@@ -1,0 +1,60 @@
+"""apex_tpu — a TPU-native training-acceleration library.
+
+A brand-new JAX/XLA/Pallas implementation of the capability set of NVIDIA Apex
+(reference: ``13462877152/apex``): mixed-precision opt levels O0–O3 with a
+jit-compatible dynamic loss scaler, fused optimizers (Adam/LAMB/SGD/NovoGrad/
+Adagrad), fused normalization kernels, data parallelism (bucketed gradient
+all-reduce, SyncBatchNorm, LARC), Megatron-style tensor/pipeline/sequence
+parallelism over a named ``jax.sharding.Mesh``, and the contrib kernel suite
+(attention, cross-entropy, focal loss, group norm, transducer, sparsity).
+
+This is not a port: the compute path is jnp/XLA with Pallas kernels for the
+hot ops, and all distribution is SPMD over mesh axes (psum / all_gather /
+reduce_scatter / ppermute on ICI) instead of process groups + NCCL.
+
+Layering mirrors the reference (see SURVEY.md §2):
+  amp/            precision engine           (ref: apex/amp)
+  multi_tensor/   fused tree-update engine   (ref: apex/multi_tensor_apply + csrc/amp_C)
+  ops/            Pallas kernels + jnp refs  (ref: csrc/*)
+  optimizers/     fused optimizers           (ref: apex/optimizers)
+  normalization/  fused LN/RMSNorm modules   (ref: apex/normalization)
+  parallel/       data parallelism           (ref: apex/parallel)
+  transformer/    model parallelism          (ref: apex/transformer)
+  contrib/        optional extensions        (ref: apex/contrib)
+"""
+
+from apex_tpu import utils  # noqa: F401
+
+__version__ = "0.1.0"
+
+# Subpackages are imported lazily to keep `import apex_tpu` light and to avoid
+# importing optional heavy pieces (pallas, flax) unless used.
+_SUBMODULES = (
+    "amp",
+    "multi_tensor",
+    "ops",
+    "optimizers",
+    "normalization",
+    "fp16_utils",
+    "mlp",
+    "fused_dense",
+    "parallel",
+    "transformer",
+    "contrib",
+    "models",
+    "testing",
+)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+
+        mod = importlib.import_module(f"apex_tpu.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'apex_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals().keys()) + list(_SUBMODULES))

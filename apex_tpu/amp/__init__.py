@@ -1,0 +1,27 @@
+"""apex_tpu.amp — mixed-precision engine (ref: apex/amp)."""
+
+from apex_tpu.amp.policy import (  # noqa: F401
+    O0,
+    O1,
+    O2,
+    O3,
+    Policy,
+    default_keep_fp32_predicate,
+)
+from apex_tpu.amp.scaler import LossScaler, ScalerState  # noqa: F401
+from apex_tpu.amp.autocast import (  # noqa: F401
+    autocast,
+    disable_casts,
+    register_float_function,
+    register_half_function,
+    register_promote_function,
+)
+from apex_tpu.amp.frontend import (  # noqa: F401
+    AmpOptimizer,
+    AmpOptState,
+    initialize,
+    load_state_dict,
+    master_params,
+    scale_loss,
+    state_dict,
+)

@@ -1,0 +1,168 @@
+"""O1-style autocast: cast-list-driven function interception.
+
+Reference: apex/amp/amp.py::init + wrap.py::make_cast_wrapper — the reference
+monkey-patches torch functions so that listed ops cast their inputs per the
+cast lists. Under JAX the same mechanism works *at trace time*: while a
+jit-traced forward runs inside this context, calls routed through the public
+``jax.numpy`` / ``jax.lax`` / ``jax.nn`` entry points are intercepted and
+their floating inputs cast (SURVEY.md §8.4.1 — behavioral, not mechanical,
+parity: there is no per-op cast caching because XLA CSE already deduplicates
+repeated casts of the same value).
+
+Only Python-level dispatch is affected; once a function has been traced the
+jaxpr is fixed, which is exactly the O1 contract (casts become part of the
+compiled program).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import importlib
+import threading
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import lists as _lists
+
+_LOW, _HIGH, _PROMOTE = "low", "high", "promote"
+
+# Runtime-extensible registries (ref: apex.amp.register_half_function etc.)
+_extra: dict = {_LOW: [], _HIGH: [], _PROMOTE: []}
+
+
+def register_half_function(module_name: str, fn_name: str) -> None:
+    _extra[_LOW].append((module_name, fn_name))
+
+
+def register_float_function(module_name: str, fn_name: str) -> None:
+    _extra[_HIGH].append((module_name, fn_name))
+
+
+def register_promote_function(module_name: str, fn_name: str) -> None:
+    _extra[_PROMOTE].append((module_name, fn_name))
+
+
+class _ThreadState(threading.local):
+    """Per-thread policy stack: a thread outside any autocast context is never
+    affected by another thread's context (wrappers see an empty stack)."""
+
+    def __init__(self):
+        self.stack: List[Optional[object]] = []  # active Policy or None(=disabled)
+
+
+_tstate = _ThreadState()
+
+# Patching is process-global (module attributes are shared), so it is
+# REFCOUNTED across threads under a lock: the wrappers stay installed until
+# the last thread exits its outermost context.
+_patch_lock = threading.RLock()
+_patch_refcount = 0
+_patched: List[Tuple[object, str, object]] = []
+
+
+def _current_policy():
+    return _tstate.stack[-1] if _tstate.stack else None
+
+
+def _is_float_array(x) -> bool:
+    return isinstance(x, (jax.Array, jnp.ndarray)) and jnp.issubdtype(
+        jnp.asarray(x).dtype, jnp.floating
+    )
+
+
+def _map_float_args(fn, args, kwargs):
+    args = tuple(fn(a) if _is_float_array(a) else a for a in args)
+    kwargs = {k: (fn(v) if _is_float_array(v) else v) for k, v in kwargs.items()}
+    return args, kwargs
+
+
+def _cast_wrapper(orig, category):
+    @functools.wraps(orig)
+    def wrapper(*args, **kwargs):
+        policy = _current_policy()
+        if policy is None:
+            return orig(*args, **kwargs)
+        if category == _LOW:
+            dtype = policy.compute_dtype
+            args, kwargs = _map_float_args(lambda a: a.astype(dtype), args, kwargs)
+        elif category == _HIGH:
+            args, kwargs = _map_float_args(
+                lambda a: a.astype(jnp.float32), args, kwargs
+            )
+        else:  # promote: widest floating dtype among args
+            dts = [jnp.asarray(a).dtype for a in args if _is_float_array(a)]
+            dts += [jnp.asarray(v).dtype for v in kwargs.values() if _is_float_array(v)]
+            if dts:
+                widest = functools.reduce(jnp.promote_types, dts)
+                args, kwargs = _map_float_args(
+                    lambda a: a.astype(widest), args, kwargs
+                )
+        return orig(*args, **kwargs)
+
+    wrapper.__wrapped_by_apex_tpu_amp__ = True
+    return wrapper
+
+
+def _entries():
+    for cat, base in (
+        (_LOW, _lists.LOW_PRECISION_FUNCS),
+        (_HIGH, _lists.HIGH_PRECISION_FUNCS),
+        (_PROMOTE, _lists.PROMOTE_FUNCS),
+    ):
+        for mod_name, fn_name in list(base) + _extra[cat]:
+            yield cat, mod_name, fn_name
+
+
+def _acquire_patches():
+    global _patch_refcount
+    with _patch_lock:
+        _patch_refcount += 1
+        if _patch_refcount > 1:
+            return
+        for cat, mod_name, fn_name in _entries():
+            try:
+                mod = importlib.import_module(mod_name)
+                orig = getattr(mod, fn_name)
+            except (ImportError, AttributeError):
+                continue
+            if getattr(orig, "__wrapped_by_apex_tpu_amp__", False):
+                continue
+            setattr(mod, fn_name, _cast_wrapper(orig, cat))
+            _patched.append((mod, fn_name, orig))
+
+
+def _release_patches():
+    global _patch_refcount
+    with _patch_lock:
+        _patch_refcount -= 1
+        if _patch_refcount > 0:
+            return
+        for mod, fn_name, orig in reversed(_patched):
+            setattr(mod, fn_name, orig)
+        _patched.clear()
+
+
+@contextlib.contextmanager
+def autocast(policy=None, enabled: bool = True):
+    """Run the body with cast-list interception active.
+
+    ``policy`` defaults to the O1 preset. ``enabled=False`` opens a disabled
+    region inside an active autocast (reference: ``amp.disable_casts``).
+    """
+    if policy is None and enabled:
+        from apex_tpu.amp.policy import Policy
+
+        policy = Policy.from_opt_level("O1")
+    _tstate.stack.append(policy if enabled else None)
+    _acquire_patches()
+    try:
+        yield
+    finally:
+        _tstate.stack.pop()
+        _release_patches()
+
+
+disable_casts = functools.partial(autocast, enabled=False)

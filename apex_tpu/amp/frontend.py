@@ -1,0 +1,217 @@
+"""amp frontend: ``initialize`` / ``scale_loss`` / ``master_params`` / state dicts.
+
+Reference: apex/amp/frontend.py::initialize, handle.py::AmpHandle.scale_loss,
+_initialize.py::_initialize, _process_optimizer.py::_process_optimizer.
+
+JAX shape of the API (functional, jit-first):
+
+    model_fn, params, opt = amp.initialize(model_fn, params, optax_tx, opt_level="O2")
+    opt_state = opt.init(params)
+
+    def train_step(params, opt_state, batch):
+        def loss_fn(p):
+            loss = compute_loss(model_fn, p, batch)
+            return amp.scale_loss(loss, opt_state)      # ref: with amp.scale_loss(...)
+        grads = jax.grad(loss_fn)(params)
+        return opt.apply_gradients(grads, opt_state, params)  # unscale+check+step+update
+
+The returned optimizer owns fp32 master weights (O2), the dynamic loss scaler
+state, and the skip-on-overflow logic — the functional analog of the
+reference's optimizer surgery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.autocast import autocast
+from apex_tpu.amp.policy import Policy
+from apex_tpu.amp.scaler import LossScaler, ScalerState
+from apex_tpu.utils.pytree import tree_cast, tree_select
+
+
+class AmpOptState(NamedTuple):
+    """Pytree: inner optimizer state + master weights + scaler state."""
+
+    inner: Any
+    master: Optional[Any]        # fp32 master params (O2) or None
+    scaler: ScalerState
+    skipped_steps: jnp.ndarray   # i32[] count of overflow-skipped steps
+
+
+@dataclasses.dataclass(frozen=True)
+class AmpOptimizer:
+    """Wraps an optax GradientTransformation with amp semantics.
+
+    The analog of apex/amp/_process_optimizer.py: maintains fp32 master
+    params for low-precision model params, unscales grads (fp32), checks for
+    overflow, skips the whole step on overflow (``lax``-free tree select so it
+    stays jit-friendly), and updates the dynamic scale.
+    """
+
+    tx: Any                      # optax.GradientTransformation
+    policy: Policy
+    scaler: LossScaler
+    # Original (pre-cast) fp32 params captured by ``initialize`` so O2 master
+    # weights start from the TRUE fp32 values, not an upcast of the half-cast
+    # copy (ref: _process_optimizer keeps the original fp32 tensors as
+    # masters). None when constructed standalone — init() then upcasts.
+    master_source: Any = None
+
+    def init(self, params) -> AmpOptState:
+        if self.policy.master_weights:
+            src = self.master_source if self.master_source is not None else params
+            master = tree_cast(src, jnp.float32)
+        else:
+            master = None
+        target = master if master is not None else params
+        return AmpOptState(
+            inner=self.tx.init(target),
+            master=master,
+            scaler=self.scaler.init(),
+            skipped_steps=jnp.int32(0),
+        )
+
+    def scale_loss(self, loss, state: AmpOptState):
+        return self.scaler.scale_loss(state.scaler, loss)
+
+    def apply_gradients(self, grads, state: AmpOptState, params):
+        """Returns ``(new_params, new_state)`` with overflow-safe semantics."""
+        import optax
+
+        grads32, found_inf = self.scaler.unscale(state.scaler, grads)
+
+        target = state.master if state.master is not None else params
+        updates, inner_new = self.tx.update(grads32, state.inner, target)
+        # Zero the updates on overflow instead of branching: keeps a single
+        # fused program and matches the reference's "skip step" semantics.
+        safe_updates = jax.tree.map(
+            lambda u: jnp.where(found_inf, jnp.zeros_like(u), u), updates
+        )
+        new_target = optax.apply_updates(target, safe_updates)
+        inner_new = tree_select(found_inf, state.inner, inner_new)
+
+        if state.master is not None:
+            new_master = new_target
+            new_params = jax.tree.map(
+                lambda mp, p: mp.astype(jnp.asarray(p).dtype)
+                if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating)
+                else p,
+                new_master,
+                params,
+            )
+        else:
+            new_master = None
+            new_params = new_target
+
+        new_state = AmpOptState(
+            inner=inner_new,
+            master=new_master,
+            scaler=self.scaler.update(state.scaler, found_inf),
+            skipped_steps=state.skipped_steps + found_inf.astype(jnp.int32),
+        )
+        return new_params, new_state
+
+    # -- introspection / checkpointing -----------------------------------
+    def master_params(self, state: AmpOptState, params=None):
+        """Ref: apex/amp/frontend.py::master_params — fp32 leaves the
+        optimizer actually steps."""
+        if state.master is not None:
+            return state.master
+        return params
+
+    def state_dict(self, state: AmpOptState) -> dict:
+        d = self.scaler.state_dict(state.scaler)
+        d["skipped_steps"] = state.skipped_steps
+        return d
+
+    def load_state_dict(self, state: AmpOptState, d: dict) -> AmpOptState:
+        return state._replace(
+            scaler=self.scaler.load_state_dict(d),
+            skipped_steps=jnp.int32(d.get("skipped_steps", 0)),
+        )
+
+
+def initialize(
+    model_fn,
+    params,
+    optimizer,
+    opt_level: str = "O1",
+    *,
+    cast_model_type=None,
+    patch_functions=None,
+    keep_batchnorm_fp32=None,
+    master_weights=None,
+    loss_scale=None,
+    half_dtype=None,
+    keep_fp32_predicate=None,
+    verbosity: int = 1,
+):
+    """Set up mixed-precision training (ref: apex/amp/frontend.py::initialize).
+
+    Args:
+      model_fn: ``model_fn(params, *inputs, **kw)`` — the forward function.
+      params: parameter pytree.
+      optimizer: an optax ``GradientTransformation`` (e.g.
+        ``apex_tpu.optimizers.fused_adam(...)``).
+      opt_level: "O0" | "O1" | "O2" | "O3" (+ property overrides as kwargs).
+
+    Returns ``(wrapped_model_fn, cast_params, AmpOptimizer)``.
+    """
+    policy = Policy.from_opt_level(
+        opt_level,
+        cast_model_type=cast_model_type,
+        patch_functions=patch_functions,
+        keep_batchnorm_fp32=keep_batchnorm_fp32,
+        master_weights=master_weights,
+        loss_scale=loss_scale,
+        half_dtype=half_dtype,
+        keep_fp32_predicate=keep_fp32_predicate,
+    )
+    if verbosity:
+        print(f"apex_tpu.amp: opt_level={opt_level}, policy={policy}")
+
+    cast_params = policy.cast_params(params)
+
+    def wrapped_model_fn(p, *args, **kwargs):
+        args = policy.cast_inputs(args)
+        if policy.patch_functions:
+            with autocast(policy):
+                return model_fn(p, *args, **kwargs)
+        return model_fn(p, *args, **kwargs)
+
+    amp_opt = AmpOptimizer(
+        tx=optimizer,
+        policy=policy,
+        scaler=policy.make_scaler(),
+        master_source=params if policy.master_weights else None,
+    )
+    return wrapped_model_fn, cast_params, amp_opt
+
+
+def scale_loss(loss, opt_state_or_scaler):
+    """Scale a loss by the current dynamic scale.
+
+    Accepts an :class:`AmpOptState` or a :class:`ScalerState`. Functional form
+    of the reference's ``with amp.scale_loss(loss, optimizer):`` context —
+    unscaling happens inside ``AmpOptimizer.apply_gradients``.
+    """
+    s = opt_state_or_scaler
+    scaler_state = s.scaler if isinstance(s, AmpOptState) else s
+    return (loss.astype(jnp.float32) * scaler_state.scale).astype(loss.dtype)
+
+
+def master_params(opt, state, params=None):
+    return opt.master_params(state, params)
+
+
+def state_dict(opt: AmpOptimizer, state: AmpOptState) -> dict:
+    return opt.state_dict(state)
+
+
+def load_state_dict(opt: AmpOptimizer, state: AmpOptState, d: dict) -> AmpOptState:
+    return opt.load_state_dict(state, d)

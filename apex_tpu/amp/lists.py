@@ -1,0 +1,75 @@
+"""Autocast cast lists.
+
+Reference: apex/amp/lists/{torch_overrides,functional_overrides,tensor_overrides}.py
+— which ops run in half (FP16_FUNCS: the gemm/conv family), which must run in
+fp32 (FP32_FUNCS: softmax/log/exp/pow/norm/loss family), and which promote
+mixed inputs to the widest dtype (CASTS/PROMOTE).
+
+Here entries are (module, attribute-name) pairs resolved at patch time, so the
+interceptor wraps the functions user code and libraries (flax/haiku resolve
+``lax.dot_general`` etc. at call time) actually go through while tracing.
+"""
+
+from __future__ import annotations
+
+# The MXU ops: run in the policy's half dtype with fp32 accumulation
+# (preferred_element_type), like the reference's FP16_FUNCS gemm/conv list.
+LOW_PRECISION_FUNCS = [
+    ("jax.lax", "dot_general"),
+    ("jax.lax", "dot"),
+    ("jax.lax", "conv_general_dilated"),
+    ("jax.lax", "conv"),
+    ("jax.lax", "conv_with_general_padding"),
+    ("jax.numpy", "matmul"),
+    ("jax.numpy", "dot"),
+    ("jax.numpy", "vdot"),
+    ("jax.numpy", "inner"),
+    ("jax.numpy", "tensordot"),
+    ("jax.numpy", "einsum"),
+]
+
+# Numerically sensitive ops pinned to fp32 (reference FP32_FUNCS + the
+# functional_overrides loss/softmax family).
+HIGH_PRECISION_FUNCS = [
+    ("jax.nn", "softmax"),
+    ("jax.nn", "log_softmax"),
+    ("jax.nn", "logsumexp"),
+    ("jax.nn", "softplus"),
+    ("jax.numpy", "exp"),
+    ("jax.numpy", "expm1"),
+    ("jax.numpy", "log"),
+    ("jax.numpy", "log1p"),
+    ("jax.numpy", "log2"),
+    ("jax.numpy", "log10"),
+    ("jax.numpy", "power"),
+    ("jax.numpy", "float_power"),
+    ("jax.numpy", "cosh"),
+    ("jax.numpy", "sinh"),
+    ("jax.numpy", "tan"),
+    ("jax.numpy", "acos"),
+    ("jax.numpy", "asin"),
+    ("jax.numpy", "sum"),
+    ("jax.numpy", "prod"),
+    ("jax.numpy", "cumsum"),
+    ("jax.numpy", "cumprod"),
+    ("jax.numpy", "var"),
+    ("jax.numpy", "std"),
+    ("jax.numpy.linalg", "norm"),
+]
+
+# Ops whose mixed-precision inputs are promoted to the widest floating dtype
+# (reference CASTS/PROMOTE). JAX's native promotion already widens, but the
+# reference guarantees it even where backends would error — we keep the
+# explicit wrap for parity and for concatenation-style ops.
+PROMOTE_FUNCS = [
+    ("jax.numpy", "add"),
+    ("jax.numpy", "subtract"),
+    ("jax.numpy", "multiply"),
+    ("jax.numpy", "divide"),
+    ("jax.numpy", "true_divide"),
+    ("jax.numpy", "minimum"),
+    ("jax.numpy", "maximum"),
+    ("jax.numpy", "where"),
+    ("jax.numpy", "concatenate"),
+    ("jax.numpy", "stack"),
+]

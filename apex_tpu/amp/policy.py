@@ -1,0 +1,148 @@
+"""Opt-level policies O0–O3.
+
+Reference: apex/amp/frontend.py::O0/O1/O2/O3 + Properties. Each opt level is a
+bundle of five properties (cast_model_type, patch_functions — "patch torch
+functions" in the reference, keep_batchnorm_fp32, master_weights, loss_scale),
+individually overridable.
+
+TPU reading of the levels (SURVEY.md §3.2 mapping):
+  O0 — fp32 everything, loss_scale 1 (accuracy baseline).
+  O1 — params stay fp32; listed ops run in half via the autocast interceptor;
+       dynamic loss scaling.
+  O2 — params cast to half (BatchNorm kept fp32), fp32 master weights held by
+       the optimizer, dynamic loss scaling.
+  O3 — pure half, no master weights, static scale 1 (speed ceiling).
+
+``half_dtype`` selects bfloat16 (TPU-native default; scaler is then inert in
+practice but kept for parity) or float16 (exercises the full scaler ladder).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Callable, Optional, Union
+
+import jax.numpy as jnp
+
+from apex_tpu.utils.dtypes import canonical_half_dtype, default_half_dtype
+from apex_tpu.utils.pytree import tree_cast, tree_cast_where
+
+_BN_PAT = re.compile(r"(batch_?norm|(^|/)bn(_|\d|/|$))", re.IGNORECASE)
+
+
+def default_keep_fp32_predicate(path: str) -> bool:
+    """Heuristic for keep_batchnorm_fp32: parameter paths that look like BN."""
+    return bool(_BN_PAT.search(path))
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """The reference's ``Properties`` bundle as a frozen dataclass."""
+
+    opt_level: str = "O1"
+    cast_model_type: Optional[object] = None     # dtype params are cast to
+    patch_functions: bool = False                # O1 autocast interception
+    keep_batchnorm_fp32: Optional[bool] = None
+    master_weights: bool = False
+    loss_scale: Union[str, float] = 1.0          # "dynamic" or a number
+    half_dtype: object = None                    # bf16 (default) or fp16
+    keep_fp32_predicate: Callable[[str], bool] = default_keep_fp32_predicate
+
+    @staticmethod
+    def from_opt_level(
+        opt_level: str,
+        *,
+        cast_model_type=None,
+        patch_functions=None,
+        keep_batchnorm_fp32=None,
+        master_weights=None,
+        loss_scale=None,
+        half_dtype=None,
+        keep_fp32_predicate=None,
+    ) -> "Policy":
+        half = canonical_half_dtype(half_dtype) or default_half_dtype()
+        presets = {
+            "O0": dict(
+                cast_model_type=jnp.float32,
+                patch_functions=False,
+                keep_batchnorm_fp32=None,
+                master_weights=False,
+                loss_scale=1.0,
+            ),
+            "O1": dict(
+                cast_model_type=None,
+                patch_functions=True,
+                keep_batchnorm_fp32=None,
+                master_weights=False,
+                loss_scale="dynamic",
+            ),
+            "O2": dict(
+                cast_model_type=half,
+                patch_functions=False,
+                keep_batchnorm_fp32=True,
+                master_weights=True,
+                loss_scale="dynamic",
+            ),
+            "O3": dict(
+                cast_model_type=half,
+                patch_functions=False,
+                keep_batchnorm_fp32=False,
+                master_weights=False,
+                loss_scale=1.0,
+            ),
+        }
+        if opt_level not in presets:
+            raise ValueError(f"Unexpected opt_level {opt_level!r}; expected O0..O3")
+        cfg = presets[opt_level]
+        overrides = dict(
+            cast_model_type=cast_model_type,
+            patch_functions=patch_functions,
+            keep_batchnorm_fp32=keep_batchnorm_fp32,
+            master_weights=master_weights,
+            loss_scale=loss_scale,
+        )
+        for k, v in overrides.items():
+            if v is not None:
+                cfg[k] = v
+        return Policy(
+            opt_level=opt_level,
+            half_dtype=half,
+            keep_fp32_predicate=keep_fp32_predicate or default_keep_fp32_predicate,
+            **cfg,
+        )
+
+    # -- behavior ---------------------------------------------------------
+    @property
+    def compute_dtype(self):
+        """Dtype the autocast interceptor casts listed ops to (O1)."""
+        return self.half_dtype
+
+    def cast_params(self, params):
+        """O2/O3 model cast (ref: apex/amp/_initialize.py models_to_half)."""
+        if self.cast_model_type is None:
+            return params
+        if self.cast_model_type == jnp.float32:
+            return tree_cast(params, jnp.float32)
+        if self.keep_batchnorm_fp32:
+            return tree_cast_where(
+                params, self.cast_model_type, self.keep_fp32_predicate
+            )
+        return tree_cast(params, self.cast_model_type)
+
+    def cast_inputs(self, args):
+        """Input cast applied by the patched forward (O2/O3)."""
+        if self.cast_model_type is None or self.cast_model_type == jnp.float32:
+            return args
+        return tree_cast(args, self.cast_model_type)
+
+    def make_scaler(self):
+        from apex_tpu.amp.scaler import LossScaler
+
+        return LossScaler.from_loss_scale(self.loss_scale)
+
+
+O0 = Policy.from_opt_level("O0")
+O1 = Policy.from_opt_level("O1")
+O2 = Policy.from_opt_level("O2")
+O3 = Policy.from_opt_level("O3")

@@ -1,0 +1,107 @@
+"""Dynamic loss scaler — jit-compatible, checkpointable.
+
+Reference: apex/amp/scaler.py::LossScaler (init scale 2**16, x2 every 2000
+clean steps, /2 on overflow) and csrc/update_scale_hysteresis.cu (device-side
+update with a hysteresis counter).
+
+Design differences forced by XLA (SURVEY.md §8.4.2): the scale lives as a
+traced f32 array inside the train state — never a Python float — so scale
+changes never trigger recompilation, and the step-skip is a ``jnp.where`` /
+``lax.cond`` over the update rather than a host-side branch. The state is a
+pytree, so it checkpoints with the rest of the train state, preserving the
+reference's ``amp.state_dict()`` capability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor.functional import update_scale_hysteresis
+from apex_tpu.utils.pytree import tree_all_finite
+
+
+class ScalerState(NamedTuple):
+    """Pytree state of the loss scaler (all device scalars)."""
+
+    scale: jnp.ndarray            # f32[] current loss scale
+    growth_tracker: jnp.ndarray   # i32[] consecutive clean steps
+    hysteresis_tracker: jnp.ndarray  # i32[] remaining tolerated overflows
+
+
+@dataclasses.dataclass(frozen=True)
+class LossScaler:
+    """Static config + pure methods over :class:`ScalerState`.
+
+    ``dynamic=False`` gives the reference's static scaler ("128.0" style
+    ``loss_scale`` values); ``update`` is then the identity.
+    """
+
+    init_scale: float = 2.0 ** 16
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    hysteresis: int = 1
+    dynamic: bool = True
+
+    @staticmethod
+    def from_loss_scale(loss_scale) -> "LossScaler":
+        """Map the reference's ``loss_scale`` property ("dynamic" | number)."""
+        if loss_scale in (None, "dynamic"):
+            return LossScaler(dynamic=True)
+        return LossScaler(init_scale=float(loss_scale), dynamic=False)
+
+    def init(self) -> ScalerState:
+        return ScalerState(
+            scale=jnp.float32(self.init_scale),
+            growth_tracker=jnp.int32(0),
+            hysteresis_tracker=jnp.int32(self.hysteresis),
+        )
+
+    # -- pure ops ---------------------------------------------------------
+    def scale_loss(self, state: ScalerState, loss):
+        return (loss.astype(jnp.float32) * state.scale).astype(loss.dtype)
+
+    def unscale(self, state: ScalerState, grads):
+        """Unscale grads to fp32 and report overflow.
+
+        Returns ``(grads_fp32, found_inf)``; the overflow check inspects the
+        *unscaled* values like ``amp_C.multi_tensor_scale`` does.
+        """
+        inv = jnp.where(state.scale > 0, 1.0 / state.scale, 1.0)
+        grads32 = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+        found_inf = ~tree_all_finite(grads32)
+        return grads32, found_inf
+
+    def update(self, state: ScalerState, found_inf) -> ScalerState:
+        if not self.dynamic:
+            return state
+        scale, growth, hys = update_scale_hysteresis(
+            state.scale,
+            state.growth_tracker,
+            state.hysteresis_tracker,
+            found_inf,
+            self.growth_interval,
+            self.growth_factor,
+            self.backoff_factor,
+            self.hysteresis,
+        )
+        return ScalerState(scale, growth, hys)
+
+    # -- checkpointing (ref: apex/amp/frontend.py::state_dict) ------------
+    def state_dict(self, state: ScalerState) -> dict:
+        return {
+            "loss_scale": state.scale,
+            "unskipped": state.growth_tracker,
+            "hysteresis_tracker": state.hysteresis_tracker,
+        }
+
+    def load_state_dict(self, d: dict) -> ScalerState:
+        return ScalerState(
+            scale=jnp.float32(d["loss_scale"]),
+            growth_tracker=jnp.int32(d.get("unskipped", 0)),
+            hysteresis_tracker=jnp.int32(d.get("hysteresis_tracker", self.hysteresis)),
+        )

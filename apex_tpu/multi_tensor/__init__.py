@@ -1,0 +1,15 @@
+from apex_tpu.multi_tensor.multi_tensor_apply import (  # noqa: F401
+    MultiTensorApply,
+    multi_tensor_applier,
+)
+from apex_tpu.multi_tensor import functional  # noqa: F401
+from apex_tpu.multi_tensor.functional import (  # noqa: F401
+    multi_tensor_adagrad,
+    multi_tensor_adam,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_lamb,
+    multi_tensor_novograd,
+    multi_tensor_scale,
+    multi_tensor_sgd,
+)

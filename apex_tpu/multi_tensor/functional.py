@@ -1,0 +1,329 @@
+"""Functional multi-tensor ops — the TPU analog of the ``amp_C`` kernel suite.
+
+Reference kernels (csrc/): multi_tensor_scale_kernel.cu, multi_tensor_axpby_kernel.cu,
+multi_tensor_l2norm_kernel.cu, multi_tensor_adam.cu, multi_tensor_adagrad.cu,
+multi_tensor_novograd.cu, multi_tensor_sgd_kernel.cu, multi_tensor_lamb.cu and
+update_scale_hysteresis.cu.
+
+Semantics preserved:
+  * all update math accumulates in float32 regardless of storage dtype
+    (the reference's DISPATCH_FLOAT_HALF_AND_BFLOAT kernels upcast per element);
+  * scale/axpby detect inf/nan and report it via the returned ``noop_flag``
+    — the primitive the amp loss scaler is built on;
+  * results are returned (functional) rather than written in place; jit buffer
+    donation restores in-place behavior at the boundary.
+
+Each op takes ``(noop_flag, tensor_lists, *args)`` to match the
+``multi_tensor_applier`` calling convention and returns
+``(*new_lists, noop_flag)``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+Tensors = Sequence[jnp.ndarray]
+
+
+def _f32(x):
+    return jnp.asarray(x, jnp.float32)
+
+
+def _nonfinite_any(tensors: Tensors):
+    if not tensors:
+        return jnp.bool_(False)
+    return jnp.stack([~jnp.all(jnp.isfinite(t)) for t in tensors]).any()
+
+
+def multi_tensor_scale(noop_flag, tensor_lists, scale, out_dtype=None):
+    """out = in * scale; flags inf/nan. Ref: csrc/multi_tensor_scale_kernel.cu.
+
+    ``tensor_lists = [ins]`` (outputs are returned; the reference's [ins, outs]
+    out-tensor dtype is selected by ``out_dtype`` — pass ``jnp.float32`` to get
+    the fp16-model-grads → fp32-master-grads unscale used by amp
+    (apex/amp/_process_optimizer.py::post_backward_with_master_weights);
+    ``None`` preserves each input's dtype).
+    """
+    (ins,) = tensor_lists
+    scale = _f32(scale)
+    outs32 = [_f32(t) * scale for t in ins]
+    outs = [o.astype(out_dtype or t.dtype) for o, t in zip(outs32, ins)]
+    flag = noop_flag | _nonfinite_any(outs32)
+    return outs, flag
+
+
+def multi_tensor_axpby(noop_flag, tensor_lists, a, b):
+    """out = a*x + b*y with inf/nan check. Ref: csrc/multi_tensor_axpby_kernel.cu."""
+    xs, ys = tensor_lists
+    a, b = _f32(a), _f32(b)
+    outs32 = [a * _f32(x) + b * _f32(y) for x, y in zip(xs, ys)]
+    outs = [o.astype(x.dtype) for o, x in zip(outs32, xs)]
+    flag = noop_flag | _nonfinite_any(outs32)
+    return outs, flag
+
+
+def multi_tensor_l2norm(noop_flag, tensor_lists, per_tensor=False):
+    """Global (and optionally per-tensor) L2 norms, fp32 accumulation.
+
+    Ref: csrc/multi_tensor_l2norm_kernel.cu (+_mp). Used for LAMB trust ratios
+    and clip_grad_norm. Single source of truth for the reduction is
+    ``apex_tpu.utils.pytree.tree_global_norm``.
+    """
+    from apex_tpu.utils.pytree import tree_global_norm
+
+    (xs,) = tensor_lists
+    if not xs:
+        z = jnp.float32(0.0)
+        return (z, jnp.zeros((0,), jnp.float32)) if per_tensor else z
+    if per_tensor:
+        total, per = tree_global_norm(list(xs), per_leaf=True)
+        return total, jnp.stack(per)
+    return tree_global_norm(list(xs))
+
+
+ADAM_MODE_ADAM = 0      # L2 regularization added to gradient (classic Adam)
+ADAM_MODE_ADAMW = 1     # decoupled weight decay (AdamW)
+
+
+def multi_tensor_adam(
+    noop_flag,
+    tensor_lists,
+    lr,
+    beta1,
+    beta2,
+    eps,
+    step,
+    mode,
+    bias_correction,
+    weight_decay,
+):
+    """Fused Adam/AdamW update. Ref: csrc/multi_tensor_adam.cu.
+
+    tensor_lists = [grads, params, exp_avgs, exp_avg_sqs]; returns updated
+    (params, exp_avgs, exp_avg_sqs, noop_flag). When ``noop_flag`` is set the
+    update is suppressed (reference kernels early-exit on the flag).
+    """
+    grads, params, ms, vs = tensor_lists
+    lr = _f32(lr)
+    b1, b2, eps = _f32(beta1), _f32(beta2), _f32(eps)
+    step = jnp.asarray(step, jnp.float32)
+    if bias_correction:
+        bc1 = 1.0 - b1 ** step
+        bc2 = 1.0 - b2 ** step
+    else:
+        bc1 = bc2 = jnp.float32(1.0)
+
+    skip = noop_flag
+    new_p, new_m, new_v = [], [], []
+    for g, p, m, v in zip(grads, params, ms, vs):
+        g32, p32, m32, v32 = _f32(g), _f32(p), _f32(m), _f32(v)
+        if mode == ADAM_MODE_ADAM:
+            g32 = g32 + weight_decay * p32
+        m_n = b1 * m32 + (1.0 - b1) * g32
+        v_n = b2 * v32 + (1.0 - b2) * jnp.square(g32)
+        update = (m_n / bc1) / (jnp.sqrt(v_n / bc2) + eps)
+        if mode == ADAM_MODE_ADAMW:
+            update = update + weight_decay * p32
+        p_n = p32 - lr * update
+        new_p.append(jnp.where(skip, p32, p_n).astype(p.dtype))
+        new_m.append(jnp.where(skip, m32, m_n).astype(m.dtype))
+        new_v.append(jnp.where(skip, v32, v_n).astype(v.dtype))
+    return new_p, new_m, new_v, noop_flag
+
+
+def multi_tensor_adagrad(noop_flag, tensor_lists, lr, epsilon, mode, weight_decay):
+    """Fused Adagrad. Ref: csrc/multi_tensor_adagrad.cu (mode 0 = L2, 1 = decoupled)."""
+    grads, params, hs = tensor_lists
+    lr, eps = _f32(lr), _f32(epsilon)
+    skip = noop_flag
+    new_p, new_h = [], []
+    for g, p, h in zip(grads, params, hs):
+        g32, p32, h32 = _f32(g), _f32(p), _f32(h)
+        if mode == 0:
+            g32 = g32 + weight_decay * p32
+        h_n = h32 + jnp.square(g32)
+        p_n = p32 - lr * g32 / (jnp.sqrt(h_n) + eps)
+        if mode == 1:
+            p_n = p_n - lr * weight_decay * p32
+        new_p.append(jnp.where(skip, p32, p_n).astype(p.dtype))
+        new_h.append(jnp.where(skip, h32, h_n).astype(h.dtype))
+    return new_p, new_h, noop_flag
+
+
+def multi_tensor_sgd(
+    noop_flag,
+    tensor_lists,
+    weight_decay,
+    momentum,
+    dampening,
+    lr,
+    nesterov,
+    first_run,
+    weight_decay_after_momentum,
+    scale=1.0,
+):
+    """Fused momentum SGD. Ref: csrc/multi_tensor_sgd_kernel.cu.
+
+    tensor_lists = [grads, params, momentum_buffers]. ``scale`` multiplies the
+    gradient (used to fold grad unscaling into the update).
+    """
+    grads, params, bufs = tensor_lists
+    lr = _f32(lr)
+    skip = noop_flag
+    new_p, new_b = [], []
+    for g, p, b in zip(grads, params, bufs):
+        g32, p32, b32 = _f32(g) * _f32(scale), _f32(p), _f32(b)
+        if weight_decay != 0.0 and not weight_decay_after_momentum:
+            g32 = g32 + weight_decay * p32
+        if momentum != 0.0:
+            b_n = jnp.where(
+                jnp.bool_(first_run), g32, momentum * b32 + (1.0 - dampening) * g32
+            )
+            d = g32 + momentum * b_n if nesterov else b_n
+        else:
+            b_n = b32
+            d = g32
+        if weight_decay != 0.0 and weight_decay_after_momentum:
+            d = d + weight_decay * p32
+        p_n = p32 - lr * d
+        new_p.append(jnp.where(skip, p32, p_n).astype(p.dtype))
+        new_b.append(jnp.where(skip, b32, b_n).astype(b.dtype))
+    return new_p, new_b, noop_flag
+
+
+def multi_tensor_novograd(
+    noop_flag,
+    tensor_lists,
+    lr,
+    beta1,
+    beta2,
+    eps,
+    step,
+    bias_correction,
+    weight_decay,
+    grad_averaging,
+    moment_mode,
+    norm_type,
+):
+    """Fused NovoGrad: per-TENSOR second moment (a scalar per tensor).
+
+    Ref: csrc/multi_tensor_novograd.cu; norms list is [per-tensor v scalars].
+    tensor_lists = [grads, params, exp_avgs]; plus ``norms`` vector argument is
+    carried in exp_avg_sq per-tensor scalars, here returned as a vector.
+    """
+    grads, params, ms, v_scalars = tensor_lists
+    lr, b1, b2, eps = _f32(lr), _f32(beta1), _f32(beta2), _f32(eps)
+    step = jnp.asarray(step, jnp.float32)
+    bc1 = 1.0 - b1 ** step if bias_correction else jnp.float32(1.0)
+    bc2 = 1.0 - b2 ** step if bias_correction else jnp.float32(1.0)
+    g_coef = (1.0 - b1) if grad_averaging else jnp.float32(1.0)
+    skip = noop_flag
+    new_p, new_m, new_v = [], [], []
+    for g, p, m, v in zip(grads, params, ms, v_scalars):
+        g32, p32, m32, v32 = _f32(g), _f32(p), _f32(m), _f32(v)
+        gnorm2 = jnp.sum(jnp.square(g32))
+        v_n = jnp.where(
+            jnp.bool_(step <= 1.0) if moment_mode == 0 else jnp.bool_(False),
+            gnorm2,
+            b2 * v32 + (1.0 - b2) * gnorm2,
+        )
+        denom = jnp.sqrt(v_n / bc2) + eps
+        g_scaled = g32 / denom + weight_decay * p32
+        m_n = b1 * m32 + g_coef * g_scaled
+        p_n = p32 - lr * (m_n / bc1)
+        new_p.append(jnp.where(skip, p32, p_n).astype(p.dtype))
+        new_m.append(jnp.where(skip, m32, m_n).astype(m.dtype))
+        new_v.append(jnp.where(skip, v32, v_n))
+    return new_p, new_m, new_v, noop_flag
+
+
+def multi_tensor_lamb(
+    noop_flag,
+    tensor_lists,
+    lr,
+    beta1,
+    beta2,
+    eps,
+    step,
+    bias_correction,
+    weight_decay,
+    grad_averaging,
+    mode,
+    global_grad_norm,
+    max_grad_norm,
+    use_nvlamb=False,
+):
+    """Fused LAMB (both phases + per-tensor trust ratios in one call).
+
+    Ref: csrc/multi_tensor_lamb.cu. tensor_lists = [grads, params, m, v].
+    Phase 1: Adam-style moment update with global gradient clipping by
+    ``global_grad_norm``/``max_grad_norm``. Phase 2: per-tensor trust ratio
+    ``phi(||w||)/||update||`` scales the learning rate. NVLAMB variant applies
+    the trust ratio to weight-decay-free tensors too.
+    """
+    grads, params, ms, vs = tensor_lists
+    lr, b1, b2, eps = _f32(lr), _f32(beta1), _f32(beta2), _f32(eps)
+    step = jnp.asarray(step, jnp.float32)
+    bc1 = 1.0 - b1 ** step if bias_correction else jnp.float32(1.0)
+    bc2 = 1.0 - b2 ** step if bias_correction else jnp.float32(1.0)
+    beta3 = (1.0 - b1) if grad_averaging else jnp.float32(1.0)
+
+    gnorm = _f32(global_grad_norm)
+    if max_grad_norm is not None and max_grad_norm > 0:
+        clip = jnp.maximum(gnorm / _f32(max_grad_norm), 1.0)
+    else:
+        clip = jnp.float32(1.0)
+
+    skip = noop_flag
+    new_p, new_m, new_v = [], [], []
+    for g, p, m, v in zip(grads, params, ms, vs):
+        g32 = _f32(g) / clip
+        p32, m32, v32 = _f32(p), _f32(m), _f32(v)
+        if mode == 0:  # L2 mode: wd folded into gradient
+            g32 = g32 + weight_decay * p32
+        m_n = b1 * m32 + beta3 * g32
+        v_n = b2 * v32 + (1.0 - b2) * jnp.square(g32)
+        update = (m_n / bc1) / (jnp.sqrt(v_n / bc2) + eps)
+        if mode == 1:  # AdamW-style decoupled decay joins the update
+            update = update + weight_decay * p32
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
+        if weight_decay != 0.0 or use_nvlamb:
+            ratio = jnp.where(
+                (w_norm > 0) & (u_norm > 0), w_norm / u_norm, jnp.float32(1.0)
+            )
+        else:
+            ratio = jnp.float32(1.0)
+        p_n = p32 - lr * ratio * update
+        new_p.append(jnp.where(skip, p32, p_n).astype(p.dtype))
+        new_m.append(jnp.where(skip, m32, m_n).astype(m.dtype))
+        new_v.append(jnp.where(skip, v32, v_n).astype(v.dtype))
+    return new_p, new_m, new_v, noop_flag
+
+
+def update_scale_hysteresis(
+    scale, growth_tracker, hysteresis_tracker, found_inf,
+    growth_interval, growth_factor, backoff_factor, hysteresis,
+):
+    """Device-side dynamic loss-scale update with hysteresis.
+
+    Ref: csrc/update_scale_hysteresis.cu. On overflow, the hysteresis counter
+    must reach zero before the scale is actually backed off (absorbs isolated
+    spikes); on ``growth_interval`` consecutive clean steps the scale grows.
+    """
+    scale = _f32(scale)
+    found_inf = jnp.asarray(found_inf, jnp.bool_)
+
+    hys_n = jnp.where(found_inf, hysteresis_tracker - 1, hysteresis)
+    backoff = found_inf & (hys_n <= 0)
+    growth_n = jnp.where(found_inf, 0, growth_tracker + 1)
+    grow = (~found_inf) & (growth_n == growth_interval)
+
+    new_scale = jnp.where(
+        backoff, scale * backoff_factor, jnp.where(grow, scale * growth_factor, scale)
+    )
+    new_growth = jnp.where(grow, 0, growth_n)
+    new_hys = jnp.where(backoff, hysteresis, hys_n).astype(jnp.int32)
+    return new_scale, new_growth.astype(jnp.int32), new_hys

@@ -1,0 +1,45 @@
+"""``multi_tensor_applier``-shaped dispatch engine.
+
+Reference: apex/multi_tensor_apply/multi_tensor_apply.py::MultiTensorApply and
+the CUDA chunking harness csrc/multi_tensor_apply.cuh. The reference exists
+because CUDA kernel launches are per-tensor: it packs hundreds of tensors'
+pointers into chunked kernel launches. Under XLA a jit'd tree-map is already a
+single fused program, so the TPU engine keeps only the *semantics*:
+
+  * one call covers an arbitrary list-of-tensor-lists,
+  * an overflow ("noop") flag is computed alongside scaling ops,
+  * the op implementations are swappable (fused-jit default, Pallas variants
+    registered by apex_tpu.ops for the optimizer updates).
+
+Ops here are functional: they RETURN new tensor lists and the updated flag
+instead of writing in place (donation at the jit boundary recovers the
+reference's in-place buffer reuse).
+"""
+
+from __future__ import annotations
+
+
+class MultiTensorApply:
+    """API-parity shim for ``apex.multi_tensor_apply.MultiTensorApply``.
+
+    ``chunk_size`` is accepted and ignored: XLA tiles and fuses the work, so
+    there is nothing to chunk on the host side.
+    """
+
+    available = True
+    warned = False
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, noop_flag, tensor_lists, *args, **kwargs):
+        """Invoke ``op(noop_flag, tensor_lists, *args)`` and return its result.
+
+        Contract mirrors the reference: ``op`` receives the current overflow
+        flag and the list of tensor lists; functional ops return
+        ``(new_tensor_lists..., new_noop_flag)``.
+        """
+        return op(noop_flag, tensor_lists, *args, **kwargs)
+
+
+multi_tensor_applier = MultiTensorApply(2048 * 32)

@@ -1,0 +1,8 @@
+"""apex_tpu.ops — kernel layer (ref: csrc/*).
+
+Each op family ships a pure-jnp reference implementation (fallback + test
+oracle) and, where a hand kernel wins on TPU, a Pallas implementation wired
+through ``jax.custom_vjp``. See SURVEY.md §3.13 for the kernel roll-up.
+"""
+
+from apex_tpu.ops import optim  # noqa: F401

@@ -1,0 +1,27 @@
+"""Optimizer update kernels.
+
+Ref: csrc/multi_tensor_adam.cu etc. The default path is the fused-jit tree
+update in ``apex_tpu.multi_tensor.functional`` (XLA fuses the whole update
+into a handful of loops); this module provides the same math per-leaf and is
+the seam where Pallas kernels plug in for the cases measured to beat XLA
+(very large flat params where a single blocked VMEM pass wins).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor import functional as F
+
+
+def adam_update(
+    grads, params, exp_avgs, exp_avg_sqs, *, lr, b1, b2, eps, step, mode,
+    bias_correction, weight_decay,
+):
+    """Adam/AdamW over leaf lists; returns (new_params, new_m, new_v)."""
+    new_p, new_m, new_v, _ = F.multi_tensor_adam(
+        jnp.bool_(False),
+        [list(grads), list(params), list(exp_avgs), list(exp_avg_sqs)],
+        lr, b1, b2, eps, step, mode, bias_correction, weight_decay,
+    )
+    return new_p, new_m, new_v

@@ -1,0 +1,18 @@
+"""apex_tpu.optimizers — fused optimizers (ref: apex/optimizers).
+
+Each optimizer is an optax ``GradientTransformation`` factory (lowercase,
+idiomatic JAX) plus an Apex-style class alias (CamelCase) from
+``apex_tpu.optimizers.stateful`` for script parity.
+"""
+
+from apex_tpu.optimizers.fused_adam import FusedAdamState, fused_adam  # noqa: F401
+from apex_tpu.optimizers.fused_adagrad import (  # noqa: F401
+    FusedAdagradState,
+    fused_adagrad,
+)
+from apex_tpu.optimizers.fused_lamb import FusedLAMBState, fused_lamb  # noqa: F401
+from apex_tpu.optimizers.fused_novograd import (  # noqa: F401
+    FusedNovoGradState,
+    fused_novograd,
+)
+from apex_tpu.optimizers.fused_sgd import FusedSGDState, fused_sgd  # noqa: F401

@@ -1,0 +1,55 @@
+"""FusedAdagrad — ref: apex/optimizers/fused_adagrad.py (``multi_tensor_adagrad``)."""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.multi_tensor.functional import multi_tensor_adagrad
+
+
+class FusedAdagradState(NamedTuple):
+    step: jnp.ndarray
+    sum_sq: optax.Params
+
+
+def fused_adagrad(
+    learning_rate=1e-2,
+    eps: float = 1e-10,
+    weight_decay: float = 0.0,
+    adagrad_w_mode: bool = False,
+) -> optax.GradientTransformation:
+    mode = 1 if adagrad_w_mode else 0
+
+    def init_fn(params):
+        return FusedAdagradState(
+            step=jnp.int32(0),
+            sum_sq=jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+        )
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adagrad requires params")
+        step = state.step + 1
+        lr = learning_rate(step) if callable(learning_rate) else learning_rate
+        leaves_g, treedef = jax.tree.flatten(grads)
+        leaves_p = treedef.flatten_up_to(params)
+        leaves_h = treedef.flatten_up_to(state.sum_sq)
+        new_p, new_h, _ = multi_tensor_adagrad(
+            jnp.bool_(False), [leaves_g, leaves_p, leaves_h], lr, eps, mode, weight_decay
+        )
+        updates = [
+            (np_.astype(jnp.float32) - jnp.asarray(p).astype(jnp.float32)).astype(
+                jnp.asarray(p).dtype
+            )
+            for np_, p in zip(new_p, leaves_p)
+        ]
+        return (
+            jax.tree.unflatten(treedef, updates),
+            FusedAdagradState(step, jax.tree.unflatten(treedef, new_h)),
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,72 @@
+"""FusedNovoGrad — ref: apex/optimizers/fused_novograd.py (per-layer
+second moment from the gradient norm; ``multi_tensor_novograd``)."""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.multi_tensor.functional import multi_tensor_novograd
+
+
+class FusedNovoGradState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: optax.Params
+    exp_avg_sq: optax.Params  # one scalar per leaf
+
+
+def fused_novograd(
+    learning_rate=1e-3,
+    b1: float = 0.95,
+    b2: float = 0.98,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    bias_correction: bool = True,
+    grad_averaging: bool = True,
+    moment_mode: int = 0,
+) -> optax.GradientTransformation:
+    def init_fn(params):
+        return FusedNovoGradState(
+            step=jnp.int32(0),
+            exp_avg=jax.tree.map(
+                lambda p: jnp.zeros_like(p, dtype=jnp.float32), params
+            ),
+            exp_avg_sq=jax.tree.map(lambda p: jnp.float32(0.0), params),
+        )
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_novograd requires params")
+        step = state.step + 1
+        lr = learning_rate(step) if callable(learning_rate) else learning_rate
+
+        leaves_g, treedef = jax.tree.flatten(grads)
+        leaves_p = treedef.flatten_up_to(params)
+        leaves_m = treedef.flatten_up_to(state.exp_avg)
+        leaves_v = treedef.flatten_up_to(state.exp_avg_sq)
+
+        new_p, new_m, new_v, _ = multi_tensor_novograd(
+            jnp.bool_(False),
+            [leaves_g, leaves_p, leaves_m, leaves_v],
+            lr, b1, b2, eps, step, bias_correction, weight_decay,
+            grad_averaging, moment_mode, 2,
+        )
+        updates = [
+            (np_.astype(jnp.float32) - jnp.asarray(p).astype(jnp.float32)).astype(
+                jnp.asarray(p).dtype
+            )
+            for np_, p in zip(new_p, leaves_p)
+        ]
+        return (
+            jax.tree.unflatten(treedef, updates),
+            FusedNovoGradState(
+                step,
+                jax.tree.unflatten(treedef, new_m),
+                jax.tree.unflatten(treedef, new_v),
+            ),
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,66 @@
+"""FusedSGD — ref: apex/optimizers/fused_sgd.py (momentum, dampening,
+nesterov, weight decay; ``multi_tensor_sgd`` kernel)."""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.multi_tensor.functional import multi_tensor_sgd
+
+
+class FusedSGDState(NamedTuple):
+    step: jnp.ndarray
+    momentum_buffer: optax.Params
+
+
+def fused_sgd(
+    learning_rate=1e-3,
+    momentum: float = 0.0,
+    dampening: float = 0.0,
+    weight_decay: float = 0.0,
+    nesterov: bool = False,
+    wd_after_momentum: bool = False,
+) -> optax.GradientTransformation:
+    def init_fn(params):
+        return FusedSGDState(
+            step=jnp.int32(0),
+            momentum_buffer=jax.tree.map(
+                lambda p: jnp.zeros_like(p, dtype=jnp.float32), params
+            ),
+        )
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_sgd requires params")
+        step = state.step + 1
+        lr = learning_rate(step) if callable(learning_rate) else learning_rate
+
+        leaves_g, treedef = jax.tree.flatten(grads)
+        leaves_p = treedef.flatten_up_to(params)
+        leaves_b = treedef.flatten_up_to(state.momentum_buffer)
+
+        # first_run must be traced (jnp.where inside the kernel), matching the
+        # reference's host-side first_run flag but without recompilation.
+        first_run = state.step == 0
+        new_p, new_b, _ = multi_tensor_sgd(
+            jnp.bool_(False),
+            [leaves_g, leaves_p, leaves_b],
+            weight_decay, momentum, dampening, lr, nesterov,
+            first_run, wd_after_momentum,
+        )
+        updates = [
+            (np_.astype(jnp.float32) - jnp.asarray(p).astype(jnp.float32)).astype(
+                jnp.asarray(p).dtype
+            )
+            for np_, p in zip(new_p, leaves_p)
+        ]
+        return (
+            jax.tree.unflatten(treedef, updates),
+            FusedSGDState(step, jax.tree.unflatten(treedef, new_b)),
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)

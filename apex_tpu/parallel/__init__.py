@@ -1,0 +1,15 @@
+"""apex_tpu.parallel — data parallelism over the mesh ``data`` axis
+(ref: apex/parallel)."""
+
+from apex_tpu.parallel import mesh  # noqa: F401
+from apex_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    STAGE_AXIS,
+    cpu_mesh,
+    data_parallel_mesh,
+    default_mesh,
+    get_default_mesh,
+    make_mesh,
+    set_default_mesh,
+)

@@ -1,0 +1,147 @@
+"""Named-mesh helpers — the SPMD replacement for the reference's process groups.
+
+The reference builds NCCL process groups per parallel dimension
+(apex/transformer/parallel_state.py::initialize_model_parallel creates
+_TENSOR_MODEL_PARALLEL_GROUP, _PIPELINE_MODEL_PARALLEL_GROUP,
+_DATA_PARALLEL_GROUP, ...). On TPU, a single ``jax.sharding.Mesh`` with named
+axes replaces all of that: collectives take an axis name instead of a
+communicator, and sub-groups are just sub-axes.
+
+Canonical axis names used throughout apex_tpu:
+  "data"   — data parallelism (reference: apex/parallel DDP, _DATA_PARALLEL_GROUP)
+  "model"  — tensor model parallelism (reference: _TENSOR_MODEL_PARALLEL_GROUP)
+  "stage"  — pipeline parallelism (reference: _PIPELINE_MODEL_PARALLEL_GROUP)
+
+Axis ordering matters for the physical network: axes later in the mesh tuple
+are "closer" (minor), so we order ("stage", "data", "model") by default —
+tensor-parallel collectives (the chattiest) ride the fastest ICI links, DP
+all-reduce amortizes over larger messages, and pipeline p2p (cheapest) can
+span DCN on multi-slice deployments.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+STAGE_AXIS = "stage"
+
+# Default major→minor ordering: pipeline outermost, tensor-parallel innermost.
+DEFAULT_AXIS_ORDER = (STAGE_AXIS, DATA_AXIS, MODEL_AXIS)
+
+_default_mesh: Optional[Mesh] = None
+
+
+def make_mesh(
+    axes: Mapping[str, int],
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_order: Sequence[str] = DEFAULT_AXIS_ORDER,
+) -> Mesh:
+    """Build a Mesh from ``{axis_name: size}``.
+
+    Sizes of -1 (at most one) are inferred from the device count. Axes listed
+    in ``axis_order`` are laid out in that major→minor order; unknown axes are
+    appended in insertion order.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    axes = dict(axes)
+
+    known = math.prod(s for s in axes.values() if s != -1)
+    infer = [k for k, s in axes.items() if s == -1]
+    if len(infer) > 1:
+        raise ValueError("at most one axis size may be -1")
+    if infer:
+        if len(devices) % known:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by fixed axes product {known}"
+            )
+        axes[infer[0]] = len(devices) // known
+
+    total = math.prod(axes.values())
+    if total > len(devices):
+        raise ValueError(f"mesh needs {total} devices, have {len(devices)}")
+    devices = devices[:total]
+
+    names = [a for a in axis_order if a in axes]
+    names += [a for a in axes if a not in names]
+    shape = tuple(axes[n] for n in names)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(names))
+
+
+def data_parallel_mesh(n: Optional[int] = None, **kw) -> Mesh:
+    return make_mesh({DATA_AXIS: -1 if n is None else n}, **kw)
+
+
+def cpu_devices(n: int) -> Sequence[jax.Device]:
+    """CPU devices for hermetic multi-device tests.
+
+    Requires ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` (set in
+    tests/conftest.py) — the JAX analog of the reference's spawn-based
+    MultiProcessTestCase harness (apex/transformer/testing/distributed_test_base.py).
+    """
+    devs = jax.devices("cpu")
+    if len(devs) < n:
+        raise RuntimeError(
+            f"need {n} CPU devices, have {len(devs)}; set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count"
+        )
+    return devs[:n]
+
+
+def cpu_mesh(axes: Mapping[str, int], **kw) -> Mesh:
+    n = math.prod(s for s in axes.values())
+    return make_mesh(axes, devices=cpu_devices(n), **kw)
+
+
+def set_default_mesh(mesh: Optional[Mesh]) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_default_mesh() -> Optional[Mesh]:
+    if _default_mesh is not None:
+        return _default_mesh
+    # Fall back to an ambient `with mesh:` context if one is active. There is
+    # no public accessor for the *physical* ambient mesh, so this uses the
+    # private thread_resources and degrades to None if jax moves it.
+    try:
+        from jax._src.mesh import thread_resources
+
+        m = thread_resources.env.physical_mesh
+        if m is not None and not m.empty:
+            return m
+    except Exception:
+        pass
+    return None
+
+
+@contextlib.contextmanager
+def default_mesh(mesh: Mesh):
+    prev = _default_mesh
+    set_default_mesh(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        set_default_mesh(prev)
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    return mesh.shape[name] if name in mesh.axis_names else 1
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,14 @@
+from apex_tpu.utils.pytree import (  # noqa: F401
+    tree_all_finite,
+    tree_cast,
+    tree_cast_where,
+    tree_global_norm,
+    tree_select,
+    tree_size,
+    tree_zeros_like,
+)
+from apex_tpu.utils.dtypes import (  # noqa: F401
+    canonical_half_dtype,
+    is_float,
+    default_half_dtype,
+)

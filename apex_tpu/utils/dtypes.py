@@ -1,0 +1,36 @@
+"""Dtype policy helpers.
+
+TPU-native half precision is bfloat16 (MXU-native, no loss scaling required in
+the common path); float16 is fully supported as well to preserve the
+reference's fp16 ladder (apex/amp opt levels were designed around fp16 +
+dynamic loss scaling, and the tests exercise both dtypes).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def default_half_dtype():
+    """bfloat16 — the TPU-native 16-bit dtype."""
+    return jnp.bfloat16
+
+
+def canonical_half_dtype(dtype_or_name):
+    """Accept 'float16'/'bfloat16'/jnp dtypes/None and canonicalize."""
+    if dtype_or_name is None:
+        return None
+    if isinstance(dtype_or_name, str):
+        name = dtype_or_name.lower()
+        if name in ("fp16", "float16", "half"):
+            return jnp.float16
+        if name in ("bf16", "bfloat16"):
+            return jnp.bfloat16
+        if name in ("fp32", "float32", "float"):
+            return jnp.float32
+        raise ValueError(f"unknown dtype name {dtype_or_name!r}")
+    return jnp.dtype(dtype_or_name)
+
+
+def is_float(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)

@@ -1,0 +1,113 @@
+"""Pytree utilities shared across the library.
+
+These replace the reference's flat-buffer helpers (``csrc/flatten_unflatten.cpp``
+``apex_C.flatten/unflatten``): under XLA there is no per-kernel launch overhead
+to amortize, so trees are operated on directly and the compiler fuses the maps.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def is_float(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def tree_cast(tree, dtype):
+    """Cast every floating leaf of ``tree`` to ``dtype`` (non-floats untouched)."""
+    if dtype is None:
+        return tree
+
+    def _cast(x):
+        x = jnp.asarray(x)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(_cast, tree)
+
+
+def tree_cast_where(tree, dtype, keep_fp32_predicate):
+    """Cast floating leaves to ``dtype`` except where the path predicate holds.
+
+    ``keep_fp32_predicate(path_str)`` receives a '/'-joined key path; leaves for
+    which it returns True stay float32. This implements the reference's
+    ``keep_batchnorm_fp32`` behavior (apex/amp/_initialize.py, O2 casts the
+    model to half but leaves BatchNorm parameters in fp32) by parameter path
+    rather than module type.
+    """
+    if dtype is None:
+        return tree
+
+    def _cast(path, x):
+        x = jnp.asarray(x)
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        if keep_fp32_predicate(path_str(path)):
+            return x.astype(jnp.float32)
+        return x.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(_cast, tree)
+
+
+def path_str(path) -> str:
+    """'/'-joined key path covering dict/sequence/attr-keyed pytree nodes."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):  # GetAttrKey
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k).strip("."))
+    return "/".join(parts)
+
+
+def tree_all_finite(tree):
+    """Scalar bool array: True iff every element of every floating leaf is finite.
+
+    The jit-compatible analog of the reference's inf/nan ``noop_flag`` produced
+    by ``csrc/multi_tensor_scale_kernel.cu``.
+    """
+    leaves = [x for x in jax.tree.leaves(tree) if is_float(x)]
+    if not leaves:
+        return jnp.bool_(True)
+    finite = [jnp.all(jnp.isfinite(x)) for x in leaves]
+    return jnp.stack(finite).all()
+
+
+def tree_global_norm(tree, *, per_leaf: bool = False):
+    """Global L2 norm over all floating leaves (fp32 accumulation).
+
+    Mirrors ``amp_C.multi_tensor_l2norm``: returns the global norm, and the
+    per-tensor norms too when ``per_leaf`` is set (used by LAMB trust ratios).
+    """
+    leaves = [jnp.asarray(x) for x in jax.tree.leaves(tree) if is_float(x)]
+    if not leaves:
+        zero = jnp.float32(0.0)
+        return (zero, []) if per_leaf else zero
+    sq = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves]
+    total = jnp.sqrt(jnp.stack(sq).sum())
+    if per_leaf:
+        return total, [jnp.sqrt(s) for s in sq]
+    return total
+
+
+def tree_select(pred, tree_true, tree_false):
+    """Elementwise tree select on a scalar predicate; used for step-skipping."""
+    return jax.tree.map(lambda t, f: jnp.where(pred, t, f), tree_true, tree_false)
+
+
+def tree_zeros_like(tree, dtype=None):
+    return jax.tree.map(
+        lambda x: jnp.zeros(jnp.shape(x), dtype or jnp.asarray(x).dtype), tree
+    )
+
+
+def tree_size(tree) -> int:
+    """Total number of elements across all leaves (python int, static)."""
+    return sum(int(jnp.size(x)) for x in jax.tree.leaves(tree))

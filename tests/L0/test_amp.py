@@ -1,0 +1,162 @@
+"""amp policy/autocast/initialize behavior — ref tests/L0/run_amp/
+test_basic_casts.py, test_promotion.py, test_checkpointing.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import amp
+
+
+def test_policy_presets():
+    o0 = amp.Policy.from_opt_level("O0")
+    assert o0.cast_model_type == jnp.float32 and not o0.master_weights
+    o1 = amp.Policy.from_opt_level("O1")
+    assert o1.patch_functions and o1.loss_scale == "dynamic"
+    o2 = amp.Policy.from_opt_level("O2")
+    assert o2.master_weights and o2.keep_batchnorm_fp32
+    o3 = amp.Policy.from_opt_level("O3")
+    assert o3.cast_model_type == jnp.bfloat16 and not o3.master_weights
+    # property override, like amp.initialize(..., loss_scale=128.0)
+    o2s = amp.Policy.from_opt_level("O2", loss_scale=128.0)
+    assert o2s.loss_scale == 128.0
+
+
+def test_policy_cast_keeps_batchnorm_fp32():
+    params = {
+        "Dense_0": {"kernel": jnp.ones((2, 2), jnp.float32)},
+        "BatchNorm_0": {"scale": jnp.ones((2,), jnp.float32)},
+    }
+    o2 = amp.Policy.from_opt_level("O2")
+    cast = o2.cast_params(params)
+    assert cast["Dense_0"]["kernel"].dtype == jnp.bfloat16
+    assert cast["BatchNorm_0"]["scale"].dtype == jnp.float32
+
+
+def test_autocast_low_precision_matmul():
+    policy = amp.Policy.from_opt_level("O1")
+    x = jnp.ones((4, 4), jnp.float32)
+    with amp.autocast(policy):
+        y = jnp.matmul(x, x)
+    assert y.dtype == jnp.bfloat16
+    # outside the context behavior is restored
+    y2 = jnp.matmul(x, x)
+    assert y2.dtype == jnp.float32
+
+
+def test_autocast_high_precision_softmax():
+    policy = amp.Policy.from_opt_level("O1", half_dtype="float16")
+    x = jnp.ones((4,), jnp.float16)
+    with amp.autocast(policy):
+        y = jax.nn.softmax(x)
+    assert y.dtype == jnp.float32
+
+
+def test_autocast_under_jit():
+    policy = amp.Policy.from_opt_level("O1")
+
+    def f(x):
+        return jnp.matmul(x, x)
+
+    with amp.autocast(policy):
+        y = jax.jit(f)(jnp.ones((4, 4), jnp.float32))
+    assert y.dtype == jnp.bfloat16
+
+
+def test_autocast_promotion():
+    policy = amp.Policy.from_opt_level("O1", half_dtype="float16")
+    a = jnp.ones((4,), jnp.float16)
+    b = jnp.ones((4,), jnp.float32)
+    with amp.autocast(policy):
+        c = jnp.add(a, b)
+    assert c.dtype == jnp.float32
+
+
+def test_disable_casts_region():
+    policy = amp.Policy.from_opt_level("O1")
+    x = jnp.ones((4, 4), jnp.float32)
+    with amp.autocast(policy):
+        with amp.disable_casts():
+            y = jnp.matmul(x, x)
+    assert y.dtype == jnp.float32
+
+
+def _tiny_model(params, x):
+    h = jnp.matmul(x, params["w1"])
+    h = jax.nn.relu(h)
+    return jnp.matmul(h, params["w2"])
+
+
+def _params():
+    k = jax.random.PRNGKey(0)
+    return {
+        "w1": jax.random.normal(k, (8, 16), jnp.float32) * 0.1,
+        "w2": jax.random.normal(k, (16, 4), jnp.float32) * 0.1,
+    }
+
+
+@pytest.mark.parametrize("opt_level", ["O0", "O1", "O2", "O3"])
+def test_initialize_and_train_step_all_opt_levels(opt_level):
+    params = _params()
+    model_fn, params, opt = amp.initialize(
+        _tiny_model, params, optax.sgd(0.1), opt_level=opt_level, verbosity=0
+    )
+    state = opt.init(params)
+    x = jnp.ones((2, 8), jnp.float32)
+
+    @jax.jit
+    def step(params, state):
+        def loss_fn(p):
+            out = model_fn(p, x)
+            loss = jnp.mean(jnp.square(out.astype(jnp.float32)))
+            return amp.scale_loss(loss, state)
+
+        grads = jax.grad(loss_fn)(params)
+        return opt.apply_gradients(grads, state, params)
+
+    p1, s1 = step(params, state)
+    p2, s2 = step(p1, s1)
+    # params moved
+    diff = jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))),
+        params, p2,
+    )
+    assert max(jax.tree.leaves(diff)) > 0
+
+    if opt_level == "O2":
+        assert s2.master is not None
+        assert s2.master["w1"].dtype == jnp.float32
+        assert p2["w1"].dtype == jnp.bfloat16
+
+
+def test_overflow_skips_step_and_backs_off_fp16():
+    params = {"w": jnp.ones((4,), jnp.float32)}
+
+    def model(p, x):
+        return p["w"] * x
+
+    model_fn, params, opt = amp.initialize(
+        model, params, optax.sgd(0.1), opt_level="O2",
+        half_dtype="float16", verbosity=0,
+    )
+    state = opt.init(params)
+    grads = {"w": jnp.array([jnp.inf, 1.0, 1.0, 1.0], jnp.float16)}
+    new_p, new_s = jax.jit(opt.apply_gradients)(grads, state, params)
+    np.testing.assert_allclose(
+        np.asarray(new_p["w"], np.float32), np.asarray(params["w"], np.float32)
+    )
+    assert float(new_s.scaler.scale) == 2.0 ** 15
+    assert int(new_s.skipped_steps) == 1
+
+
+def test_amp_state_dict_roundtrip():
+    params = _params()
+    _, params, opt = amp.initialize(
+        _tiny_model, params, optax.sgd(0.1), opt_level="O2", verbosity=0
+    )
+    state = opt.init(params)
+    d = amp.state_dict(opt, state)
+    state2 = amp.load_state_dict(opt, state, jax.tree.map(np.asarray, d))
+    assert float(state2.scaler.scale) == float(state.scaler.scale)

@@ -1,0 +1,33 @@
+"""Mesh helper tests (SPMD replacement for process groups)."""
+
+import pytest
+
+from apex_tpu.parallel import mesh as M
+
+
+def test_make_cpu_mesh(eight_cpu_devices):
+    m = M.cpu_mesh({"data": 2, "model": 4})
+    assert m.shape["data"] == 2 and m.shape["model"] == 4
+    assert M.axis_size(m, "data") == 2
+    assert M.axis_size(m, "absent") == 1
+
+
+def test_axis_order_default(eight_cpu_devices):
+    m = M.cpu_mesh({"model": 2, "data": 2, "stage": 2})
+    assert m.axis_names == ("stage", "data", "model")
+
+
+def test_infer_axis_size(eight_cpu_devices):
+    m = M.make_mesh({"data": -1, "model": 2}, devices=M.cpu_devices(8))
+    assert m.shape["data"] == 4
+
+
+def test_bad_sizes(eight_cpu_devices):
+    with pytest.raises(ValueError):
+        M.make_mesh({"data": 3, "model": -1}, devices=M.cpu_devices(8))
+
+
+def test_default_mesh_context(eight_cpu_devices):
+    m = M.cpu_mesh({"data": 8})
+    with M.default_mesh(m):
+        assert M.get_default_mesh() is m

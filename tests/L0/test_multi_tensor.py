@@ -1,0 +1,98 @@
+"""multi_tensor op parity — ref tests/L0/run_amp/test_multi_tensor_scale.py
+and the amp_C kernels (csrc/multi_tensor_*.cu)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.multi_tensor import (
+    multi_tensor_adam,
+    multi_tensor_applier,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+    multi_tensor_sgd,
+)
+
+F = jnp.bool_(False)
+
+
+def test_scale_basic_and_overflow():
+    xs = [jnp.ones((8,), jnp.float32) * 2, jnp.ones((3, 3), jnp.float16)]
+    outs, flag = multi_tensor_applier(multi_tensor_scale, F, [xs], 0.5)
+    np.testing.assert_allclose(np.asarray(outs[0]), 1.0)
+    np.testing.assert_allclose(np.asarray(outs[1], np.float32), 0.5)
+    assert not bool(flag)
+
+    xs_bad = [jnp.array([1.0, jnp.nan], jnp.float32)]
+    _, flag = multi_tensor_applier(multi_tensor_scale, F, [xs_bad], 1.0)
+    assert bool(flag)
+
+
+def test_axpby():
+    xs = [jnp.full((4,), 2.0)]
+    ys = [jnp.full((4,), 3.0)]
+    outs, flag = multi_tensor_axpby(F, [xs, ys], 2.0, -1.0)
+    np.testing.assert_allclose(np.asarray(outs[0]), 1.0)
+    assert not bool(flag)
+
+
+def test_l2norm_global_and_per_tensor():
+    xs = [jnp.full((4,), 2.0), jnp.full((9,), 1.0)]
+    total = multi_tensor_l2norm(F, [xs])
+    np.testing.assert_allclose(float(total), np.sqrt(16.0 + 9.0), rtol=1e-6)
+    total, per = multi_tensor_l2norm(F, [xs], per_tensor=True)
+    np.testing.assert_allclose(np.asarray(per), [4.0, 3.0], rtol=1e-6)
+
+
+def _ref_adam(p, g, m, v, step, lr, b1, b2, eps, wd, adamw):
+    if not adamw:
+        g = g + wd * p
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    mhat = m / (1 - b1 ** step)
+    vhat = v / (1 - b2 ** step)
+    upd = mhat / (np.sqrt(vhat) + eps)
+    if adamw:
+        upd = upd + wd * p
+    return p - lr * upd, m, v
+
+
+def test_adam_parity_with_numpy_ref():
+    rng = np.random.RandomState(0)
+    p = rng.randn(16).astype(np.float32)
+    g = rng.randn(16).astype(np.float32)
+    m = np.zeros(16, np.float32)
+    v = np.zeros(16, np.float32)
+    for mode, adamw in ((0, False), (1, True)):
+        new_p, new_m, new_v, _ = multi_tensor_adam(
+            F,
+            [[jnp.asarray(g)], [jnp.asarray(p)], [jnp.asarray(m)], [jnp.asarray(v)]],
+            1e-3, 0.9, 0.999, 1e-8, 1, mode, True, 0.01,
+        )
+        rp, rm, rv = _ref_adam(p, g, m, v, 1, 1e-3, 0.9, 0.999, 1e-8, 0.01, adamw)
+        np.testing.assert_allclose(np.asarray(new_p[0]), rp, rtol=1e-4, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(new_m[0]), rm, rtol=1e-4, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(new_v[0]), rv, rtol=1e-4, atol=1e-7)
+
+
+def test_adam_skips_on_flag():
+    p = [jnp.ones((4,))]
+    g = [jnp.ones((4,))]
+    m = [jnp.zeros((4,))]
+    v = [jnp.zeros((4,))]
+    new_p, *_ = multi_tensor_adam(
+        jnp.bool_(True), [g, p, m, v], 1e-3, 0.9, 0.999, 1e-8, 1, 1, True, 0.0
+    )
+    np.testing.assert_allclose(np.asarray(new_p[0]), 1.0)
+
+
+def test_sgd_momentum():
+    p = [jnp.zeros((4,))]
+    g = [jnp.ones((4,))]
+    b = [jnp.zeros((4,))]
+    # first_run initializes buffer to grad
+    new_p, new_b, _ = multi_tensor_sgd(
+        F, [g, p, b], 0.0, 0.9, 0.0, 0.1, False, True, False
+    )
+    np.testing.assert_allclose(np.asarray(new_b[0]), 1.0)
+    np.testing.assert_allclose(np.asarray(new_p[0]), -0.1)

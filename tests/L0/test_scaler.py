@@ -1,0 +1,94 @@
+"""Loss scaler dynamics — ref tests/L0/run_amp/test_checkpointing.py and
+the LossScaler semantics in apex/amp/scaler.py (x2 every growth_interval
+clean steps, /2 on overflow, hysteresis from csrc/update_scale_hysteresis.cu)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.amp import LossScaler
+
+
+def test_init_defaults():
+    s = LossScaler()
+    st = s.init()
+    assert float(st.scale) == 2.0 ** 16
+
+
+def test_growth_after_interval():
+    s = LossScaler(growth_interval=4)
+    st = s.init()
+    for _ in range(3):
+        st = s.update(st, jnp.bool_(False))
+        assert float(st.scale) == 2.0 ** 16
+    st = s.update(st, jnp.bool_(False))
+    assert float(st.scale) == 2.0 ** 17
+    assert int(st.growth_tracker) == 0
+
+
+def test_backoff_on_overflow():
+    s = LossScaler()
+    st = s.init()
+    st = s.update(st, jnp.bool_(True))
+    assert float(st.scale) == 2.0 ** 15
+    # growth tracker resets
+    assert int(st.growth_tracker) == 0
+
+
+def test_hysteresis_absorbs_spikes():
+    s = LossScaler(hysteresis=2)
+    st = s.init()
+    st = s.update(st, jnp.bool_(True))   # first overflow absorbed
+    assert float(st.scale) == 2.0 ** 16
+    st = s.update(st, jnp.bool_(True))   # second triggers backoff
+    assert float(st.scale) == 2.0 ** 15
+
+
+def test_static_scaler_never_moves():
+    s = LossScaler.from_loss_scale(128.0)
+    st = s.init()
+    assert float(st.scale) == 128.0
+    st = s.update(st, jnp.bool_(True))
+    assert float(st.scale) == 128.0
+
+
+def test_unscale_and_overflow_detection():
+    s = LossScaler()
+    st = s.init()
+    grads = {"w": jnp.ones((4,), jnp.float16) * st.scale, "b": jnp.ones((2,), jnp.float32)}
+    g32, found = s.unscale(st, grads)
+    assert not bool(found)
+    np.testing.assert_allclose(np.asarray(g32["w"]), 1.0)
+    grads_bad = {"w": jnp.array([jnp.inf], jnp.float32), "b": jnp.ones((2,))}
+    _, found = s.unscale(st, grads_bad)
+    assert bool(found)
+
+
+def test_update_inside_jit_no_recompile():
+    s = LossScaler(growth_interval=2)
+    traces = []
+
+    @jax.jit
+    def step(st, flag):
+        traces.append(1)
+        return s.update(st, flag)
+
+    st = s.init()
+    st = step(st, jnp.bool_(False))
+    st = step(st, jnp.bool_(True))
+    st = step(st, jnp.bool_(False))
+    assert len(traces) == 1  # scale is traced, never a static constant
+
+
+def test_state_dict_roundtrip():
+    s = LossScaler()
+    st = s.init()
+    st = s.update(st, jnp.bool_(True))
+    d = s.state_dict(st)
+    st2 = s.load_state_dict(jax.tree.map(np.asarray, d))
+    assert float(st2.scale) == float(st.scale)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

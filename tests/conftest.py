@@ -1,0 +1,35 @@
+"""Test configuration: hermetic 8-device CPU mesh.
+
+The JAX analog of the reference's spawn-based MultiProcessTestCase harness
+(apex/transformer/testing/distributed_test_base.py): instead of spawning N
+NCCL processes, XLA exposes N host devices in ONE process, so every
+DP/TP/PP/SP test runs on any machine with no TPU.
+
+Note: this environment's sitecustomize imports jax at interpreter startup and
+latches JAX_PLATFORMS from the ambient env (which points at a remote TPU
+backend), so the env var alone is too late here — we must also update the jax
+config directly. XLA_FLAGS is read lazily at backend init, which has not
+happened yet when conftest runs.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_cpu_devices():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"expected >=8 CPU devices, got {len(devs)}"
+    return devs[:8]
