@@ -1,0 +1,6 @@
+from apex_tpu.fused_dense.fused_dense import (  # noqa: F401
+    FusedDense,
+    FusedDenseGeluDense,
+    fused_dense,
+    fused_dense_gelu_dense,
+)

@@ -1,0 +1,71 @@
+"""FusedDense / FusedDenseGeluDense — ref: apex/fused_dense/fused_dense.py
+(+ csrc/fused_dense_cuda.cu using cublasLt GELU_AUX epilogues).
+
+On TPU, bias and GELU epilogues fuse into the MXU matmul under XLA; the value
+of these wrappers is API parity with the reference while letting the compiler
+do the scheduling.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+try:
+    import flax.linen as nn
+
+    _HAVE_FLAX = True
+except ImportError:  # pragma: no cover
+    _HAVE_FLAX = False
+
+
+def fused_dense(x, kernel, bias=None):
+    """y = x @ kernel + bias (bias fused into the matmul epilogue by XLA)."""
+    y = x @ kernel
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def fused_dense_gelu_dense(x, kernel1, bias1, kernel2, bias2):
+    """linear+bias+gelu+linear+bias, the reference's cublasLt-epilogue chain.
+
+    GELU uses the tanh approximation, matching the reference's CUDA epilogue.
+    """
+    h = x @ kernel1 + bias1
+    h = jax.nn.gelu(h, approximate=True)
+    return h @ kernel2 + bias2
+
+
+if _HAVE_FLAX:
+
+    class FusedDense(nn.Module):
+        """Drop-in Dense with fused bias epilogue (ref: FusedDense)."""
+
+        features: int
+        use_bias: bool = True
+        dtype: object = jnp.float32
+
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(
+                self.features, use_bias=self.use_bias, dtype=self.dtype
+            )(x)
+
+    class FusedDenseGeluDense(nn.Module):
+        """linear+gelu+linear chain (ref: FusedDenseGeluDense)."""
+
+        intermediate_features: int
+        out_features: int
+        use_bias: bool = True
+        dtype: object = jnp.float32
+
+        @nn.compact
+        def __call__(self, x):
+            h = nn.Dense(
+                self.intermediate_features, use_bias=self.use_bias, dtype=self.dtype
+            )(x)
+            h = jax.nn.gelu(h, approximate=True)
+            return nn.Dense(
+                self.out_features, use_bias=self.use_bias, dtype=self.dtype
+            )(h)

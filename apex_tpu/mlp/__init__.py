@@ -1,0 +1,1 @@
+from apex_tpu.mlp.mlp import MLP, mlp_apply, mlp_init  # noqa: F401

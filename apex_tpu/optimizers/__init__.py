@@ -16,3 +16,10 @@ from apex_tpu.optimizers.fused_novograd import (  # noqa: F401
     fused_novograd,
 )
 from apex_tpu.optimizers.fused_sgd import FusedSGDState, fused_sgd  # noqa: F401
+from apex_tpu.optimizers.stateful import (  # noqa: F401
+    FusedAdagrad,
+    FusedAdam,
+    FusedLAMB,
+    FusedNovoGrad,
+    FusedSGD,
+)

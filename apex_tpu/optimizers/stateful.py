@@ -1,0 +1,102 @@
+"""Apex-style stateful optimizer classes.
+
+Ref: apex/optimizers/fused_adam.py::FusedAdam etc. — the reference API is
+``opt = FusedAdam(model.parameters(), lr=...); opt.step()``. The functional
+optax transforms in this package are the core; these classes are a thin
+host-side veneer that owns (params, opt_state) and jits the update, for
+users migrating reference scripts. New code should prefer the functional
+API (``apex_tpu.optimizers.fused_adam`` + their own train step).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import optax
+
+from apex_tpu.optimizers.fused_adagrad import fused_adagrad
+from apex_tpu.optimizers.fused_adam import fused_adam
+from apex_tpu.optimizers.fused_lamb import fused_lamb
+from apex_tpu.optimizers.fused_novograd import fused_novograd
+from apex_tpu.optimizers.fused_sgd import fused_sgd
+
+
+class _StatefulOptimizer:
+    """Owns params + optax state; ``step(grads)`` applies one fused update."""
+
+    def __init__(self, params, tx: optax.GradientTransformation):
+        self._tx = tx
+        self.params = params
+        self.state = tx.init(params)
+
+        @jax.jit
+        def _step(params, state, grads):
+            updates, new_state = tx.update(grads, state, params)
+            return optax.apply_updates(params, updates), new_state
+
+        self._step = _step
+
+    def step(self, grads):
+        """Apply one update from ``grads`` (a pytree matching params)."""
+        self.params, self.state = self._step(self.params, self.state, grads)
+        return self.params
+
+    def zero_grad(self):
+        """No-op: JAX gradients are values, not accumulated buffers."""
+
+    @property
+    def tx(self) -> optax.GradientTransformation:
+        """The underlying optax transformation, for functional use."""
+        return self._tx
+
+    def state_dict(self) -> dict:
+        return {"state": self.state, "params": self.params}
+
+    def load_state_dict(self, d: dict) -> None:
+        self.state = d["state"]
+        self.params = d["params"]
+
+
+def _translate_apex_kwargs(kwargs: dict) -> dict:
+    """Map reference constructor argument names onto the factory names:
+    ``lr`` → ``learning_rate``, ``betas=(b1, b2)`` → ``b1``/``b2``."""
+    kwargs = dict(kwargs)
+    if "lr" in kwargs:
+        kwargs["learning_rate"] = kwargs.pop("lr")
+    if "betas" in kwargs:
+        b1, b2 = kwargs.pop("betas")
+        kwargs["b1"], kwargs["b2"] = b1, b2
+    return kwargs
+
+
+def _make_class(name: str, factory: Callable[..., Any], doc: str):
+    class _Opt(_StatefulOptimizer):
+        def __init__(self, params, **kwargs):
+            super().__init__(params, factory(**_translate_apex_kwargs(kwargs)))
+
+    _Opt.__name__ = _Opt.__qualname__ = name
+    _Opt.__doc__ = doc
+    return _Opt
+
+
+FusedAdam = _make_class(
+    "FusedAdam", fused_adam,
+    "Stateful Adam/AdamW (ref: apex/optimizers/fused_adam.py::FusedAdam).",
+)
+FusedLAMB = _make_class(
+    "FusedLAMB", fused_lamb,
+    "Stateful LAMB (ref: apex/optimizers/fused_lamb.py::FusedLAMB).",
+)
+FusedSGD = _make_class(
+    "FusedSGD", fused_sgd,
+    "Stateful momentum SGD (ref: apex/optimizers/fused_sgd.py::FusedSGD).",
+)
+FusedNovoGrad = _make_class(
+    "FusedNovoGrad", fused_novograd,
+    "Stateful NovoGrad (ref: apex/optimizers/fused_novograd.py::FusedNovoGrad).",
+)
+FusedAdagrad = _make_class(
+    "FusedAdagrad", fused_adagrad,
+    "Stateful Adagrad (ref: apex/optimizers/fused_adagrad.py::FusedAdagrad).",
+)

@@ -1,0 +1,109 @@
+"""Benchmark entry point — prints ONE JSON line for the driver.
+
+Round-1 flagship: MLP amp-O2 train step, samples/sec/chip + MFU estimate
+(BASELINE config 1). Will be upgraded to the BERT-large north star
+(amp O2 + FusedLAMB, BASELINE config 3) as milestones land.
+
+``vs_baseline``: the reference publishes no in-repo numbers
+(BASELINE.md: "published": {}); the operational target is >=50% MFU
+(BASELINE.json north star), so vs_baseline reports measured_MFU / 0.50.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+if os.environ.get("BENCH_CPU") == "1":  # debug escape hatch
+    jax.config.update("jax_platforms", "cpu")
+
+
+# Peak bf16 matmul throughput per chip by device_kind substring.
+# v5e reports device_kind "TPU v5 lite" -> normalized "tpuv5lite".
+PEAK_FLOPS = (
+    ("v5lite", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6", 918e12),
+    ("v4", 275e12),
+    ("cpu", 1e12),  # nominal, only for the debug path
+)
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower().replace(" ", "")
+    for k, v in PEAK_FLOPS:
+        if k in kind:
+            return v
+    print(f"bench: unknown device_kind {kind!r}; assuming v5e peak", file=sys.stderr)
+    return 197e12
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from apex_tpu import amp
+    from apex_tpu.mlp import mlp_apply, mlp_init
+    from apex_tpu.optimizers import fused_adam
+
+    dev = jax.devices()[0]
+
+    batch, din, dh, dout = 8192, 784, 4096, 10
+    params = mlp_init(jax.random.PRNGKey(0), (din, dh, dout))
+    model_fn, params, opt = amp.initialize(
+        mlp_apply, params, fused_adam(1e-3), opt_level="O2", verbosity=0
+    )
+    state = opt.init(params)
+    x = jax.random.uniform(jax.random.PRNGKey(1), (batch, din), jnp.float32)
+    y = jnp.zeros((batch,), jnp.int32)
+
+    @jax.jit
+    def step(params, state, xb, yb):
+        def loss_fn(p):
+            logits = model_fn(p, xb).astype(jnp.float32)
+            loss = -jnp.mean(
+                jax.nn.log_softmax(logits)[jnp.arange(yb.shape[0]), yb]
+            )
+            return amp.scale_loss(loss, state)
+
+        grads = jax.grad(loss_fn)(params)
+        return opt.apply_gradients(grads, state, params)
+
+    # warmup/compile
+    params, state = step(params, state, x, y)
+    jax.block_until_ready(params)
+
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, state = step(params, state, x, y)
+    jax.block_until_ready(params)
+    dt = (time.perf_counter() - t0) / iters
+
+    samples_per_sec = batch / dt
+    # fwd+bwd matmul FLOPs: 3 GEMM passes x 2 layers x 2*m*n*k
+    flops = 3 * 2 * (batch * din * dh + batch * dh * dout)
+    mfu = flops / dt / peak_flops(dev)
+
+    print(
+        json.dumps(
+            {
+                "metric": "mlp_amp_o2_fused_adam_samples_per_sec_per_chip",
+                "value": round(samples_per_sec, 1),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(mfu / 0.50, 4),
+                "detail": {
+                    "mfu": round(mfu, 4),
+                    "step_ms": round(dt * 1e3, 3),
+                    "device": str(dev),
+                    "batch": batch,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""BASELINE config 1 end-to-end: 2-layer MLP on MNIST-shaped data,
+amp O1 + FusedAdam, single process.
+
+Ref pattern: tests/L1/ cross-product integration (main_amp.py + compare.py):
+loss trajectories across opt levels must track the fp32 reference within
+tolerance. MNIST itself is not downloadable here (zero egress), so a fixed
+synthetic teacher task with MNIST shapes (784 -> 10) stands in; the
+capability exercised (policy casting, autocast, dynamic scaler, fused
+optimizer, jit train loop) is identical.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.mlp import mlp_apply, mlp_init
+from apex_tpu.optimizers import fused_adam
+
+
+def _data(n=256):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(42))
+    x = jax.random.uniform(k1, (n, 784), jnp.float32)
+    w_teacher = jax.random.normal(k2, (784, 10), jnp.float32)
+    y = jnp.argmax(x @ w_teacher, axis=-1)
+    return x, y
+
+
+def _train(opt_level, steps=30, half_dtype=None, seed=0):
+    params = mlp_init(jax.random.PRNGKey(seed), (784, 128, 10))
+    x, y = _data()
+
+    def model(p, xb):
+        return mlp_apply(p, xb)
+
+    model_fn, params, opt = amp.initialize(
+        model, params, fused_adam(1e-3), opt_level=opt_level,
+        half_dtype=half_dtype, verbosity=0,
+    )
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state, xb, yb):
+        def loss_fn(p):
+            logits = model_fn(p, xb).astype(jnp.float32)
+            loss = -jnp.mean(
+                jax.nn.log_softmax(logits)[jnp.arange(yb.shape[0]), yb]
+            )
+            return amp.scale_loss(loss, state), loss
+
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        new_p, new_s = opt.apply_gradients(grads, state, params)
+        return new_p, new_s, loss
+
+    losses = []
+    for _ in range(steps):
+        params, state, loss = step(params, state, x, y)
+        losses.append(float(loss))
+    return np.array(losses), params, state
+
+
+def test_mnist_mlp_o1_fused_adam_learns():
+    losses, _, state = _train("O1")
+    assert losses[-1] < losses[0] * 0.7, losses
+    assert int(state.skipped_steps) == 0
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2", "O3"])
+def test_loss_trajectory_tracks_fp32_reference(opt_level):
+    """compare.py analog: mixed-precision loss must track O0 within tol."""
+    ref, _, _ = _train("O0")
+    got, _, _ = _train(opt_level)
+    # bf16 forward: generous tolerance, trajectory-level agreement
+    np.testing.assert_allclose(got, ref, rtol=0.15, atol=0.05)
+
+
+def test_o2_fp16_with_scaler_learns():
+    losses, params, state = _train("O2", half_dtype="float16")
+    assert losses[-1] < losses[0] * 0.7
+    # master weights fp32, model params fp16
+    assert state.master["layer_0"]["kernel"].dtype == jnp.float32
+    assert params["layer_0"]["kernel"].dtype == jnp.float16
