@@ -1,0 +1,96 @@
+"""FP16_Optimizer — ref: apex/fp16_utils/fp16_optimizer.py.
+
+The pre-amp master-weight wrapper (``backward(loss)`` + ``step()`` with
+static or dynamic loss scale). Aliased onto the amp engine: this class wraps
+an apex_tpu stateful optimizer with an :class:`apex_tpu.amp.AmpOptimizer`
+configured for O2-style master weights.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.frontend import AmpOptimizer
+from apex_tpu.amp.policy import Policy
+from apex_tpu.amp.scaler import LossScaler
+
+
+class FP16_Optimizer:
+    """Legacy API: ``opt = FP16_Optimizer(inner, static_loss_scale=128)``;
+    ``scaled = opt.scale_loss(loss)``; ``opt.step(grads)``.
+
+    ``inner`` is an apex_tpu stateful optimizer (e.g. ``FusedAdam``) holding
+    half params; this wrapper owns fp32 masters + the scaler.
+    """
+
+    def __init__(
+        self,
+        init_optimizer,
+        static_loss_scale=1.0,
+        dynamic_loss_scale=False,
+        dynamic_loss_args=None,
+        verbose=False,
+    ):
+        self.inner = init_optimizer
+        if dynamic_loss_scale:
+            # translate legacy kwarg names (scale_factor/scale_window) onto
+            # the engine's (growth_factor, backoff_factor, growth_interval)
+            legacy = dict(dynamic_loss_args or {})
+            kwargs = {}
+            if "init_scale" in legacy:
+                kwargs["init_scale"] = float(legacy.pop("init_scale"))
+            if "scale_factor" in legacy:
+                f = float(legacy.pop("scale_factor"))
+                kwargs["growth_factor"] = f
+                kwargs["backoff_factor"] = 1.0 / f
+            if "scale_window" in legacy:
+                kwargs["growth_interval"] = int(legacy.pop("scale_window"))
+            kwargs.update(legacy)  # engine-native names pass through
+            scaler = LossScaler(dynamic=True, **kwargs)
+            loss_scale = "dynamic"
+        else:
+            scaler = LossScaler(init_scale=float(static_loss_scale), dynamic=False)
+            loss_scale = float(static_loss_scale)
+        policy = Policy.from_opt_level("O2", loss_scale=loss_scale)
+        self._amp = AmpOptimizer(tx=init_optimizer.tx, policy=policy, scaler=scaler)
+        self.state = self._amp.init(self.inner.params)
+        if verbose:
+            print(f"FP16_Optimizer: loss_scale={loss_scale}")
+
+        @jax.jit
+        def _apply(grads, state, params):
+            return self._amp.apply_gradients(grads, state, params)
+
+        self._apply = _apply
+
+    @property
+    def loss_scale(self):
+        return float(self.state.scaler.scale)
+
+    def scale_loss(self, loss):
+        return (loss.astype(jnp.float32) * self.state.scaler.scale).astype(loss.dtype)
+
+    # legacy name: backward(loss) computed grads; functional JAX computes
+    # grads outside, so step takes them directly.
+    def step(self, grads):
+        self.inner.params, self.state = self._apply(
+            grads, self.state, self.inner.params
+        )
+        return self.inner.params
+
+    def zero_grad(self):
+        pass
+
+    def state_dict(self):
+        """Full resume state: fp32 masters, live inner optax state, scaler,
+        and the half params (ref FP16_Optimizer.state_dict saves the same
+        set: optimizer state + fp32_from_fp16 groups + scaler fields)."""
+        return {
+            "amp_state": self.state,          # AmpOptState: inner/master/scaler
+            "params": self.inner.params,      # half model params
+        }
+
+    def load_state_dict(self, d):
+        self.state = d["amp_state"]
+        self.inner.params = d["params"]

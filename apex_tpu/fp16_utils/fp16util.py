@@ -1,0 +1,53 @@
+"""Legacy fp16 helpers — ref: apex/fp16_utils/fp16util.py.
+
+These pre-amp utilities are aliases over the single master-weights engine
+(SURVEY.md §3.3: "provide ONE master-weights engine and alias both API styles
+onto it"). Trees replace module/parameter lists.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.policy import default_keep_fp32_predicate
+from apex_tpu.utils.pytree import path_str, tree_cast, tree_cast_where
+
+
+def network_to_half(params, half_dtype=jnp.float16):
+    """Cast floating params to half, keeping batchnorm-looking leaves fp32
+    (ref: network_to_half + BN_convert_float)."""
+    return tree_cast_where(params, half_dtype, default_keep_fp32_predicate)
+
+
+def BN_convert_float(params):
+    """Force batchnorm-looking leaves back to fp32 (ref: BN_convert_float)."""
+
+    def _conv(path, x):
+        x = jnp.asarray(x)
+        if jnp.issubdtype(x.dtype, jnp.floating) and default_keep_fp32_predicate(
+            path_str(path)
+        ):
+            return x.astype(jnp.float32)
+        return x
+
+    return jax.tree_util.tree_map_with_path(_conv, params)
+
+
+def prep_param_lists(params):
+    """Returns (model_params, master_params): the fp32 master copy of a half
+    tree (ref: prep_param_lists, flat_master unsupported — XLA has no use for
+    a flat buffer)."""
+    return params, tree_cast(params, jnp.float32)
+
+
+def master_params_to_model_params(model_params, master_params):
+    """Cast master values into the model tree's dtypes (ref name preserved)."""
+    return jax.tree.map(
+        lambda p, m: m.astype(jnp.asarray(p).dtype), model_params, master_params
+    )
+
+
+def model_grads_to_master_grads(model_grads):
+    """Upcast half grads to fp32 masters (ref name preserved)."""
+    return tree_cast(model_grads, jnp.float32)

@@ -1,0 +1,56 @@
+"""Legacy loss scalers — ref: apex/fp16_utils/loss_scaler.py.
+
+Aliases onto the single scaler engine (apex_tpu.amp.scaler): ``LossScaler``
+is the static variant, ``DynamicLossScaler`` the dynamic one, with the
+legacy attribute names preserved.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.amp.scaler import LossScaler as _Engine
+from apex_tpu.utils.pytree import tree_all_finite
+
+
+class LossScaler:
+    """Static loss scaler (legacy API: .loss_scale, .scale_gradient)."""
+
+    def __init__(self, scale=1.0):
+        self._engine = _Engine(init_scale=float(scale), dynamic=False)
+        self.state = self._engine.init()
+
+    @property
+    def loss_scale(self):
+        return float(self.state.scale)
+
+    def scale_loss(self, loss):
+        return self._engine.scale_loss(self.state, loss)
+
+    def unscale(self, grads):
+        g32, _ = self._engine.unscale(self.state, grads)
+        return g32
+
+    @staticmethod
+    def has_inf_or_nan(tree) -> bool:
+        return not bool(tree_all_finite(tree))
+
+    def update_scale(self, overflow: bool) -> None:
+        pass  # static
+
+
+class DynamicLossScaler(LossScaler):
+    """Dynamic loss scaler (legacy API; 2x growth / 0.5x backoff)."""
+
+    def __init__(self, init_scale=2.0 ** 32, scale_factor=2.0, scale_window=1000):
+        self._engine = _Engine(
+            init_scale=float(init_scale),
+            growth_factor=float(scale_factor),
+            backoff_factor=1.0 / float(scale_factor),
+            growth_interval=int(scale_window),
+            dynamic=True,
+        )
+        self.state = self._engine.init()
+
+    def update_scale(self, overflow: bool) -> None:
+        import jax.numpy as jnp
+
+        self.state = self._engine.update(self.state, jnp.bool_(overflow))

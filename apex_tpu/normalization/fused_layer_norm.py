@@ -1,0 +1,122 @@
+"""FusedLayerNorm / FusedRMSNorm modules.
+
+Ref: apex/normalization/fused_layer_norm.py — drop-in nn.LayerNorm/RMSNorm
+replacements with elementwise-affine and no-affine paths, mixed-dtype
+variants (params fp32 while activations are bf16/fp16 — the Megatron
+pattern), and a ``memory_efficient`` flag.
+
+On TPU the kernel is ``apex_tpu.ops.layer_norm`` (Pallas fwd/bwd, fp32
+accumulation). ``memory_efficient=True`` maps to ``jax.checkpoint`` around
+the op: residuals are dropped and recomputed in backward — the XLA-idiomatic
+equivalent of the reference's recompute-free-bwd-from-output trick.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.layer_norm import layer_norm, rms_norm
+
+try:
+    import flax.linen as nn
+
+    _HAVE_FLAX = True
+except ImportError:  # pragma: no cover
+    _HAVE_FLAX = False
+
+
+def _norm_shape(normalized_shape) -> int:
+    if isinstance(normalized_shape, int):
+        return normalized_shape
+    shape = tuple(normalized_shape)
+    if len(shape) != 1:
+        raise NotImplementedError(
+            "apex_tpu normalizes over the last axis; pass the hidden size"
+        )
+    return shape[0]
+
+
+def fused_layer_norm(
+    x,
+    weight=None,
+    bias=None,
+    eps: float = 1e-5,
+    memory_efficient: bool = False,
+):
+    """Functional fused LayerNorm (ref: fused_layer_norm / FusedLayerNormFunction)."""
+    fn = functools.partial(layer_norm, eps=eps)
+    if memory_efficient:
+        fn = jax.checkpoint(fn)
+    return fn(x, weight, bias)
+
+
+def fused_rms_norm(x, weight=None, eps: float = 1e-5, memory_efficient: bool = False):
+    fn = functools.partial(rms_norm, eps=eps)
+    if memory_efficient:
+        fn = jax.checkpoint(fn)
+    return fn(x, weight)
+
+
+if _HAVE_FLAX:
+
+    class FusedLayerNorm(nn.Module):
+        """Drop-in LayerNorm over the last axis (ref: FusedLayerNorm).
+
+        ``elementwise_affine=False`` gives the no-affine path. ``params_dtype``
+        fp32 + bf16 inputs reproduces MixedFusedLayerNorm.
+        """
+
+        normalized_shape: Union[int, Sequence[int]]
+        eps: float = 1e-5
+        elementwise_affine: bool = True
+        memory_efficient: bool = False
+        params_dtype: object = jnp.float32
+
+        @nn.compact
+        def __call__(self, x):
+            h = _norm_shape(self.normalized_shape)
+            if self.elementwise_affine:
+                weight = self.param(
+                    "scale", nn.initializers.ones, (h,), self.params_dtype
+                )
+                bias = self.param(
+                    "bias", nn.initializers.zeros, (h,), self.params_dtype
+                )
+            else:
+                weight = bias = None
+            return fused_layer_norm(
+                x, weight, bias, self.eps, self.memory_efficient
+            )
+
+    class FusedRMSNorm(nn.Module):
+        """Drop-in RMSNorm (ref: FusedRMSNorm)."""
+
+        normalized_shape: Union[int, Sequence[int]]
+        eps: float = 1e-5
+        elementwise_affine: bool = True
+        memory_efficient: bool = False
+        params_dtype: object = jnp.float32
+
+        @nn.compact
+        def __call__(self, x):
+            h = _norm_shape(self.normalized_shape)
+            weight = (
+                self.param("scale", nn.initializers.ones, (h,), self.params_dtype)
+                if self.elementwise_affine
+                else None
+            )
+            return fused_rms_norm(x, weight, self.eps, self.memory_efficient)
+
+    class MixedFusedLayerNorm(FusedLayerNorm):
+        """Params stay fp32 while activations are half (ref: MixedFusedLayerNorm).
+
+        Identical to FusedLayerNorm with params_dtype=fp32 (the default) —
+        kept as a named class for reference-script parity.
+        """
+
+    class MixedFusedRMSNorm(FusedRMSNorm):
+        """fp32-params RMSNorm (ref: MixedFusedRMSNorm)."""

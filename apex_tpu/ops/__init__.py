@@ -6,3 +6,17 @@ through ``jax.custom_vjp``. See SURVEY.md §3.13 for the kernel roll-up.
 """
 
 from apex_tpu.ops import optim  # noqa: F401
+from apex_tpu.ops.layer_norm import (  # noqa: F401
+    layer_norm,
+    layer_norm_affine,
+    rms_norm,
+    rms_norm_affine,
+)
+from apex_tpu.ops.softmax import (  # noqa: F401
+    generic_scaled_masked_softmax,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.ops.xentropy import softmax_cross_entropy  # noqa: F401
+from apex_tpu.ops.rope import apply_rope, rope_frequencies  # noqa: F401

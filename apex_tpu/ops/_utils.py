@@ -1,0 +1,32 @@
+"""Shared helpers for the kernel layer."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def pallas_interpret() -> bool:
+    """Run Pallas kernels in interpret mode off-TPU (CPU tests) unless
+    explicitly overridden via APEX_TPU_PALLAS_INTERPRET."""
+    env = os.environ.get("APEX_TPU_PALLAS_INTERPRET")
+    if env is not None:
+        return env == "1"
+    return not on_tpu()
+
+
+def default_use_pallas() -> bool:
+    """Pallas kernels are the default on TPU; jnp reference elsewhere.
+    Override with APEX_TPU_USE_PALLAS=0/1."""
+    env = os.environ.get("APEX_TPU_USE_PALLAS")
+    if env is not None:
+        return env == "1"
+    return on_tpu()

@@ -1,0 +1,356 @@
+"""LayerNorm / RMSNorm kernels — Pallas fwd+bwd with jnp oracle.
+
+Ref: csrc/layer_norm_cuda_kernel.cu (Welford row statistics, fp32
+accumulation for half/bf16 inputs, two-stage gamma/beta gradient reduction)
+and apex/normalization/fused_layer_norm.py's autograd Functions.
+
+TPU design: rows are blocked onto the grid, each block normalizes in VMEM
+with fp32 math (one pass: mean + centered variance — Welford's streaming
+update exists to avoid a second pass over *global* memory, which a VMEM-
+resident block doesn't need). The backward emits per-block partial
+dgamma/dbeta (the analog of the reference's two-stage reduction) which are
+summed outside the kernel. Mixed-dtype (fp32 params, bf16 activations) is
+native: params are upcast in-kernel and the output takes x.dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from apex_tpu.ops._utils import default_use_pallas, pallas_interpret
+
+_BLOCK_ROWS = 256
+
+
+# ---------------------------------------------------------------------------
+# jnp reference implementations (oracle + fallback)
+# ---------------------------------------------------------------------------
+
+def _ln_fwd_ref(x, gamma, beta, eps):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    xc = x32 - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xc * rstd
+    y = xhat
+    if gamma is not None:
+        y = y * gamma.astype(jnp.float32)
+    if beta is not None:
+        y = y + beta.astype(jnp.float32)
+    return y.astype(x.dtype), mean, rstd
+
+
+def _ln_bwd_ref(x, gamma, mean, rstd, dy):
+    x32 = x.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    xhat = (x32 - mean) * rstd
+    dxhat = dy32 if gamma is None else dy32 * gamma.astype(jnp.float32)
+    mean_dxhat = jnp.mean(dxhat, axis=-1, keepdims=True)
+    mean_dxhat_xhat = jnp.mean(dxhat * xhat, axis=-1, keepdims=True)
+    dx = (rstd * (dxhat - mean_dxhat - xhat * mean_dxhat_xhat)).astype(x.dtype)
+    reduce_axes = tuple(range(x.ndim - 1))
+    dgamma = jnp.sum(dy32 * xhat, axis=reduce_axes) if gamma is not None else None
+    dbeta = jnp.sum(dy32, axis=reduce_axes) if gamma is not None else None
+    return dx, dgamma, dbeta
+
+
+def _rms_fwd_ref(x, gamma, eps):
+    x32 = x.astype(jnp.float32)
+    ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    y = x32 * rstd
+    if gamma is not None:
+        y = y * gamma.astype(jnp.float32)
+    return y.astype(x.dtype), rstd
+
+
+def _rms_bwd_ref(x, gamma, rstd, dy):
+    x32 = x.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    xhat = x32 * rstd
+    dxhat = dy32 if gamma is None else dy32 * gamma.astype(jnp.float32)
+    mean_dxhat_xhat = jnp.mean(dxhat * xhat, axis=-1, keepdims=True)
+    dx = (rstd * (dxhat - xhat * mean_dxhat_xhat)).astype(x.dtype)
+    reduce_axes = tuple(range(x.ndim - 1))
+    dgamma = jnp.sum(dy32 * xhat, axis=reduce_axes) if gamma is not None else None
+    return dx, dgamma
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels (2-D row-major view: (rows, hidden))
+# ---------------------------------------------------------------------------
+
+def _ln_fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    mean = jnp.mean(x, axis=1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = xc * rstd * g_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+    y_ref[:] = y.astype(y_ref.dtype)
+    mean_ref[:] = mean
+    rstd_ref[:] = rstd
+
+
+def _ln_bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
+                   dx_ref, dg_ref, db_ref):
+    x = x_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
+    mean, rstd = mean_ref[:], rstd_ref[:]
+    xhat = (x - mean) * rstd
+    dxhat = dy * g_ref[:].astype(jnp.float32)
+    mean_dxhat = jnp.mean(dxhat, axis=1, keepdims=True)
+    mean_dxhat_xhat = jnp.mean(dxhat * xhat, axis=1, keepdims=True)
+    dx_ref[:] = (rstd * (dxhat - mean_dxhat - xhat * mean_dxhat_xhat)).astype(
+        dx_ref.dtype
+    )
+    # per-block partial reductions (stage 1 of the two-stage reduction)
+    dg_ref[:] = jnp.sum(dy * xhat, axis=0, keepdims=True)
+    db_ref[:] = jnp.sum(dy, axis=0, keepdims=True)
+
+
+def _rms_fwd_kernel(x_ref, g_ref, y_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    y_ref[:] = (x * rstd * g_ref[:].astype(jnp.float32)).astype(y_ref.dtype)
+    rstd_ref[:] = rstd
+
+
+def _rms_bwd_kernel(x_ref, g_ref, rstd_ref, dy_ref, dx_ref, dg_ref):
+    x = x_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
+    rstd = rstd_ref[:]
+    xhat = x * rstd
+    dxhat = dy * g_ref[:].astype(jnp.float32)
+    mean_dxhat_xhat = jnp.mean(dxhat * xhat, axis=1, keepdims=True)
+    dx_ref[:] = (rstd * (dxhat - xhat * mean_dxhat_xhat)).astype(dx_ref.dtype)
+    dg_ref[:] = jnp.sum(dy * xhat, axis=0, keepdims=True)
+
+
+def _pad_rows(x2, block):
+    r = x2.shape[0]
+    pad = (-r) % block
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    return x2, r
+
+
+def _ln_fwd_pallas(x, gamma, beta, eps):
+    h = x.shape[-1]
+    x2, rows = _pad_rows(x.reshape(-1, h), _BLOCK_ROWS)
+    rp = x2.shape[0]
+    grid = rp // _BLOCK_ROWS
+    g2 = gamma.reshape(1, h)
+    b2 = beta.reshape(1, h)
+    y, mean, rstd = pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rp, h), x.dtype),
+            jax.ShapeDtypeStruct((rp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rp, 1), jnp.float32),
+        ],
+        interpret=pallas_interpret(),
+    )(x2, g2, b2)
+    y = y[:rows].reshape(x.shape)
+    return y, mean[:rows], rstd[:rows]
+
+
+def _ln_bwd_pallas(x, gamma, mean, rstd, dy):
+    h = x.shape[-1]
+    x2, rows = _pad_rows(x.reshape(-1, h), _BLOCK_ROWS)
+    dy2, _ = _pad_rows(dy.reshape(-1, h), _BLOCK_ROWS)
+    mean2, _ = _pad_rows(mean.reshape(-1, 1), _BLOCK_ROWS)
+    rstd2, _ = _pad_rows(rstd.reshape(-1, 1), _BLOCK_ROWS)
+    rp = x2.shape[0]
+    grid = rp // _BLOCK_ROWS
+    g2 = gamma.reshape(1, h)
+    dx, dg_part, db_part = pl.pallas_call(
+        _ln_bwd_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rp, h), x.dtype),
+            jax.ShapeDtypeStruct((grid, h), jnp.float32),
+            jax.ShapeDtypeStruct((grid, h), jnp.float32),
+        ],
+        interpret=pallas_interpret(),
+    )(x2, g2, mean2, rstd2, dy2)
+    dx = dx[:rows].reshape(x.shape)
+    # stage 2: combine per-block partials
+    dgamma = dg_part.sum(axis=0).astype(gamma.dtype)
+    dbeta = db_part.sum(axis=0).astype(gamma.dtype)
+    return dx, dgamma, dbeta
+
+
+def _rms_fwd_pallas(x, gamma, eps):
+    h = x.shape[-1]
+    x2, rows = _pad_rows(x.reshape(-1, h), _BLOCK_ROWS)
+    rp = x2.shape[0]
+    grid = rp // _BLOCK_ROWS
+    y, rstd = pl.pallas_call(
+        functools.partial(_rms_fwd_kernel, eps=eps),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rp, h), x.dtype),
+            jax.ShapeDtypeStruct((rp, 1), jnp.float32),
+        ],
+        interpret=pallas_interpret(),
+    )(x2, gamma.reshape(1, h))
+    return y[:rows].reshape(x.shape), rstd[:rows]
+
+
+def _rms_bwd_pallas(x, gamma, rstd, dy):
+    h = x.shape[-1]
+    x2, rows = _pad_rows(x.reshape(-1, h), _BLOCK_ROWS)
+    dy2, _ = _pad_rows(dy.reshape(-1, h), _BLOCK_ROWS)
+    rstd2, _ = _pad_rows(rstd.reshape(-1, 1), _BLOCK_ROWS)
+    rp = x2.shape[0]
+    grid = rp // _BLOCK_ROWS
+    dx, dg_part = pl.pallas_call(
+        _rms_bwd_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rp, h), x.dtype),
+            jax.ShapeDtypeStruct((grid, h), jnp.float32),
+        ],
+        interpret=pallas_interpret(),
+    )(x2, gamma.reshape(1, h), rstd2, dy2)
+    dx = dx[:rows].reshape(x.shape)
+    return dx, dg_part.sum(axis=0).astype(gamma.dtype)
+
+
+# ---------------------------------------------------------------------------
+# public API with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def layer_norm_affine(x, gamma, beta, eps=1e-5, use_pallas=None):
+    """Fused LayerNorm with affine params (ref: FusedLayerNormAffineFunction)."""
+    return _ln_affine_fwd(x, gamma, beta, eps, use_pallas)[0]
+
+
+def _ln_affine_fwd(x, gamma, beta, eps, use_pallas):
+    use = default_use_pallas() if use_pallas is None else use_pallas
+    if use:
+        y, mean, rstd = _ln_fwd_pallas(x, gamma, beta, eps)
+    else:
+        y, mean, rstd = _ln_fwd_ref(x, gamma, beta, eps)
+        mean = mean.reshape(-1, 1)
+        rstd = rstd.reshape(-1, 1)
+    return y, (x, gamma, mean, rstd)
+
+
+def _ln_affine_fwd_vjp(x, gamma, beta, eps, use_pallas):
+    y, res = _ln_affine_fwd(x, gamma, beta, eps, use_pallas)
+    return y, res
+
+
+def _ln_affine_bwd_vjp(eps, use_pallas, res, dy):
+    x, gamma, mean, rstd = res
+    use = default_use_pallas() if use_pallas is None else use_pallas
+    if use:
+        dx, dgamma, dbeta = _ln_bwd_pallas(x, gamma, mean, rstd, dy)
+    else:
+        mean_r = mean.reshape(x.shape[:-1] + (1,))
+        rstd_r = rstd.reshape(x.shape[:-1] + (1,))
+        dx, dgamma, dbeta = _ln_bwd_ref(x, gamma, mean_r, rstd_r, dy)
+    return dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype)
+
+
+layer_norm_affine.defvjp(_ln_affine_fwd_vjp, _ln_affine_bwd_vjp)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def rms_norm_affine(x, gamma, eps=1e-5, use_pallas=None):
+    """Fused RMSNorm with affine gain (ref: FusedRMSNormAffineFunction)."""
+    return _rms_affine_fwd(x, gamma, eps, use_pallas)[0]
+
+
+def _rms_affine_fwd(x, gamma, eps, use_pallas):
+    use = default_use_pallas() if use_pallas is None else use_pallas
+    if use:
+        y, rstd = _rms_fwd_pallas(x, gamma, eps)
+    else:
+        y, rstd = _rms_fwd_ref(x, gamma, eps)
+        rstd = rstd.reshape(-1, 1)
+    return y, (x, gamma, rstd)
+
+
+def _rms_affine_bwd(eps, use_pallas, res, dy):
+    x, gamma, rstd = res
+    use = default_use_pallas() if use_pallas is None else use_pallas
+    if use:
+        dx, dgamma = _rms_bwd_pallas(x, gamma, rstd, dy)
+    else:
+        rstd_r = rstd.reshape(x.shape[:-1] + (1,))
+        dx, dgamma = _rms_bwd_ref(x, gamma, rstd_r, dy)
+    return dx, dgamma.astype(gamma.dtype)
+
+
+rms_norm_affine.defvjp(_rms_affine_fwd, _rms_affine_bwd)
+
+
+def layer_norm(x, gamma=None, beta=None, eps=1e-5, use_pallas=None):
+    """LayerNorm over the last axis; affine when gamma AND beta are given
+    (partial affine is rejected — the reference has only the two paths)."""
+    if (gamma is None) != (beta is None):
+        raise ValueError(
+            "layer_norm: pass both gamma and beta (affine) or neither"
+        )
+    if gamma is None:
+        y, _, _ = _ln_fwd_ref(x, None, None, eps)
+        return y
+    return layer_norm_affine(x, gamma, beta, eps, use_pallas)
+
+
+def rms_norm(x, gamma=None, eps=1e-5, use_pallas=None):
+    if gamma is None:
+        y, _ = _rms_fwd_ref(x, None, eps)
+        return y
+    return rms_norm_affine(x, gamma, eps, use_pallas)

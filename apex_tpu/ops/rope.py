@@ -1,0 +1,61 @@
+"""Rotary positional embedding (RoPE) fwd/bwd.
+
+Ref: csrc/megatron/fused_rotary_positional_embedding.{h,cpp,cu} — fused
+application of cos/sin rotation to [sq, b, np, hn] tensors. Under XLA the
+rotation fuses into neighboring ops; the explicit custom VJP mirrors the
+reference's hand-written backward (rotate by -theta) and avoids saving the
+rotated output.
+
+Layout here is [..., seq, heads, head_dim] (seq anywhere before the last two
+axes works since the math broadcasts on leading axes).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq: int, base: float = 10000.0):
+    """cos/sin tables of shape [max_seq, head_dim//2] (fp32)."""
+    inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def _rotate(x, cos, sin):
+    """x: [..., seq, heads, hd]; cos/sin: [max_seq, hd//2] tables (sliced to
+    the actual sequence length, so precompute-once-at-max_seq works)."""
+    seq = x.shape[-3]
+    if cos.shape[0] < seq:
+        raise ValueError(
+            f"RoPE table covers {cos.shape[0]} positions < sequence {seq}"
+        )
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = cos[:seq][..., :, None, :]
+    sin = sin[:seq][..., :, None, :]
+    y1 = x1 * cos - x2 * sin
+    y2 = x2 * cos + x1 * sin
+    return jnp.concatenate([y1, y2], axis=-1).astype(x.dtype)
+
+
+@jax.custom_vjp
+def apply_rope(x, cos, sin):
+    """Apply RoPE (ref: fused_rotary_positional_embedding fwd)."""
+    return _rotate(x, cos, sin)
+
+
+def _rope_fwd(x, cos, sin):
+    return _rotate(x, cos, sin), (cos, sin)
+
+
+def _rope_bwd(res, dy):
+    cos, sin = res
+    # inverse rotation = rotation by -theta (ref bwd kernel)
+    return _rotate(dy, cos, -sin), None, None
+
+
+apply_rope.defvjp(_rope_fwd, _rope_bwd)

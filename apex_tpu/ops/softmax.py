@@ -1,0 +1,57 @@
+"""Scaled/masked softmax family.
+
+Ref: csrc/megatron/scaled_softmax*.cu, scaled_masked_softmax*.cu,
+scaled_upper_triang_masked_softmax*.cu, generic_scaled_masked_softmax*.cu —
+warp-per-row fused (scale + mask + softmax) fwd/bwd kernels used by
+FusedScaleMaskSoftmax.
+
+On TPU these are bandwidth-bound elementwise+reduction patterns that XLA
+fuses into a single pass; the functions below define the exact reference
+semantics (mask value -10000, fp32 softmax math for half inputs, scale
+applied pre-mask) and are the building blocks for
+``apex_tpu.transformer.FusedScaleMaskSoftmax`` and the attention kernels.
+All are differentiable through JAX autodiff, which produces the same fused
+``y*(dy - sum(dy*y))`` backward the reference hand-writes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MASK_VALUE = -10000.0  # the reference's fill value for masked logits
+
+
+def scaled_softmax(x, scale: float = 1.0):
+    """softmax(scale * x) — ref: scaled_softmax_cuda. The scale multiply
+    happens in fp32 (the reference scales during the fp32 load), so large
+    half-precision logits don't overflow before the cast."""
+    dtype = x.dtype
+    y = jax.nn.softmax(x.astype(jnp.float32) * scale, axis=-1)
+    return y.astype(dtype)
+
+
+def scaled_masked_softmax(x, mask, scale: float = 1.0):
+    """softmax(scale*x masked) — ref: scaled_masked_softmax_cuda.
+
+    ``mask`` is boolean (or 0/1) with True = MASKED, broadcastable to x
+    (the reference takes a [b, 1, sq, sk] pad mask).
+    """
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32) * scale  # scale in fp32 (see scaled_softmax)
+    x32 = jnp.where(jnp.asarray(mask, bool), MASK_VALUE, x32)
+    # rows that are fully masked produce uniform attention in the reference
+    return jax.nn.softmax(x32, axis=-1).astype(dtype)
+
+
+def scaled_upper_triang_masked_softmax(x, scale: float = 1.0):
+    """Causal softmax over the last two axes (ref:
+    scaled_upper_triang_masked_softmax_cuda; x is [..., sq, sk])."""
+    sq, sk = x.shape[-2], x.shape[-1]
+    causal = jnp.tril(jnp.ones((sq, sk), bool))
+    return scaled_masked_softmax(x, ~causal, scale)
+
+
+def generic_scaled_masked_softmax(x, mask, scale: float = 1.0):
+    """Arbitrary-shape mask variant (ref: generic_scaled_masked_softmax_cuda)."""
+    return scaled_masked_softmax(x, mask, scale)

@@ -23,3 +23,12 @@ from apex_tpu.optimizers.stateful import (  # noqa: F401
     FusedNovoGrad,
     FusedSGD,
 )
+from apex_tpu.optimizers.fused_mixed_precision_lamb import (  # noqa: F401
+    FusedMixedPrecisionLambState,
+    fused_mixed_precision_lamb,
+)
+from apex_tpu.optimizers.larc import LARC, larc  # noqa: F401
+from apex_tpu.optimizers.clip_grad import (  # noqa: F401
+    clip_grad_norm,
+    clip_grad_norm_,
+)

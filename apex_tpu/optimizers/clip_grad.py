@@ -1,0 +1,40 @@
+"""clip_grad_norm_ — fused global-norm gradient clipping.
+
+Ref: apex/contrib/clip_grad/clip_grad.py::clip_grad_norm_ (built on
+``multi_tensor_l2norm`` + ``multi_tensor_scale``). Functional: returns the
+clipped grads and the pre-clip total norm (reference returns the norm and
+scales in place).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.pytree import tree_global_norm
+
+
+def clip_grad_norm(grads, max_norm: float, norm_type: float = 2.0):
+    """Returns ``(clipped_grads, total_norm)``.
+
+    norm_type 2.0 uses the fused fp32 global L2 norm; other norm types fall
+    back to a generic tree reduction (reference does the same: only L2 is
+    fused)."""
+    if norm_type == 2.0:
+        total = tree_global_norm(grads)
+    else:
+        leaves = [
+            jnp.sum(jnp.abs(jnp.asarray(g).astype(jnp.float32)) ** norm_type)
+            for g in jax.tree.leaves(grads)
+        ]
+        total = jnp.stack(leaves).sum() ** (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    clipped = jax.tree.map(
+        lambda g: (g.astype(jnp.float32) * scale).astype(jnp.asarray(g).dtype),
+        grads,
+    )
+    return clipped, total
+
+
+# reference-style alias
+clip_grad_norm_ = clip_grad_norm

@@ -1,0 +1,59 @@
+"""FusedMixedPrecisionLamb — ref: apex/optimizers/fused_mixed_precision_lamb.py
+(``lamb_mp`` kernel): model params live in bf16/fp16 while the optimizer holds
+fp32 masters; each step updates the master and writes the half copy.
+
+Functionally this is fused_lamb over an fp32 master tree + a cast-back; the
+state carries the master so user-visible params can stay half.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers.fused_lamb import fused_lamb
+
+
+class FusedMixedPrecisionLambState(NamedTuple):
+    master: optax.Params          # fp32 master copy
+    inner: object                 # FusedLAMBState over the master
+
+
+def fused_mixed_precision_lamb(
+    learning_rate=1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+    max_grad_norm: float = 1.0,
+    **lamb_kwargs,
+) -> optax.GradientTransformation:
+    inner = fused_lamb(
+        learning_rate, b1, b2, eps, weight_decay,
+        max_grad_norm=max_grad_norm, **lamb_kwargs,
+    )
+
+    def init_fn(params):
+        master = jax.tree.map(
+            lambda p: jnp.asarray(p).astype(jnp.float32), params
+        )
+        return FusedMixedPrecisionLambState(master=master, inner=inner.init(master))
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_mixed_precision_lamb requires params")
+        grads32 = jax.tree.map(lambda g: jnp.asarray(g).astype(jnp.float32), grads)
+        updates32, inner_new = inner.update(grads32, state.inner, state.master)
+        new_master = optax.apply_updates(state.master, updates32)
+        # updates emitted in the *model* dtype: new_half - old_half
+        updates = jax.tree.map(
+            lambda m, p: m.astype(jnp.asarray(p).dtype) - jnp.asarray(p),
+            new_master,
+            params,
+        )
+        return updates, FusedMixedPrecisionLambState(new_master, inner_new)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,82 @@
+"""LARC — layer-wise adaptive rate clipping.
+
+Ref: apex/parallel/LARC.py::LARC — wraps any optimizer; per-parameter
+adaptive lr = trust_coefficient * ||w|| / (||g|| + wd*||w||), either clipping
+the optimizer lr (clip=True) or scaling the gradient (clip=False). Here it is
+an optax gradient transformation applied BEFORE the inner optimizer, which
+reproduces the reference's mechanism (it mutates grads, then restores lr).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def larc(
+    learning_rate: float,
+    trust_coefficient: float = 0.02,
+    clip: bool = True,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """Gradient pre-scaler implementing LARC; chain with any optimizer:
+    ``optax.chain(larc(lr), fused_sgd(lr, momentum=0.9))``."""
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("larc requires params")
+
+        def scale_one(g, p):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            pn = jnp.sqrt(jnp.sum(p32 * p32))
+            gn = jnp.sqrt(jnp.sum(g32 * g32))
+            adaptive_lr = (
+                trust_coefficient * pn / (gn + pn * weight_decay + eps)
+            )
+            # parameters with zero norm (or zero grad) fall back to base lr
+            ok = (pn > 0) & (gn > 0)
+            if clip:
+                # reference: lr <- min(adaptive_lr / base_lr, 1) applied to grad
+                factor = jnp.minimum(adaptive_lr / learning_rate, 1.0)
+            else:
+                factor = adaptive_lr
+            factor = jnp.where(ok, factor, 1.0)
+            # reference adds wd*p into the gradient before scaling (and
+            # zeroes the wrapped group's own weight decay)
+            g_wd = g32 + weight_decay * p32 if weight_decay else g32
+            return (g_wd * factor).astype(g.dtype)
+
+        return jax.tree.map(scale_one, grads, params), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class LARC:
+    """Stateful veneer matching the reference wrapper's shape:
+    ``LARC(inner, base_lr)`` where ``inner`` is an apex_tpu stateful
+    optimizer and ``base_lr`` the lr it was built with (the reference reads
+    it from the wrapped optimizer's param groups; the functional core here
+    doesn't retain it)."""
+
+    def __init__(self, optimizer, base_lr, trust_coefficient=0.02, clip=True,
+                 eps=1e-8):
+        from apex_tpu.optimizers.stateful import _StatefulOptimizer
+
+        if not isinstance(optimizer, _StatefulOptimizer):
+            raise TypeError("LARC wraps an apex_tpu stateful optimizer")
+        self.inner = optimizer
+        self._pre = larc(base_lr, trust_coefficient, clip, eps)
+
+    def step(self, grads):
+        scaled, _ = self._pre.update(grads, optax.EmptyState(), self.inner.params)
+        return self.inner.step(scaled)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

@@ -1,0 +1,130 @@
+"""FusedLayerNorm/RMSNorm parity — ref tests/L0/run_fused_layer_norm/
+test_fused_layer_norm.py (fused vs torch.nn.LayerNorm / python RMSNorm ref,
+dtype ladder, mixed-dtype params, memory_efficient path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.normalization import (
+    FusedLayerNorm,
+    FusedRMSNorm,
+    MixedFusedLayerNorm,
+    fused_layer_norm,
+    fused_rms_norm,
+)
+from apex_tpu.ops.layer_norm import (
+    _ln_fwd_ref,
+    _rms_fwd_ref,
+    layer_norm_affine,
+    rms_norm_affine,
+)
+
+TOLS = {jnp.float32: dict(rtol=2e-5, atol=2e-5),
+        jnp.bfloat16: dict(rtol=2e-2, atol=2e-2),
+        jnp.float16: dict(rtol=2e-3, atol=2e-3)}
+
+
+def _np(x):
+    return np.asarray(x, np.float32)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.float16])
+@pytest.mark.parametrize("shape", [(4, 64), (2, 3, 128), (17, 256)])
+def test_pallas_ln_matches_oracle_fwd_bwd(dtype, shape):
+    h = shape[-1]
+    k = jax.random.PRNGKey(0)
+    x = jax.random.normal(k, shape, jnp.float32).astype(dtype)
+    gamma = (jnp.ones((h,)) + 0.1 * jax.random.normal(k, (h,))).astype(dtype)
+    beta = (0.1 * jax.random.normal(k, (h,))).astype(dtype)
+
+    def f_pallas(x, g, b):
+        return jnp.sum(layer_norm_affine(x, g, b, 1e-5, True).astype(jnp.float32) ** 2)
+
+    def f_ref(x, g, b):
+        y, _, _ = _ln_fwd_ref(x, g, b, 1e-5)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    y_p = layer_norm_affine(x, gamma, beta, 1e-5, True)
+    y_r, _, _ = _ln_fwd_ref(x, gamma, beta, 1e-5)
+    np.testing.assert_allclose(_np(y_p), _np(y_r), **TOLS[dtype])
+
+    g_p = jax.grad(f_pallas, argnums=(0, 1, 2))(x, gamma, beta)
+    g_r = jax.grad(f_ref, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b_ in zip(g_p, g_r):
+        np.testing.assert_allclose(_np(a), _np(b_), **TOLS[dtype])
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_pallas_rmsnorm_matches_oracle(dtype):
+    k = jax.random.PRNGKey(1)
+    x = jax.random.normal(k, (6, 128), jnp.float32).astype(dtype)
+    gamma = jnp.ones((128,), dtype)
+
+    y_p = rms_norm_affine(x, gamma, 1e-6, True)
+    y_r, _ = _rms_fwd_ref(x, gamma, 1e-6)
+    np.testing.assert_allclose(_np(y_p), _np(y_r), **TOLS[dtype])
+
+    f_p = lambda x, g: jnp.sum(rms_norm_affine(x, g, 1e-6, True).astype(jnp.float32) ** 2)
+    f_r = lambda x, g: jnp.sum(_rms_fwd_ref(x, g, 1e-6)[0].astype(jnp.float32) ** 2)
+    gp = jax.grad(f_p, (0, 1))(x, gamma)
+    gr = jax.grad(f_r, (0, 1))(x, gamma)
+    for a, b_ in zip(gp, gr):
+        np.testing.assert_allclose(_np(a), _np(b_), **TOLS[dtype])
+
+
+def test_ln_against_plain_jnp_layernorm():
+    """Oracle itself vs the textbook formula in f64-ish fp32."""
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, 32))
+    gamma = jnp.full((32,), 1.5)
+    beta = jnp.full((32,), -0.5)
+    y = fused_layer_norm(x, gamma, beta, eps=1e-5)
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mean) / jnp.sqrt(var + 1e-5) * gamma + beta
+    np.testing.assert_allclose(_np(y), _np(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_mixed_dtype_params_fp32_activations_bf16():
+    """Megatron MixedFusedLayerNorm pattern: fp32 params, bf16 activations."""
+    m = MixedFusedLayerNorm(normalized_shape=64)
+    x = jnp.ones((4, 64), jnp.bfloat16)
+    v = m.init(jax.random.PRNGKey(0), x)
+    assert v["params"]["scale"].dtype == jnp.float32
+    y = m.apply(v, x)
+    assert y.dtype == jnp.bfloat16
+
+
+def test_no_affine_path():
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, 16))
+    m = FusedLayerNorm(normalized_shape=16, elementwise_affine=False)
+    v = m.init(jax.random.PRNGKey(0), x)
+    assert not jax.tree.leaves(v)  # no params
+    y = m.apply(v, x)
+    np.testing.assert_allclose(_np(y.mean(-1)), 0.0, atol=1e-5)
+
+
+def test_memory_efficient_same_values():
+    x = jax.random.normal(jax.random.PRNGKey(4), (4, 32))
+    gamma, beta = jnp.ones((32,)), jnp.zeros((32,))
+    y1 = fused_layer_norm(x, gamma, beta, memory_efficient=False)
+    y2 = fused_layer_norm(x, gamma, beta, memory_efficient=True)
+    np.testing.assert_allclose(_np(y1), _np(y2), rtol=1e-6)
+    g1 = jax.grad(lambda x: jnp.sum(fused_layer_norm(x, gamma, beta) ** 2))(x)
+    g2 = jax.grad(
+        lambda x: jnp.sum(fused_layer_norm(x, gamma, beta, memory_efficient=True) ** 2)
+    )(x)
+    np.testing.assert_allclose(_np(g1), _np(g2), rtol=1e-6)
+
+
+def test_rms_module():
+    m = FusedRMSNorm(normalized_shape=32)
+    x = jax.random.normal(jax.random.PRNGKey(5), (4, 32))
+    v = m.init(jax.random.PRNGKey(0), x)
+    y = m.apply(v, x)
+    ref = x / jnp.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(_np(y), _np(ref), rtol=1e-5, atol=1e-5)
+    # functional form agrees
+    y2 = fused_rms_norm(x, v["params"]["scale"])
+    np.testing.assert_allclose(_np(y), _np(y2), rtol=1e-6)

@@ -1,0 +1,97 @@
+"""LAMB vs pure-python ref, LARC, clip_grad, mixed-precision LAMB.
+
+Ref: tests/L0/run_optimizers/test_lamb.py (FusedLAMB vs RefLAMB written in
+the test), test_larc.py, contrib clip_grad tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from apex_tpu.optimizers import (
+    clip_grad_norm,
+    fused_lamb,
+    fused_mixed_precision_lamb,
+    fused_novograd,
+    fused_adagrad,
+    larc,
+)
+
+
+def _ref_lamb_step(p, g, m, v, step, lr, b1, b2, eps, wd, max_gn, gnorm):
+    """Pure-numpy LAMB reference (mode=1/AdamW, grad_averaging=True)."""
+    clip = max(gnorm / max_gn, 1.0) if max_gn else 1.0
+    g = g / clip
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    mhat = m / (1 - b1 ** step)
+    vhat = v / (1 - b2 ** step)
+    upd = mhat / (np.sqrt(vhat) + eps) + wd * p
+    wn = np.sqrt((p * p).sum())
+    un = np.sqrt((upd * upd).sum())
+    ratio = wn / un if (wn > 0 and un > 0 and wd != 0) else 1.0
+    return p - lr * ratio * upd, m, v
+
+
+def test_fused_lamb_matches_python_reference():
+    rng = np.random.RandomState(0)
+    p0 = rng.randn(32).astype(np.float32)
+    g0 = rng.randn(32).astype(np.float32)
+    params = {"w": jnp.asarray(p0)}
+    grads = {"w": jnp.asarray(g0)}
+    tx = fused_lamb(1e-2, 0.9, 0.999, 1e-6, weight_decay=0.01, max_grad_norm=1.0)
+    state = tx.init(params)
+    updates, state = tx.update(grads, state, params)
+    got = optax.apply_updates(params, updates)
+
+    gnorm = np.sqrt((g0 * g0).sum())
+    ref_p, _, _ = _ref_lamb_step(
+        p0, g0, np.zeros(32, np.float32), np.zeros(32, np.float32),
+        1, 1e-2, 0.9, 0.999, 1e-6, 0.01, 1.0, gnorm,
+    )
+    np.testing.assert_allclose(np.asarray(got["w"]), ref_p, rtol=1e-4, atol=1e-6)
+
+
+def test_larc_clips_adaptive_lr():
+    params = {"w": jnp.full((4,), 10.0), "b": jnp.full((2,), 1e-12)}
+    grads = {"w": jnp.full((4,), 1.0), "b": jnp.zeros((2,))}
+    tx = larc(learning_rate=1.0, trust_coefficient=0.001)
+    out, _ = tx.update(grads, optax.EmptyState(), params)
+    # adaptive lr = 0.001*20/2 = 0.01 < 1 -> grads scaled by 0.01
+    np.testing.assert_allclose(np.asarray(out["w"]), 0.01, rtol=1e-4)
+    # zero-norm params fall back to unscaled grads
+    np.testing.assert_allclose(np.asarray(out["b"]), 0.0)
+
+
+def test_clip_grad_norm():
+    grads = {"a": jnp.full((3,), 4.0), "b": jnp.full((4,), 3.0)}
+    total = float(jnp.sqrt(3 * 16.0 + 4 * 9.0))
+    clipped, norm = clip_grad_norm(grads, max_norm=1.0)
+    assert abs(float(norm) - total) < 1e-4
+    cn = float(jnp.sqrt(sum(jnp.sum(x ** 2) for x in jax.tree.leaves(clipped))))
+    assert abs(cn - 1.0) < 1e-3
+    # under the max: unchanged
+    clipped2, _ = clip_grad_norm(grads, max_norm=100.0)
+    np.testing.assert_allclose(np.asarray(clipped2["a"]), 4.0, rtol=1e-6)
+
+
+def test_mixed_precision_lamb_bf16_params():
+    params = {"w": jnp.ones((8,), jnp.bfloat16)}
+    tx = fused_mixed_precision_lamb(1e-2)
+    state = tx.init(params)
+    assert state.master["w"].dtype == jnp.float32
+    grads = {"w": jnp.full((8,), 0.1, jnp.bfloat16)}
+    updates, state = tx.update(grads, state, params)
+    new_p = optax.apply_updates(params, updates)
+    assert new_p["w"].dtype == jnp.bfloat16
+    assert float(state.master["w"][0]) != 1.0
+
+
+def test_novograd_and_adagrad_step():
+    params = {"w": jnp.ones((4,))}
+    grads = {"w": jnp.full((4,), 0.5)}
+    for tx in (fused_novograd(1e-2), fused_adagrad(1e-2)):
+        state = tx.init(params)
+        updates, state = tx.update(grads, state, params)
+        p = optax.apply_updates(params, updates)
+        assert float(p["w"][0]) < 1.0
