@@ -1,7 +1,17 @@
 """apex_tpu.parallel — data parallelism over the mesh ``data`` axis
 (ref: apex/parallel)."""
 
-from apex_tpu.parallel import mesh  # noqa: F401
+from apex_tpu.parallel import collectives, mesh  # noqa: F401
+from apex_tpu.parallel.ddp import DistributedDataParallel  # noqa: F401
+from apex_tpu.parallel.sync_batchnorm import sync_batch_stats  # noqa: F401
+
+try:  # flax-only pieces; DDP/collectives/mesh stay importable without flax
+    from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
+        SyncBatchNorm,
+        convert_syncbn_model,
+    )
+except ImportError:  # pragma: no cover
+    pass
 from apex_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,
     MODEL_AXIS,

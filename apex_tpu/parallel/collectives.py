@@ -1,0 +1,98 @@
+"""Named-axis collectives — the distributed communication backend.
+
+Ref: the reference's torch.distributed usage (SURVEY.md §6 "Distributed
+communication backend"): NCCL/UCC process groups with all_reduce, all_gather,
+reduce_scatter, broadcast, batch_isend_irecv. Under SPMD there are no
+communicators: a collective names a mesh axis and XLA lowers it to ICI
+(intra-slice) or DCN (inter-slice) transfers based on the mesh layout.
+
+These wrappers exist to (a) give the rest of the library one vocabulary,
+(b) centralize dtype-handling (fp32 accumulation options), and (c) document
+the mapping for users porting reference code:
+
+  dist.all_reduce(t, group=g)        -> all_reduce(t, axis)
+  dist.all_gather(ts, t, group=g)    -> all_gather(t, axis)
+  dist.reduce_scatter(out, ts)       -> reduce_scatter(t, axis)
+  dist.broadcast(t, src, group=g)    -> broadcast(t, axis, src)
+  batch_isend_irecv(P2POps)          -> permute(t, axis, perm) [ppermute]
+
+All functions must run inside a ``shard_map``/``pmap`` body (a context where
+``axis`` is bound).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Axis = Union[str, Sequence[str]]
+
+
+def axis_index(axis: Axis):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: Axis) -> int:
+    return lax.axis_size(axis) if hasattr(lax, "axis_size") else lax.psum(1, axis)
+
+
+def all_reduce(x, axis: Axis, op: str = "sum"):
+    """Ref: dist.all_reduce (SUM/MAX/MIN)."""
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "mean":
+        return lax.pmean(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
+def all_gather(x, axis: Axis, *, gather_axis: int = 0, tiled: bool = True):
+    """Ref: dist.all_gather — concatenates shards along ``gather_axis``."""
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: Axis, *, scatter_axis: int = 0):
+    """Ref: dist.reduce_scatter — sum then keep this rank's shard."""
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def broadcast(x, axis: Axis, src: int = 0):
+    """Ref: dist.broadcast — every rank gets rank ``src``'s value.
+
+    SPMD form: zero out non-src shards and psum (one collective, no
+    control flow divergence).
+    """
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)
+
+
+def permute(x, axis: Axis, perm: Sequence[tuple]):
+    """Ref: batch_isend_irecv p2p — (src, dst) pairs over the axis ring."""
+    return lax.ppermute(x, axis, perm)
+
+
+def shift_right(x, axis: Axis):
+    """Send to the next rank on the ring (pipeline send_forward)."""
+    n = axis_size(axis)
+    return lax.ppermute(x, axis, [(i, (i + 1) % n) for i in range(n)])
+
+
+def shift_left(x, axis: Axis):
+    """Send to the previous rank on the ring (pipeline send_backward)."""
+    n = axis_size(axis)
+    return lax.ppermute(x, axis, [(i, (i - 1) % n) for i in range(n)])
+
+
+def all_reduce_tree(tree, axis: Axis, op: str = "sum"):
+    return jax.tree.map(lambda x: all_reduce(x, axis, op), tree)
+
+
+def broadcast_tree(tree, axis: Axis, src: int = 0):
+    return jax.tree.map(lambda x: broadcast(x, axis, src), tree)

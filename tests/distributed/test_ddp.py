@@ -1,0 +1,169 @@
+"""DDP bucketed allreduce — distributed-in-a-box on the CPU mesh.
+
+Ref: tests/distributed/DDP/ddp_race_condition_test.py (bucket/order stress)
+and apex/parallel/distributed.py option semantics."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from apex_tpu.parallel import DistributedDataParallel, cpu_mesh
+
+
+def _grads_tree(key, sizes):
+    ks = jax.random.split(key, len(sizes))
+    return {f"p{i}": jax.random.normal(k, (s,), jnp.float32)
+            for i, (k, s) in enumerate(zip(ks, sizes))}
+
+
+def _run_ddp(mesh, grads_sharded, ddp, world):
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P("data"),), out_specs=P(),
+        check_rep=False,
+    )
+    def go(g):
+        g = jax.tree.map(lambda x: x[0], g)  # shard dim -> local grads
+        return ddp.allreduce_gradients(g)
+
+    return go(grads_sharded)
+
+
+@pytest.mark.parametrize("message_size", [1, 64, 2 ** 20])
+def test_bucketed_allreduce_matches_mean(eight_cpu_devices, message_size):
+    mesh = cpu_mesh({"data": 4})
+    world = 4
+    # per-rank grads: shape [world, ...] then sharded over data
+    sizes = (3, 17, 64, 5)
+    per_rank = [
+        _grads_tree(jax.random.PRNGKey(r), sizes) for r in range(world)
+    ]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_rank)
+
+    ddp = DistributedDataParallel(message_size=message_size)
+    out = _run_ddp(mesh, stacked, ddp, world)
+
+    expected = jax.tree.map(lambda *xs: sum(xs) / world, *per_rank)
+    for k in expected:
+        np.testing.assert_allclose(
+            np.asarray(out[k]), np.asarray(expected[k]), rtol=1e-6
+        )
+
+
+def test_predivide_and_no_average(eight_cpu_devices):
+    mesh = cpu_mesh({"data": 2})
+    per_rank = [_grads_tree(jax.random.PRNGKey(r), (8,)) for r in range(2)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_rank)
+
+    # no averaging: pure sum
+    ddp_sum = DistributedDataParallel(gradient_average=False)
+    out = _run_ddp(mesh, stacked, ddp_sum, 2)
+    np.testing.assert_allclose(
+        np.asarray(out["p0"]),
+        np.asarray(per_rank[0]["p0"] + per_rank[1]["p0"]),
+        rtol=1e-6,
+    )
+
+    # predivide factor preserves the mean overall
+    ddp_pre = DistributedDataParallel(gradient_predivide_factor=2.0)
+    out2 = _run_ddp(mesh, stacked, ddp_pre, 2)
+    np.testing.assert_allclose(
+        np.asarray(out2["p0"]),
+        np.asarray((per_rank[0]["p0"] + per_rank[1]["p0"]) / 2),
+        rtol=1e-6,
+    )
+
+
+def test_always_fp32_with_bf16_grads(eight_cpu_devices):
+    mesh = cpu_mesh({"data": 2})
+    g0 = {"w": jnp.full((1024,), 1.001, jnp.bfloat16)}
+    g1 = {"w": jnp.full((1024,), -1.0, jnp.bfloat16)}
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), g0, g1)
+    ddp = DistributedDataParallel(allreduce_always_fp32=True)
+    out = _run_ddp(mesh, stacked, ddp, 2)
+    assert out["w"].dtype == jnp.bfloat16  # cast back after fp32 reduce
+
+
+def test_retain_allreduce_buffers(eight_cpu_devices):
+    mesh = cpu_mesh({"data": 2})
+    per_rank = [_grads_tree(jax.random.PRNGKey(r), (4, 4)) for r in range(2)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_rank)
+    ddp = DistributedDataParallel(retain_allreduce_buffers=True, message_size=1)
+
+    @functools.partial(
+        shard_map, mesh=cpu_mesh({"data": 2}), in_specs=(P("data"),),
+        out_specs=(P(), P()), check_rep=False,
+    )
+    def go(g):
+        g = jax.tree.map(lambda x: x[0], g)
+        out, buffers = ddp.allreduce_gradients(g)
+        return out, tuple(buffers)
+
+    out, buffers = go(stacked)
+    assert len(buffers) == 2  # one flat buffer per bucket (message_size=1)
+    np.testing.assert_allclose(
+        np.asarray(buffers[0]),
+        np.asarray((per_rank[0]["p0"] + per_rank[1]["p0"]) / 2),
+        rtol=1e-6,
+    )
+
+
+def test_ddp_end_to_end_equals_full_batch_training(eight_cpu_devices):
+    """DDP-sharded grads == single-process full-batch grads (the invariant
+    behind tests/distributed/amp_master_params)."""
+    mesh = cpu_mesh({"data": 4})
+    k = jax.random.PRNGKey(0)
+    params = {"w": jax.random.normal(k, (16, 4))}
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 16))
+    y = jax.random.normal(jax.random.PRNGKey(2), (32, 4))
+
+    def loss_local(p, xb, yb):
+        return jnp.mean((xb @ p["w"] - yb) ** 2)
+
+    ddp = DistributedDataParallel()
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P(), P("data"), P("data")),
+        out_specs=P(), check_rep=False,
+    )
+    def dist_grads(p, xb, yb):
+        g = jax.grad(loss_local)(p, xb, yb)
+        return ddp.allreduce_gradients(g)
+
+    g_dist = dist_grads(params, x, y)
+    g_full = jax.grad(loss_local)(params, x, y)
+    np.testing.assert_allclose(
+        np.asarray(g_dist["w"]), np.asarray(g_full["w"]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_broadcast_params(eight_cpu_devices):
+    mesh = cpu_mesh({"data": 4})
+    vals = jnp.arange(4.0).reshape(4, 1)  # rank r holds value r
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+        check_rep=False,
+    )
+    def bcast(v):
+        ddp = DistributedDataParallel()
+        return ddp.broadcast_params(v[0])[None]
+
+    out = bcast(vals)
+    np.testing.assert_allclose(np.asarray(out).ravel(), 0.0)  # all got rank0
+
+
+def test_mixed_dtype_buckets_no_promotion(eight_cpu_devices):
+    mesh = cpu_mesh({"data": 2})
+    g0 = {"w": jnp.ones((64,), jnp.bfloat16), "n": jnp.ones((8,), jnp.float32)}
+    g1 = {"w": jnp.ones((64,), jnp.bfloat16), "n": jnp.ones((8,), jnp.float32)}
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), g0, g1)
+    ddp = DistributedDataParallel(message_size=2 ** 20)  # both would share a bucket
+    out = _run_ddp(mesh, stacked, ddp, 2)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["n"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out["n"]), 1.0, rtol=1e-6)

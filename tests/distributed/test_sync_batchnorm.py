@@ -1,0 +1,156 @@
+"""SyncBatchNorm vs single-process BN — ref tests/distributed/synced_batchnorm/
+(two_gpu_unit_test.py, test_groups.py): sharded syncbn stats/output/grads
+must equal BN over the concatenated batch."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import flax.linen as nn
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from apex_tpu.parallel import (
+    SyncBatchNorm,
+    convert_syncbn_model,
+    cpu_mesh,
+    sync_batch_stats,
+)
+
+
+def test_sync_stats_equal_global_stats(eight_cpu_devices):
+    mesh = cpu_mesh({"data": 4})
+    x = jax.random.normal(jax.random.PRNGKey(0), (32, 8))
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P("data"),), out_specs=(P(), P()),
+        check_rep=False,
+    )
+    def stats(xb):
+        return sync_batch_stats(xb, "data")
+
+    mean, var = stats(x)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(x.mean(0)), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(x.var(0)), rtol=1e-4, atol=1e-6)
+
+
+def test_syncbn_matches_full_batch_bn_fwd_bwd(eight_cpu_devices):
+    mesh = cpu_mesh({"data": 2})
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 6)) * 3 + 1
+
+    sbn = SyncBatchNorm(use_running_average=False, axis_name="data")
+    bn = nn.BatchNorm(use_running_average=False)
+    v_s = sbn.init(jax.random.PRNGKey(2), x)
+    v_b = bn.init(jax.random.PRNGKey(2), x)
+
+    def full(vb, x):
+        y, _ = bn.apply(vb, x, mutable=["batch_stats"])
+        return y
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"),
+        check_rep=False,
+    )
+    def dist(vs, xb):
+        y, _ = sbn.apply(vs, xb, mutable=["batch_stats"])
+        return y
+
+    y_full = full(v_b, x)
+    y_dist = dist(v_s, x)
+    np.testing.assert_allclose(np.asarray(y_dist), np.asarray(y_full), rtol=1e-4, atol=1e-5)
+
+    # grads through the sharded path match the full-batch path
+    def loss_full(vb):
+        return jnp.sum(full(vb, x) ** 2)
+
+    def loss_dist(vs):
+        return jnp.sum(dist(vs, x) ** 2)
+
+    g_full = jax.grad(loss_full)(v_b)["params"]
+    g_dist = jax.grad(loss_dist)(v_s)["params"]
+    np.testing.assert_allclose(
+        np.asarray(g_dist["scale"]), np.asarray(g_full["scale"]), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(g_dist["bias"]), np.asarray(g_full["bias"]), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_syncbn_running_stats_update(eight_cpu_devices):
+    mesh = cpu_mesh({"data": 2})
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, 4)) + 5.0
+    sbn = SyncBatchNorm(use_running_average=False, axis_name="data", momentum=0.0)
+    v = sbn.init(jax.random.PRNGKey(0), x)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P(), P("data")),
+        out_specs=(P("data"), P()), check_rep=False,
+    )
+    def step(v, xb):
+        y, mut = sbn.apply(v, xb, mutable=["batch_stats"])
+        return y, mut["batch_stats"]
+
+    _, bs = step(v, x)
+    # momentum=0 -> running stats jump to batch stats (global)
+    np.testing.assert_allclose(np.asarray(bs["mean"]), np.asarray(x.mean(0)), rtol=1e-4)
+
+
+def test_syncbn_process_group_subaxes(eight_cpu_devices):
+    """axis grouping: sync only within each group of 2 (ref test_groups.py)."""
+    mesh = cpu_mesh({"group": 2, "member": 2}, axis_order=("group", "member"))
+    x = jnp.stack([jnp.full((4, 2), float(i)) for i in range(4)])  # [4,4,2]
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P(("group", "member")),),
+        out_specs=P(("group", "member")), check_rep=False,
+    )
+    def stats(xb):
+        mean, _ = sync_batch_stats(xb[0], "member")  # sync within group only
+        return mean[None]
+
+    means = np.asarray(stats(x))
+    # ranks 0,1 share a group (values 0,1 -> mean 0.5); ranks 2,3 -> 2.5
+    np.testing.assert_allclose(means[0], means[1])
+    np.testing.assert_allclose(means[0][0], 0.5)
+    np.testing.assert_allclose(means[2][0], 2.5)
+
+
+class _Net(nn.Module):
+    norm: nn.Module = None
+
+    @nn.compact
+    def __call__(self, x):
+        norm = self.norm if self.norm is not None else nn.BatchNorm(
+            use_running_average=False
+        )
+        return norm(x)
+
+
+def test_convert_syncbn_model():
+    bn = nn.BatchNorm(use_running_average=False, momentum=0.8)
+    net = _Net(norm=bn)
+    conv = convert_syncbn_model(net, axis_name="data")
+    assert isinstance(conv.norm, SyncBatchNorm)
+    assert conv.norm.momentum == 0.8
+    assert conv.norm.axis_name == "data"
+    # non-BN modules untouched
+    dense = nn.Dense(4)
+    assert convert_syncbn_model(dense) is dense
+
+
+def test_convert_syncbn_recurses_containers_and_keeps_axis():
+    class Seq(nn.Module):
+        layers: tuple = ()
+
+        @nn.compact
+        def __call__(self, x):
+            for l in self.layers:
+                x = l(x)
+            return x
+
+    net = Seq(layers=(nn.Dense(4), nn.BatchNorm(use_running_average=False, axis=1)))
+    conv = convert_syncbn_model(net, axis_name="data")
+    assert isinstance(conv.layers[1], SyncBatchNorm)
+    assert conv.layers[1].feature_axis == 1
+    assert isinstance(conv.layers[0], nn.Dense)
