@@ -98,14 +98,15 @@ class DistributedDataParallel:
             return grads
         n = world_size if world_size is not None else lax.psum(1, self.axis_name)
 
+        # ref allreduce_bucket order: predivide unconditionally BEFORE the
+        # all-reduce (overflow guard for low-precision sums), post-multiply
+        # (predivide_factor / world) only when gradient_average
         pre = 1.0
         post = 1.0
+        if self.gradient_predivide_factor != 1.0:
+            pre = 1.0 / self.gradient_predivide_factor
         if self.gradient_average:
-            if self.gradient_predivide_factor != 1.0:
-                pre = 1.0 / self.gradient_predivide_factor
-                post = self.gradient_predivide_factor / n
-            else:
-                post = 1.0 / n
+            post = self.gradient_predivide_factor / n
 
         flat_buckets = []
         reduced_leaves = [None] * len(leaves)

@@ -39,12 +39,18 @@ def sync_batch_stats(x, axis_name: Optional[Axis], *, feature_axis: int = -1):
     red = tuple(i for i in range(x.ndim) if i != (feature_axis % x.ndim))
     x32 = x.astype(jnp.float32)
     mean = jnp.mean(x32, axis=red)
-    mean_sq = jnp.mean(jnp.square(x32), axis=red)
     if axis_name is not None:
         # equal shard sizes under SPMD -> unweighted pmean == Chan merge
         mean = lax.pmean(mean, axis_name)
-        mean_sq = lax.pmean(mean_sq, axis_name)
-    var = mean_sq - jnp.square(mean)
+    # two-pass variance around the GLOBAL mean: E[x^2]-E[x]^2 cancels
+    # catastrophically in fp32 when |mean| >> std; centering first keeps the
+    # numerics of the reference's Welford kernel at the cost of one more
+    # local pass (collective count unchanged: one pmean for mean, one for var)
+    shape = [1] * x.ndim
+    shape[feature_axis % x.ndim] = mean.shape[0]
+    var = jnp.mean(jnp.square(x32 - mean.reshape(shape)), axis=red)
+    if axis_name is not None:
+        var = lax.pmean(var, axis_name)
     return mean, var
 
 
@@ -138,6 +144,12 @@ if _HAVE_FLAX:
         import dataclasses as dc
 
         if isinstance(module, nn.BatchNorm):
+            if not isinstance(module.axis, int):
+                raise NotImplementedError(
+                    "convert_syncbn_model: BatchNorm with multiple feature "
+                    f"axes (axis={module.axis!r}) is not supported; use "
+                    "SyncBatchNorm directly with a custom reduction"
+                )
             return SyncBatchNorm(
                 use_running_average=module.use_running_average,
                 axis_name=axis_name,

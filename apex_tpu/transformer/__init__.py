@@ -1,0 +1,35 @@
+"""Megatron-style model parallelism, TPU-native.
+
+Ref: apex/transformer/* (SURVEY.md §3.9). The reference manages NCCL process
+groups for a 3D (TP x PP x DP) decomposition; here a single named
+``jax.sharding.Mesh`` plus SPMD collectives replaces all group bookkeeping.
+"""
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer import tensor_parallel
+from apex_tpu.transformer.enums import AttnType, AttnMaskType, LayerType, ModelType
+from apex_tpu.transformer.fused_softmax import (
+    FusedScaleMaskSoftmax,
+    GenericScaledMaskedSoftmax,
+)
+from apex_tpu.transformer.grad_scaler import GradScaler
+from apex_tpu.transformer.microbatches import (
+    build_num_microbatches_calculator,
+    ConstantNumMicroBatchesCalculator,
+    RampupBatchsizeNumMicroBatchesCalculator,
+)
+
+__all__ = [
+    "parallel_state",
+    "tensor_parallel",
+    "AttnType",
+    "AttnMaskType",
+    "LayerType",
+    "ModelType",
+    "FusedScaleMaskSoftmax",
+    "GenericScaledMaskedSoftmax",
+    "GradScaler",
+    "build_num_microbatches_calculator",
+    "ConstantNumMicroBatchesCalculator",
+    "RampupBatchsizeNumMicroBatchesCalculator",
+]

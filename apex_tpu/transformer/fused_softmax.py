@@ -1,0 +1,91 @@
+"""FusedScaleMaskSoftmax — the attention-softmax front door.
+
+Ref: apex/transformer/functional/fused_softmax.py::FusedScaleMaskSoftmax —
+routes to scaled_upper_triang_masked_softmax_cuda (causal) /
+scaled_masked_softmax_cuda (padding) / scaled_softmax_cuda (no mask) when the
+CUDA kernels' constraints hold, else a torch fallback.
+
+On TPU there is no eligibility gate: the jnp softmax family
+(apex_tpu.ops.softmax) fuses under XLA for any shape/dtype, so the
+"kernel" path is always taken; ``is_kernel_available`` is kept (always True
+for supported dtypes) so ported callers behave identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.ops.softmax import (
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.transformer.enums import AttnMaskType
+
+
+@dataclasses.dataclass
+class FusedScaleMaskSoftmax:
+    """fused operation: scaling + mask + softmax.
+
+    Arguments mirror the reference ctor:
+      input_in_fp16/bf16: declared activation dtype (validated at call)
+      attn_mask_type: AttnMaskType.padding | AttnMaskType.causal
+      scaled_masked_softmax_fusion: kept for parity; fusion is XLA's job
+      mask_func: fallback mask function (applied when mask given and the
+        generic path runs), e.g. lambda x, m: x.masked_fill(m, -10000)
+      softmax_in_fp32: compute softmax in fp32 (the kernels always do)
+      scale: logit scale factor
+    """
+
+    input_in_fp16: bool = False
+    input_in_bf16: bool = False
+    attn_mask_type: AttnMaskType = AttnMaskType.padding
+    scaled_masked_softmax_fusion: bool = True
+    mask_func: Optional[Callable] = None
+    softmax_in_fp32: bool = True
+    scale: Optional[float] = None
+
+    def __post_init__(self):
+        if self.input_in_fp16 and self.input_in_bf16:
+            raise ValueError("both fp16 and bf16 flags cannot be active")
+        if self.scale is not None and not self.softmax_in_fp32:
+            raise ValueError("softmax should be in fp32 when scaled (ref asserts)")
+
+    @property
+    def input_in_float16(self) -> bool:
+        return self.input_in_fp16 or self.input_in_bf16
+
+    def is_kernel_available(self, mask, b, np_, sq, sk) -> bool:
+        """The reference gates on dtype, 16 < sk <= 4096, sk % 4 == 0, etc.
+        XLA has no such constraints; report True for float16/bfloat16 inputs
+        (the only dtypes the CUDA kernels accept)."""
+        return self.scaled_masked_softmax_fusion and self.input_in_float16
+
+    def __call__(self, x, mask=None):
+        scale = self.scale if self.scale is not None else 1.0
+        orig_dtype = x.dtype
+        if self.softmax_in_fp32:
+            x = x.astype(jnp.float32)
+
+        if self.attn_mask_type == AttnMaskType.causal:
+            # the reference's causal kernel ignores the mask argument
+            probs = scaled_upper_triang_masked_softmax(x, scale)
+        elif mask is not None:
+            if self.mask_func is not None and not self.input_in_float16:
+                probs = scaled_softmax(self.mask_func(x * scale, mask), 1.0)
+            else:
+                probs = scaled_masked_softmax(x, mask, scale)
+        else:
+            probs = scaled_softmax(x, scale)
+
+        if self.softmax_in_fp32 and self.input_in_float16:
+            probs = probs.astype(orig_dtype)
+        return probs
+
+
+class GenericScaledMaskedSoftmax(FusedScaleMaskSoftmax):
+    """Arbitrary-mask variant (ref: generic_scaled_masked_softmax_cuda) —
+    identical math on TPU; exists for import parity."""

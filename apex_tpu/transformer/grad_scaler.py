@@ -1,0 +1,47 @@
+"""Model-parallel-aware grad scaler.
+
+Ref: apex/transformer/amp/grad_scaler.py::GradScaler — subclasses
+torch.cuda.amp.GradScaler and all-reduces found_inf across the model-parallel
+group so every TP/PP rank skips the same steps.
+
+Here the same contract over apex_tpu.amp.LossScaler: ``unscale`` additionally
+MAX-reduces found_inf over the model axes when called inside a mapped
+computation. Under pure GSPMD/pjit the overflow flag is computed on global
+arrays and is already consistent — the sync matters for shard_map training
+loops where each model shard sees only its local grads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.amp.scaler import LossScaler, ScalerState
+
+Axis = Union[str, Sequence[str]]
+
+
+def sync_found_inf(found_inf, axes: Axis):
+    """MAX-reduce the overflow flag over ``axes`` (ref: the all_reduce in
+    GradScaler._unscale_grads_)."""
+    return lax.pmax(found_inf.astype(jnp.float32), axes) > 0
+
+
+@dataclasses.dataclass(frozen=True)
+class GradScaler(LossScaler):
+    """LossScaler whose overflow decision is agreed across model axes.
+
+    ``model_parallel_axes`` defaults to ("stage", "model") — the reference's
+    _MODEL_PARALLEL_GROUP (TP x PP).
+    """
+
+    model_parallel_axes: Tuple[str, ...] = ("stage", "model")
+
+    def unscale(self, state: ScalerState, grads, *, in_mapped_context: bool = True):
+        grads32, found_inf = super().unscale(state, grads)
+        if in_mapped_context and self.model_parallel_axes:
+            found_inf = sync_found_inf(found_inf, tuple(self.model_parallel_axes))
+        return grads32, found_inf

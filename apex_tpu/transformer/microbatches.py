@@ -1,0 +1,149 @@
+"""Microbatch calculators.
+
+Ref: apex/transformer/microbatches.py::build_num_microbatches_calculator,
+::ConstantNumMicroBatches, ::RampupBatchsizeNumMicroBatches. Pure host-side
+bookkeeping — ported semantics, no device code.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+class NumMicroBatchesCalculator:
+    def __init__(self):
+        self.num_micro_batches: Optional[int] = None
+        self.current_global_batch_size: Optional[int] = None
+
+    def get(self) -> int:
+        return self.num_micro_batches
+
+    def get_current_global_batch_size(self) -> int:
+        return self.current_global_batch_size
+
+    def update(self, consumed_samples, consistency_check) -> None:
+        raise NotImplementedError
+
+
+class ConstantNumMicroBatchesCalculator(NumMicroBatchesCalculator):
+    """Ref: microbatches.py::ConstantNumMicroBatches."""
+
+    def __init__(self, global_batch_size: int, micro_batch_size: int,
+                 data_parallel_size: int):
+        super().__init__()
+        micro_batch_times_dp = micro_batch_size * data_parallel_size
+        if global_batch_size % micro_batch_times_dp:
+            raise ValueError(
+                f"global batch size ({global_batch_size}) is not divisible by "
+                f"micro batch size ({micro_batch_size}) times data parallel "
+                f"size ({data_parallel_size})"
+            )
+        self.num_micro_batches = global_batch_size // micro_batch_times_dp
+        if self.num_micro_batches < 1:
+            raise ValueError("num_micro_batches must be >= 1")
+        self.current_global_batch_size = global_batch_size
+        self.micro_batch_size = micro_batch_size
+
+    def update(self, consumed_samples, consistency_check) -> None:
+        pass
+
+
+class RampupBatchsizeNumMicroBatchesCalculator(NumMicroBatchesCalculator):
+    """Linear global-batch-size ramp (ref: RampupBatchsizeNumMicroBatches).
+
+    Batch size grows from ``start_batch_size`` by ``batch_size_increment``
+    every ``ramup_samples / steps`` consumed samples, where
+    steps = (global_batch_size - start_batch_size) / batch_size_increment.
+    """
+
+    def __init__(self, start_batch_size: int, batch_size_increment: int,
+                 ramup_samples: int, global_batch_size: int,
+                 micro_batch_size: int, data_parallel_size: int):
+        super().__init__()
+        if batch_size_increment <= 0:
+            raise ValueError("batch_size_increment must be positive")
+        if ramup_samples < 0:
+            raise ValueError("ramup_samples must be non-negative")
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_size = data_parallel_size
+        self.start_batch_size = start_batch_size
+        self.batch_size_increment = batch_size_increment
+        self.ramup_samples = ramup_samples
+        self.global_batch_size = global_batch_size
+        self.micro_batch_times_data_parallel_size = (
+            micro_batch_size * data_parallel_size
+        )
+        if start_batch_size % self.micro_batch_times_data_parallel_size:
+            raise ValueError(
+                "start batch size must be divisible by micro-batch * dp size"
+            )
+
+        diff = global_batch_size - start_batch_size
+        if diff < 0:
+            raise ValueError("global batch size must be >= start batch size")
+        if diff % batch_size_increment:
+            raise ValueError(
+                f"expected global batch size interval ({diff}) to be divisible "
+                f"by batch size increment ({batch_size_increment})"
+            )
+        num_increments = diff // batch_size_increment
+        self.rampup_samples_per_increment = (
+            self.ramup_samples / num_increments if num_increments > 0 else 0
+        )
+        self.update(0, False)
+
+    def update(self, consumed_samples: int, consistency_check: bool) -> None:
+        if (self.rampup_samples_per_increment == 0
+                or consumed_samples > self.ramup_samples):
+            self.current_global_batch_size = self.global_batch_size
+        else:
+            steps = int(consumed_samples / self.rampup_samples_per_increment)
+            self.current_global_batch_size = (
+                self.start_batch_size + steps * self.batch_size_increment
+            )
+            self.current_global_batch_size = min(
+                self.current_global_batch_size, self.global_batch_size
+            )
+        if consistency_check:
+            if self.current_global_batch_size % \
+                    self.micro_batch_times_data_parallel_size:
+                raise ValueError(
+                    f"current global batch size "
+                    f"({self.current_global_batch_size}) is not divisible by "
+                    "micro-batch-size * data-parallel-size"
+                )
+        self.num_micro_batches = (
+            self.current_global_batch_size
+            // self.micro_batch_times_data_parallel_size
+        )
+
+
+def build_num_microbatches_calculator(
+    rank: int = 0,
+    rampup_batch_size: Optional[Sequence[int]] = None,
+    global_batch_size: int = 1,
+    micro_batch_size: int = 1,
+    data_parallel_size: int = 1,
+) -> NumMicroBatchesCalculator:
+    """Ref: microbatches.py::build_num_microbatches_calculator.
+
+    ``rampup_batch_size`` is the Megatron triple
+    [start_batch_size, increment, ramup_samples] or None for constant.
+    """
+    if rampup_batch_size is None:
+        return ConstantNumMicroBatchesCalculator(
+            global_batch_size, micro_batch_size, data_parallel_size
+        )
+    if len(rampup_batch_size) != 3:
+        raise ValueError(
+            "expected the following format: --rampup-batch-size <start batch "
+            "size> <batch size increment> <ramp-up samples>"
+        )
+    return RampupBatchsizeNumMicroBatchesCalculator(
+        int(rampup_batch_size[0]),
+        int(rampup_batch_size[1]),
+        int(rampup_batch_size[2]),
+        global_batch_size,
+        micro_batch_size,
+        data_parallel_size,
+    )

@@ -1,0 +1,223 @@
+"""Model-parallel topology state — the mesh-backed analog of process groups.
+
+Ref: apex/transformer/parallel_state.py::initialize_model_parallel and the
+rank/world-size getters over _TENSOR_MODEL_PARALLEL_GROUP /
+_PIPELINE_MODEL_PARALLEL_GROUP / _DATA_PARALLEL_GROUP etc.
+
+The reference enumerates global ranks into NCCL communicators per parallel
+dimension. Under single-controller SPMD none of that machinery exists: one
+``jax.sharding.Mesh`` with axes ("stage", "data", "model") IS the 3D
+decomposition, and "my rank in group G" is ``lax.axis_index(axis)`` inside a
+mapped computation. This module keeps the reference's API shape so Megatron-
+style model code ports mechanically:
+
+  * world sizes are static mesh properties — callable anywhere;
+  * ranks are *traced* values — callable only inside shard_map/pmap/pjit
+    bodies (where an axis binding exists), mirroring how the reference's
+    rank getters are only meaningful after torch.distributed init;
+  * virtual-pipeline bookkeeping (used by the interleaved schedule) is plain
+    host state, exactly like the reference's globals.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Sequence
+
+import jax
+from jax import lax
+from jax.sharding import Mesh
+
+from apex_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    STAGE_AXIS,
+    make_mesh,
+)
+
+_state: Optional["ParallelState"] = None
+
+
+@dataclasses.dataclass
+class ParallelState:
+    """Everything initialize_model_parallel computed, mesh-ified."""
+
+    mesh: Mesh
+    tensor_axis: str = MODEL_AXIS
+    pipeline_axis: str = STAGE_AXIS
+    data_axis: str = DATA_AXIS
+    virtual_pipeline_model_parallel_size: Optional[int] = None
+    pipeline_model_parallel_split_rank: Optional[int] = None
+    # Host-side cursor used by the interleaved schedule, mirroring the
+    # reference's _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK global.
+    virtual_pipeline_model_parallel_rank: Optional[int] = None
+
+    def axis_size(self, axis: str) -> int:
+        return self.mesh.shape[axis] if axis in self.mesh.axis_names else 1
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    pipeline_model_parallel_split_rank: Optional[int] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    mesh: Optional[Mesh] = None,
+) -> ParallelState:
+    """Build (or adopt) the mesh for a TPxPPxDP decomposition.
+
+    Ref signature: parallel_state.py::initialize_model_parallel(
+    tensor_model_parallel_size_, pipeline_model_parallel_size_, virtual...,
+    pipeline_model_parallel_split_rank_). The reference's ``default_backend``/
+    ``p2p_backend`` (nccl|ucc) selectors have no analog: XLA picks the
+    transport (ICI/DCN) from the mesh layout.
+
+    DP size is inferred as n_devices / (tp * pp), like the reference.
+    """
+    global _state
+    if virtual_pipeline_model_parallel_size is not None:
+        if pipeline_model_parallel_size < 2:
+            raise ValueError(
+                "virtual pipeline parallelism requires pipeline_model_parallel_size >= 2"
+            )
+    if mesh is None:
+        mesh = make_mesh(
+            {
+                STAGE_AXIS: pipeline_model_parallel_size,
+                DATA_AXIS: -1,
+                MODEL_AXIS: tensor_model_parallel_size,
+            },
+            devices=devices,
+        )
+    _state = ParallelState(
+        mesh=mesh,
+        virtual_pipeline_model_parallel_size=virtual_pipeline_model_parallel_size,
+        pipeline_model_parallel_split_rank=pipeline_model_parallel_split_rank,
+        virtual_pipeline_model_parallel_rank=(
+            0 if virtual_pipeline_model_parallel_size is not None else None
+        ),
+    )
+    return _state
+
+
+def model_parallel_is_initialized() -> bool:
+    """Ref: parallel_state.py::model_parallel_is_initialized."""
+    return _state is not None
+
+
+def get_state() -> ParallelState:
+    if _state is None:
+        raise RuntimeError(
+            "model parallel state is not initialized; call "
+            "initialize_model_parallel() first"
+        )
+    return _state
+
+
+def get_mesh() -> Mesh:
+    return get_state().mesh
+
+
+def destroy_model_parallel() -> None:
+    """Ref: parallel_state.py::destroy_model_parallel."""
+    global _state
+    _state = None
+
+
+# -- axis names (the "group" handles) ------------------------------------
+
+def get_tensor_model_parallel_group() -> str:
+    """The reference returns an NCCL communicator; we return the axis name —
+    the thing every collective in this library takes in its place."""
+    return get_state().tensor_axis
+
+
+def get_pipeline_model_parallel_group() -> str:
+    return get_state().pipeline_axis
+
+
+def get_data_parallel_group() -> str:
+    return get_state().data_axis
+
+
+def get_model_parallel_group() -> tuple:
+    """TP x PP combined (ref: _MODEL_PARALLEL_GROUP)."""
+    s = get_state()
+    return (s.pipeline_axis, s.tensor_axis)
+
+
+# -- world sizes (static, callable anywhere) ------------------------------
+
+def get_tensor_model_parallel_world_size() -> int:
+    s = get_state()
+    return s.axis_size(s.tensor_axis)
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    s = get_state()
+    return s.axis_size(s.pipeline_axis)
+
+
+def get_data_parallel_world_size() -> int:
+    s = get_state()
+    return s.axis_size(s.data_axis)
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return get_state().virtual_pipeline_model_parallel_size
+
+
+# -- ranks (traced; inside mapped computations only) -----------------------
+
+def get_tensor_model_parallel_rank():
+    s = get_state()
+    return lax.axis_index(s.tensor_axis)
+
+
+def get_pipeline_model_parallel_rank():
+    s = get_state()
+    return lax.axis_index(s.pipeline_axis)
+
+
+def get_data_parallel_rank():
+    s = get_state()
+    return lax.axis_index(s.data_axis)
+
+
+def get_tensor_model_parallel_src_rank() -> int:
+    """Ref: global rank of tp-rank-0 in my TP group. Under SPMD the src is
+    simply index 0 along the tensor axis."""
+    return 0
+
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    """Traced bool. Ref: parallel_state.py::is_pipeline_first_stage."""
+    s = get_state()
+    first = lax.axis_index(s.pipeline_axis) == 0
+    if not ignore_virtual and s.virtual_pipeline_model_parallel_size is not None:
+        if s.virtual_pipeline_model_parallel_rank != 0:
+            return first & False
+    return first
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    s = get_state()
+    last = lax.axis_index(s.pipeline_axis) == s.axis_size(s.pipeline_axis) - 1
+    if not ignore_virtual and s.virtual_pipeline_model_parallel_size is not None:
+        vp = s.virtual_pipeline_model_parallel_size
+        if s.virtual_pipeline_model_parallel_rank != vp - 1:
+            return last & False
+    return last
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: Optional[int]) -> None:
+    get_state().virtual_pipeline_model_parallel_rank = rank
+
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    return get_state().virtual_pipeline_model_parallel_rank
+
+
+def get_pipeline_model_parallel_split_rank() -> Optional[int]:
+    return get_state().pipeline_model_parallel_split_rank

@@ -1,0 +1,33 @@
+"""TP data broadcast. Ref: apex/transformer/tensor_parallel/data.py::broadcast_data.
+
+The reference moves each batch from tp-rank-0 to the rest of the TP group
+(other ranks pass dummy tensors). Under SPMD input batches are *already*
+replicated (or sharded) by the sharding of the input arrays, so the common
+case is the identity. ``broadcast_data`` exists for shard_map code that
+constructs rank-divergent values and needs the reference's semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def broadcast_data(keys: Sequence[str], data: Mapping[str, jax.Array], dtype=None,
+                   axis: str = "model"):
+    """Every rank gets tp-rank-0's value for each key.
+
+    Shapes must match across ranks (the reference ships size metadata first
+    for the same reason; under SPMD shapes are static so that step is free).
+    """
+    out = {}
+    for k in keys:
+        x = data[k]
+        if dtype is not None:
+            x = x.astype(dtype)
+        idx = lax.axis_index(axis)
+        out[k] = lax.psum(jnp.where(idx == 0, x, jnp.zeros_like(x)), axis)
+    return out

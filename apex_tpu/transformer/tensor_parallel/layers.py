@@ -1,0 +1,255 @@
+"""Tensor-parallel layers.
+
+Ref: apex/transformer/tensor_parallel/layers.py::VocabParallelEmbedding,
+::ColumnParallelLinear, ::RowParallelLinear,
+::LinearWithGradAccumulationAndAsyncCommunication.
+
+Two API levels, both first-class:
+
+1. **Functional, shard-local** (``column_parallel_linear`` & co.): run inside
+   a ``shard_map`` body over the tensor axis with explicitly sharded weight
+   shards — the direct analog of the reference's per-rank modules, and the
+   form the parity tests pin down collective-by-collective.
+2. **Flax modules** (``ColumnParallelLinear`` & co.): GSPMD-style modules
+   whose params carry ``nn.with_partitioning`` metadata; under pjit on a
+   mesh, XLA inserts the same collectives automatically.
+
+Reference knobs with no TPU analog (documented, accepted, ignored):
+  * ``async_tensor_model_parallel_allreduce`` / the side-stream overlap in
+    LinearWithGradAccumulationAndAsyncCommunication — XLA's async
+    collectives overlap comm with the wgrad matmul without manual streams.
+  * ``gradient_accumulation_fusion`` (fused_weight_gradient_mlp_cuda's fp32
+    main_grad accumulation) — weight-grad matmuls here always accumulate in
+    fp32 on the MXU (``preferred_element_type``); cross-microbatch
+    accumulation in fp32 is the optimizer/master-weights engine's job.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.mesh import MODEL_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+try:
+    import flax.linen as nn
+
+    _HAVE_FLAX = True
+except ImportError:  # pragma: no cover
+    _HAVE_FLAX = False
+
+
+def _matmul(x, kernel):
+    """Shard-local GEMM with fp32 MXU accumulation, result in input dtype."""
+    return jnp.matmul(x, kernel, preferred_element_type=jnp.float32).astype(
+        jnp.result_type(x, kernel)
+    )
+
+
+# -- functional (shard_map-local) forms -----------------------------------
+
+def column_parallel_linear(
+    x,
+    kernel,
+    bias=None,
+    *,
+    axis: str = MODEL_AXIS,
+    gather_output: bool = True,
+    sequence_parallel_enabled: bool = False,
+):
+    """Y = XA + b with A column-split: local ``kernel`` is [in, out/tp].
+
+    Ref: layers.py::ColumnParallelLinear.forward. With
+    ``sequence_parallel_enabled`` the input arrives seq-sharded [s/tp, b, in]
+    and is all-gathered here (bwd: reduce-scatter) — Megatron SP.
+    """
+    if sequence_parallel_enabled:
+        if gather_output:
+            raise ValueError(
+                "gather_output is incompatible with sequence parallelism (ref "
+                "asserts the same)"
+            )
+        x = gather_from_sequence_parallel_region(
+            x, axis, True  # tensor_parallel_output_grad
+        )
+    else:
+        x = copy_to_tensor_model_parallel_region(x, axis)
+    y = _matmul(x, kernel)
+    if bias is not None:
+        y = y + bias
+    if gather_output:
+        y = gather_from_tensor_model_parallel_region(y, axis)
+    return y
+
+
+def row_parallel_linear(
+    x,
+    kernel,
+    bias=None,
+    *,
+    axis: str = MODEL_AXIS,
+    input_is_parallel: bool = True,
+    sequence_parallel_enabled: bool = False,
+):
+    """Y = XA + b with A row-split: local ``kernel`` is [in/tp, out].
+
+    Ref: layers.py::RowParallelLinear.forward. The local GEMM yields partial
+    sums; they are all-reduced (or reduce-scattered along seq under SP).
+    Bias is added *after* the reduction, once, like the reference.
+    """
+    if not input_is_parallel:
+        if sequence_parallel_enabled:
+            raise ValueError(
+                "sequence parallelism requires input_is_parallel (ref asserts)"
+            )
+        x = scatter_to_tensor_model_parallel_region(x, axis)
+    y_partial = _matmul(x, kernel)
+    if sequence_parallel_enabled:
+        y = reduce_scatter_to_sequence_parallel_region(y_partial, axis)
+    else:
+        y = reduce_from_tensor_model_parallel_region(y_partial, axis)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def vocab_parallel_embedding(ids, table, *, axis: str = MODEL_AXIS):
+    """Embedding lookup over a vocab-split table: local ``table`` is
+    [vocab/tp, h]; out-of-range ids contribute zero and the partial
+    embeddings are all-reduced.
+
+    Ref: layers.py::VocabParallelEmbedding.forward (mask input, zero masked
+    rows, reduce_from_tensor_model_parallel_region).
+    """
+    n_local = table.shape[0]
+    start = lax.axis_index(axis) * n_local
+    local = ids - start
+    in_range = (local >= 0) & (local < n_local)
+    safe = jnp.clip(local, 0, n_local - 1)
+    emb = jnp.take(table, safe, axis=0)
+    emb = jnp.where(in_range[..., None], emb, 0)
+    return reduce_from_tensor_model_parallel_region(emb, axis)
+
+
+# -- flax/GSPMD modules ----------------------------------------------------
+
+if _HAVE_FLAX:
+
+    def _init(fn, spec):
+        return nn.with_partitioning(fn, spec)
+
+    class ColumnParallelLinear(nn.Module):
+        """GSPMD ColumnParallelLinear: kernel sharded (None, "model").
+
+        Under pjit over a mesh with a "model" axis, XLA derives the same
+        collectives the functional form issues explicitly. ``gather_output``
+        is expressed as an output sharding constraint.
+        """
+
+        features: int
+        use_bias: bool = True
+        gather_output: bool = True
+        dtype: Any = None
+        param_dtype: Any = jnp.float32
+        kernel_init: Callable = nn.initializers.lecun_normal()
+        bias_init: Callable = nn.initializers.zeros_init()
+        axis: str = MODEL_AXIS
+
+        @nn.compact
+        def __call__(self, x):
+            kernel = self.param(
+                "kernel",
+                _init(self.kernel_init, (None, self.axis)),
+                (x.shape[-1], self.features),
+                self.param_dtype,
+            )
+            bias = (
+                self.param(
+                    "bias",
+                    _init(self.bias_init, (self.axis,)),
+                    (self.features,),
+                    self.param_dtype,
+                )
+                if self.use_bias
+                else None
+            )
+            x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)[:2]
+            y = _matmul(x, kernel)
+            if bias is not None:
+                y = y + bias.astype(y.dtype)
+            # gather_output=False leaves y sharded (.., "model") — which GSPMD
+            # already derives from the kernel sharding; gather_output=True is a
+            # replication constraint so downstream non-parallel ops see full y.
+            if self.gather_output:
+                mesh = jax.sharding.get_abstract_mesh()
+                if mesh is not None and not mesh.empty:
+                    y = jax.lax.with_sharding_constraint(
+                        y, jax.sharding.PartitionSpec()
+                    )
+            return y
+
+    class RowParallelLinear(nn.Module):
+        """GSPMD RowParallelLinear: kernel sharded ("model", None)."""
+
+        features: int
+        use_bias: bool = True
+        input_is_parallel: bool = True
+        dtype: Any = None
+        param_dtype: Any = jnp.float32
+        kernel_init: Callable = nn.initializers.lecun_normal()
+        bias_init: Callable = nn.initializers.zeros_init()
+        axis: str = MODEL_AXIS
+
+        @nn.compact
+        def __call__(self, x):
+            kernel = self.param(
+                "kernel",
+                _init(self.kernel_init, (self.axis, None)),
+                (x.shape[-1], self.features),
+                self.param_dtype,
+            )
+            bias = (
+                self.param(
+                    "bias", self.bias_init, (self.features,), self.param_dtype
+                )
+                if self.use_bias
+                else None
+            )
+            x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)[:2]
+            y = _matmul(x, kernel)
+            if bias is not None:
+                y = y + bias.astype(y.dtype)
+            return y
+
+    class VocabParallelEmbedding(nn.Module):
+        """GSPMD vocab-parallel embedding: table sharded ("model", None)."""
+
+        num_embeddings: int
+        features: int
+        dtype: Any = None
+        param_dtype: Any = jnp.float32
+        embedding_init: Callable = nn.initializers.normal(stddev=1.0)
+        axis: str = MODEL_AXIS
+
+        @nn.compact
+        def __call__(self, ids):
+            table = self.param(
+                "embedding",
+                _init(self.embedding_init, (self.axis, None)),
+                (self.num_embeddings, self.features),
+                self.param_dtype,
+            )
+            (table,) = nn.dtypes.promote_dtype(table, dtype=self.dtype)
+            return jnp.take(table, ids, axis=0)

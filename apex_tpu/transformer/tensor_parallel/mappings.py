@@ -1,0 +1,185 @@
+"""Autograd-transparent TP collectives.
+
+Ref: apex/transformer/tensor_parallel/mappings.py — the _CopyToModelParallel
+Region / _ReduceFromModelParallelRegion / _ScatterToModelParallelRegion /
+_GatherFromModelParallelRegion autograd.Functions plus the three
+sequence-parallel region functions.
+
+Each mapping is a ``jax.custom_vjp`` whose forward and backward are the
+conjugate collective pair the reference hand-writes:
+
+  copy     : fwd identity      / bwd all-reduce
+  reduce   : fwd all-reduce    / bwd identity
+  scatter  : fwd split last dim/ bwd all-gather last dim
+  gather   : fwd all-gather    / bwd split last dim
+  SP scatter        : fwd split seq dim       / bwd all-gather seq dim
+  SP gather         : fwd all-gather seq dim  / bwd reduce-scatter (or split)
+  SP reduce-scatter : fwd reduce-scatter seq  / bwd all-gather seq dim
+
+All functions take the mesh axis name where the reference takes an implicit
+process group, and must run inside a shard_map/pmap body. The sequence
+dimension is dim 0 ([s, b, h] layout), matching the reference.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+
+SEQ_DIM = 0  # reference uses sequence-first [s, b, h] activations
+
+
+def _split_along(x, axis: str, dim: int):
+    """This rank's equal chunk of ``x`` along ``dim``."""
+    n = lax.axis_size(axis)
+    if x.shape[dim] % n:
+        raise ValueError(f"dim {dim} size {x.shape[dim]} not divisible by {n}")
+    chunk = x.shape[dim] // n
+    return lax.dynamic_slice_in_dim(x, lax.axis_index(axis) * chunk, chunk, dim)
+
+
+def _all_gather(x, axis: str, dim: int):
+    return lax.all_gather(x, axis, axis=dim, tiled=True)
+
+
+def _reduce_scatter(x, axis: str, dim: int):
+    return lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=True)
+
+
+# -- copy: identity fwd, all-reduce bwd -----------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_model_parallel_region(x, axis: str):
+    """Ref: mappings.py::copy_to_tensor_model_parallel_region."""
+    return x
+
+
+def _copy_fwd(x, axis):
+    return x, None
+
+
+def _copy_bwd(axis, _, g):
+    return (lax.psum(g, axis),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+# -- reduce: all-reduce fwd, identity bwd ---------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_model_parallel_region(x, axis: str):
+    """Ref: mappings.py::reduce_from_tensor_model_parallel_region."""
+    return lax.psum(x, axis)
+
+
+def _reduce_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _reduce_bwd(axis, _, g):
+    return (g,)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+# -- scatter/gather along the last (hidden) dim ---------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_tensor_model_parallel_region(x, axis: str):
+    """Ref: mappings.py::scatter_to_tensor_model_parallel_region."""
+    return _split_along(x, axis, x.ndim - 1)
+
+
+def _scatter_fwd(x, axis):
+    return _split_along(x, axis, x.ndim - 1), None
+
+
+def _scatter_bwd(axis, _, g):
+    return (_all_gather(g, axis, g.ndim - 1),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_model_parallel_region(x, axis: str):
+    """Ref: mappings.py::gather_from_tensor_model_parallel_region."""
+    return _all_gather(x, axis, x.ndim - 1)
+
+
+def _gather_fwd(x, axis):
+    return _all_gather(x, axis, x.ndim - 1), None
+
+
+def _gather_bwd(axis, _, g):
+    return (_split_along(g, axis, g.ndim - 1),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+# -- sequence-parallel regions (seq dim 0) --------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_sequence_parallel_region(x, axis: str):
+    """Ref: mappings.py::scatter_to_sequence_parallel_region."""
+    return _split_along(x, axis, SEQ_DIM)
+
+
+def _sp_scatter_fwd(x, axis):
+    return _split_along(x, axis, SEQ_DIM), None
+
+
+def _sp_scatter_bwd(axis, _, g):
+    return (_all_gather(g, axis, SEQ_DIM),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(
+    x, axis: str, tensor_parallel_output_grad: bool = True
+):
+    """Ref: mappings.py::gather_from_sequence_parallel_region.
+
+    ``tensor_parallel_output_grad=True`` (the ColumnParallel input path):
+    the gathered activation feeds a tensor-parallel matmul, so the incoming
+    grad is a *partial sum* per rank and the backward is a reduce-scatter.
+    False: the grad is replicated and the backward is a plain split.
+    """
+    return _all_gather(x, axis, SEQ_DIM)
+
+
+def _sp_gather_fwd(x, axis, tensor_parallel_output_grad):
+    return _all_gather(x, axis, SEQ_DIM), None
+
+
+def _sp_gather_bwd(axis, tensor_parallel_output_grad, _, g):
+    if tensor_parallel_output_grad:
+        return (_reduce_scatter(g, axis, SEQ_DIM),)
+    return (_split_along(g, axis, SEQ_DIM),)
+
+
+gather_from_sequence_parallel_region.defvjp(_sp_gather_fwd, _sp_gather_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_scatter_to_sequence_parallel_region(x, axis: str):
+    """Ref: mappings.py::reduce_scatter_to_sequence_parallel_region."""
+    return _reduce_scatter(x, axis, SEQ_DIM)
+
+
+def _sp_rs_fwd(x, axis):
+    return _reduce_scatter(x, axis, SEQ_DIM), None
+
+
+def _sp_rs_bwd(axis, _, g):
+    return (_all_gather(g, axis, SEQ_DIM),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_sp_rs_fwd, _sp_rs_bwd)

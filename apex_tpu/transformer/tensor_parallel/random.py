@@ -1,0 +1,109 @@
+"""Model-parallel RNG streams + activation checkpointing.
+
+Ref: apex/transformer/tensor_parallel/random.py::CudaRNGStatesTracker,
+::model_parallel_cuda_manual_seed, ::CheckpointFunction.
+
+The reference juggles mutable per-device CUDA RNG states: a "default" state
+shared across TP ranks (so e.g. data augmentations agree) and a
+"model-parallel-rng" state offset by tp rank (so dropout masks *differ*
+across TP ranks but match across DP). With JAX's counter-based PRNG the same
+contract is a pure key-derivation spec — frozen here because checkpoint/
+resume and dropout-parity tests depend on it:
+
+  default key        = PRNGKey(seed)
+  model-parallel key = fold_in(PRNGKey(seed + 2718), tp_rank)
+
+(2718 mirrors the reference's ``offset = seed + 2718``.)
+
+``checkpoint`` is ``jax.checkpoint``: XLA replays the *same* fold_in chain
+during recomputation, so the RNG-replay machinery the reference needs
+(fork/restore around recompute) is automatic.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import NamedTuple
+
+import jax
+from jax import lax
+
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+_MODEL_PARALLEL_SEED_OFFSET = 2718  # ref: model_parallel_cuda_manual_seed
+
+
+class ModelParallelKeys(NamedTuple):
+    """The two streams the reference tracks (see module docstring)."""
+
+    default: jax.Array
+    model_parallel: jax.Array
+
+
+def model_parallel_seed(seed: int, axis: str = "model") -> ModelParallelKeys:
+    """Derive the two PRNG streams for this rank. Must run where ``axis`` is
+    bound (shard_map body). Ref: random.py::model_parallel_cuda_manual_seed."""
+    default = jax.random.PRNGKey(seed)
+    mp = jax.random.fold_in(
+        jax.random.PRNGKey(seed + _MODEL_PARALLEL_SEED_OFFSET),
+        lax.axis_index(axis),
+    )
+    return ModelParallelKeys(default=default, model_parallel=mp)
+
+
+class RNGStatesTracker:
+    """API-parity shim for CudaRNGStatesTracker.
+
+    Holds named key streams; ``fork(name)`` yields a fresh subkey and
+    advances the stream. This is trace-time Python bookkeeping over traced
+    keys — deterministic, and replayed identically under ``jax.checkpoint``
+    recomputation (which is exactly the fork/restore semantics the
+    reference implements manually).
+    """
+
+    def __init__(self):
+        self.states_ = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name: str, key) -> None:
+        if name in self.states_:
+            raise ValueError(f"rng state {name} already present")
+        if isinstance(key, int):
+            key = jax.random.PRNGKey(key)
+        self.states_[name] = key
+
+    @contextlib.contextmanager
+    def fork(self, name: str = _MODEL_PARALLEL_RNG_TRACKER_NAME):
+        if name not in self.states_:
+            raise ValueError(f"rng state {name} is not added")
+        self.states_[name], sub = jax.random.split(self.states_[name])
+        yield sub
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_cuda_rng_tracker() -> RNGStatesTracker:
+    """Name kept for mechanical ports (ref: random.py::get_cuda_rng_tracker)."""
+    return _tracker
+
+
+def model_parallel_manual_seed(seed: int, axis: str = "model") -> ModelParallelKeys:
+    """Seed the global tracker (ref: model_parallel_cuda_manual_seed)."""
+    keys = model_parallel_seed(seed, axis)
+    _tracker.reset()
+    _tracker.add(_MODEL_PARALLEL_RNG_TRACKER_NAME, keys.model_parallel)
+    return keys
+
+
+# Activation recomputation. Ref: random.py::CheckpointFunction — fwd under
+# no_grad + RNG snapshot, bwd replays with restored RNG. jax.checkpoint gives
+# both (recompute on bwd; PRNG ops replay deterministically).
+checkpoint = jax.checkpoint

@@ -1,0 +1,64 @@
+"""TP shape utilities. Ref: apex/transformer/tensor_parallel/utils.py and
+apex/transformer/utils.py (divide, split_tensor_along_last_dim, VocabUtility,
+split_tensor_into_1d_equal_chunks / gather_split_1d_tensor)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    """Ref: utils.py::ensure_divisibility."""
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    """Ref: utils.py::divide."""
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(x, num_partitions: int) -> Sequence:
+    """Ref: utils.py::split_tensor_along_last_dim (contiguous flag is a torch
+    detail with no XLA analog)."""
+    ensure_divisibility(x.shape[-1], num_partitions)
+    return jnp.split(x, num_partitions, axis=-1)
+
+
+def split_tensor_into_1d_equal_chunks(x, axis: str):
+    """Ref: apex/transformer/utils.py::split_tensor_into_1d_equal_chunks —
+    this rank's flat chunk (the p2p scatter-gather optimization)."""
+    flat = x.reshape(-1)
+    n = lax.axis_size(axis)
+    chunk = divide(flat.shape[0], n)
+    return lax.dynamic_slice_in_dim(flat, lax.axis_index(axis) * chunk, chunk)
+
+
+def gather_split_1d_tensor(x, axis: str):
+    """Ref: apex/transformer/utils.py::gather_split_1d_tensor."""
+    return lax.all_gather(x, axis, axis=0, tiled=True)
+
+
+class VocabUtility:
+    """Ref: tensor_parallel/utils.py::VocabUtility — [first, last) vocab range
+    owned by a partition."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+        per_partition_vocab_size: int, rank
+    ) -> Tuple:
+        first = rank * per_partition_vocab_size
+        return first, first + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(
+        global_vocab_size: int, rank, world_size: int
+    ) -> Tuple:
+        per_partition = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per_partition, rank
+        )

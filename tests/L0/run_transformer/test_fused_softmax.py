@@ -1,0 +1,69 @@
+"""FusedScaleMaskSoftmax wrapper.
+
+Ref: tests/L0/run_transformer/test_fused_softmax.py — fused kernel path vs
+the torch fallback path must agree; here vs explicit jnp references.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.transformer import AttnMaskType, FusedScaleMaskSoftmax
+
+
+def _rand_logits(shape, dtype=jnp.float32, seed=0):
+    return (jax.random.normal(jax.random.PRNGKey(seed), shape) * 3).astype(dtype)
+
+
+def test_causal():
+    x = _rand_logits((2, 4, 8, 8), jnp.bfloat16)
+    sm = FusedScaleMaskSoftmax(
+        input_in_bf16=True, attn_mask_type=AttnMaskType.causal, scale=0.5
+    )
+    out = sm(x)
+    assert out.dtype == jnp.bfloat16
+
+    x32 = x.astype(jnp.float32) * 0.5
+    mask = np.triu(np.ones((8, 8), bool), k=1)
+    x32 = jnp.where(mask, -10000.0, x32)
+    ref = jax.nn.softmax(x32, axis=-1)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), rtol=2e-2, atol=2e-3
+    )
+    # causal rows attend only to the lower triangle
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32)[..., 0, 1:], 0.0, atol=1e-3
+    )
+
+
+def test_padding_mask():
+    x = _rand_logits((2, 2, 4, 6))
+    mask = jnp.zeros((2, 1, 4, 6), bool).at[:, :, :, -2:].set(True)
+    sm = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.padding)
+    out = sm(x, mask)
+    # masked keys get ~0 probability
+    assert float(jnp.max(out[..., -2:])) < 1e-3
+    np.testing.assert_allclose(np.asarray(out.sum(-1)), 1.0, rtol=1e-5)
+
+
+def test_no_mask_is_plain_softmax():
+    x = _rand_logits((3, 5))
+    sm = FusedScaleMaskSoftmax()
+    np.testing.assert_allclose(
+        np.asarray(sm(x)), np.asarray(jax.nn.softmax(x, -1)), rtol=1e-6
+    )
+
+
+def test_ctor_validation():
+    with pytest.raises(ValueError):
+        FusedScaleMaskSoftmax(input_in_fp16=True, input_in_bf16=True)
+    with pytest.raises(ValueError):
+        FusedScaleMaskSoftmax(scale=2.0, softmax_in_fp32=False)
+
+
+def test_is_kernel_available_parity():
+    sm = FusedScaleMaskSoftmax(input_in_fp16=True)
+    assert sm.is_kernel_available(None, 4, 8, 128, 128)
+    sm32 = FusedScaleMaskSoftmax()
+    assert not sm32.is_kernel_available(None, 4, 8, 128, 128)

@@ -1,0 +1,56 @@
+"""MP-aware grad scaler: all model-parallel ranks skip together.
+
+Ref: apex/transformer/amp/grad_scaler.py::GradScaler (found_inf allreduced
+across the model-parallel group).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel.mesh import cpu_mesh
+from apex_tpu.transformer import GradScaler
+
+TP = 4
+AXIS = "model"
+
+
+def test_found_inf_syncs_across_model_ranks(eight_cpu_devices):
+    mesh = cpu_mesh({AXIS: TP})
+    scaler = GradScaler(model_parallel_axes=(AXIS,))
+    state = scaler.init()
+
+    # rank 0's grads overflow, others are clean
+    grads = jnp.ones((TP, 8), jnp.float32)
+    grads = grads.at[0, 3].set(jnp.inf)
+
+    def body(g):
+        local = {"w": g[0]}
+        _, found = scaler.unscale(state, local)
+        return found.astype(jnp.int32).reshape(1)
+
+    found = jax.shard_map(
+        body, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS),
+        check_vma=False,
+    )(grads)
+    # every rank reports overflow, not just rank 0
+    np.testing.assert_array_equal(np.asarray(found), np.ones(TP, np.int32))
+
+
+def test_clean_grads_no_false_positive(eight_cpu_devices):
+    mesh = cpu_mesh({AXIS: TP})
+    scaler = GradScaler(model_parallel_axes=(AXIS,))
+    state = scaler.init()
+    grads = jnp.ones((TP, 8), jnp.float32) * state.scale  # unscale -> 1.0
+
+    def body(g):
+        g32, found = scaler.unscale(state, {"w": g[0]})
+        return found.astype(jnp.int32).reshape(1), g32["w"]
+
+    found, g32 = jax.shard_map(
+        body, mesh=mesh, in_specs=(P(AXIS),),
+        out_specs=(P(AXIS), P(AXIS)), check_vma=False,
+    )(grads)
+    np.testing.assert_array_equal(np.asarray(found), np.zeros(TP, np.int32))
+    np.testing.assert_allclose(np.asarray(g32), 1.0, rtol=1e-6)

@@ -1,0 +1,59 @@
+"""Microbatch calculators. Ref: tests/L0/run_transformer/test_microbatches.py."""
+
+import pytest
+
+from apex_tpu.transformer import build_num_microbatches_calculator
+
+
+def test_constant():
+    c = build_num_microbatches_calculator(
+        global_batch_size=64, micro_batch_size=4, data_parallel_size=2
+    )
+    assert c.get() == 8
+    assert c.get_current_global_batch_size() == 64
+    c.update(10_000, True)  # no-op
+    assert c.get() == 8
+
+
+def test_constant_divisibility_error():
+    with pytest.raises(ValueError):
+        build_num_microbatches_calculator(
+            global_batch_size=65, micro_batch_size=4, data_parallel_size=2
+        )
+
+
+def test_rampup():
+    c = build_num_microbatches_calculator(
+        rampup_batch_size=[16, 16, 48],
+        global_batch_size=64,
+        micro_batch_size=4,
+        data_parallel_size=1,
+    )
+    # ramp: 3 increments over 48 samples -> one every 16 samples
+    c.update(0, True)
+    assert c.get_current_global_batch_size() == 16
+    assert c.get() == 4
+    c.update(16, True)
+    assert c.get_current_global_batch_size() == 32
+    c.update(32, True)
+    assert c.get_current_global_batch_size() == 48
+    c.update(49, True)
+    assert c.get_current_global_batch_size() == 64
+    assert c.get() == 16
+
+
+def test_rampup_bad_spec():
+    with pytest.raises(ValueError):
+        build_num_microbatches_calculator(
+            rampup_batch_size=[16, 16],
+            global_batch_size=64,
+            micro_batch_size=4,
+            data_parallel_size=1,
+        )
+    with pytest.raises(ValueError):
+        build_num_microbatches_calculator(
+            rampup_batch_size=[16, 10, 48],  # (64-16) % 10 != 0
+            global_batch_size=64,
+            micro_batch_size=4,
+            data_parallel_size=1,
+        )

@@ -1,0 +1,107 @@
+"""Parallel-state topology bookkeeping.
+
+Ref: tests/L0/run_transformer/test_parallel_state.py — world sizes / ranks /
+first-last-stage predicates across (tp, pp) grids.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel.mesh import cpu_devices
+from apex_tpu.transformer import parallel_state
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    yield
+    parallel_state.destroy_model_parallel()
+
+
+def test_world_sizes_and_dp_inference(eight_cpu_devices):
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=2,
+        pipeline_model_parallel_size=2,
+        devices=cpu_devices(8),
+    )
+    assert parallel_state.model_parallel_is_initialized()
+    assert parallel_state.get_tensor_model_parallel_world_size() == 2
+    assert parallel_state.get_pipeline_model_parallel_world_size() == 2
+    assert parallel_state.get_data_parallel_world_size() == 2  # 8/(2*2)
+    assert parallel_state.get_tensor_model_parallel_group() == "model"
+    assert parallel_state.get_pipeline_model_parallel_group() == "stage"
+    assert parallel_state.get_data_parallel_group() == "data"
+    assert parallel_state.get_model_parallel_group() == ("stage", "model")
+
+
+def test_uninitialized_raises():
+    parallel_state.destroy_model_parallel()
+    assert not parallel_state.model_parallel_is_initialized()
+    with pytest.raises(RuntimeError):
+        parallel_state.get_tensor_model_parallel_world_size()
+
+
+def test_virtual_pp_requires_pp(eight_cpu_devices):
+    with pytest.raises(ValueError):
+        parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size=1,
+            pipeline_model_parallel_size=1,
+            virtual_pipeline_model_parallel_size=2,
+            devices=cpu_devices(8),
+        )
+
+
+def test_ranks_inside_shard_map(eight_cpu_devices):
+    st = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=2,
+        pipeline_model_parallel_size=2,
+        devices=cpu_devices(8),
+    )
+    mesh = st.mesh
+
+    def body():
+        # scalars get singleton dims so out_specs can lay them on the mesh
+        return tuple(
+            v.reshape(1, 1, 1)
+            for v in (
+                parallel_state.get_tensor_model_parallel_rank(),
+                parallel_state.get_pipeline_model_parallel_rank(),
+                parallel_state.get_data_parallel_rank(),
+                parallel_state.is_pipeline_first_stage().astype(jnp.int32),
+                parallel_state.is_pipeline_last_stage().astype(jnp.int32),
+            )
+        )
+
+    tp, pp, dp, first, last = jax.shard_map(
+        body, mesh=mesh, in_specs=(),
+        out_specs=P("stage", "data", "model"), check_vma=False,
+    )()
+    # mesh layout ("stage","data","model") = (2,2,2): axis_index patterns
+    np.testing.assert_array_equal(np.asarray(tp).ravel(), [0, 1] * 4)
+    np.testing.assert_array_equal(
+        np.asarray(pp).ravel(), [0, 0, 0, 0, 1, 1, 1, 1]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(dp).ravel(), [0, 0, 1, 1, 0, 0, 1, 1]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(first).ravel(), [1, 1, 1, 1, 0, 0, 0, 0]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(last).ravel(), [0, 0, 0, 0, 1, 1, 1, 1]
+    )
+
+
+def test_virtual_pipeline_rank_bookkeeping(eight_cpu_devices):
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=1,
+        pipeline_model_parallel_size=2,
+        virtual_pipeline_model_parallel_size=2,
+        devices=cpu_devices(8),
+    )
+    assert parallel_state.get_virtual_pipeline_model_parallel_world_size() == 2
+    assert parallel_state.get_virtual_pipeline_model_parallel_rank() == 0
+    parallel_state.set_virtual_pipeline_model_parallel_rank(1)
+    assert parallel_state.get_virtual_pipeline_model_parallel_rank() == 1

@@ -1,0 +1,87 @@
+"""Model-parallel RNG spec.
+
+Ref: tests/L0/run_transformer/test_random.py — tracker fork/restore, seeds
+differ across TP ranks for the model-parallel stream, match for default.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel.mesh import cpu_mesh
+from apex_tpu.transformer.tensor_parallel import random as tp_random
+
+TP = 4
+AXIS = "model"
+
+
+def test_model_parallel_seed_streams(eight_cpu_devices):
+    mesh = cpu_mesh({AXIS: TP})
+
+    def body():
+        keys = tp_random.model_parallel_seed(123, AXIS)
+        # draw from both streams
+        d = jax.random.normal(keys.default, (4,))
+        m = jax.random.normal(keys.model_parallel, (4,))
+        return d, m
+
+    d, m = jax.shard_map(
+        body, mesh=mesh, in_specs=(), out_specs=P(AXIS), check_vma=False
+    )()
+    d = np.asarray(d).reshape(TP, 4)
+    m = np.asarray(m).reshape(TP, 4)
+    # default stream identical across ranks
+    for r in range(1, TP):
+        np.testing.assert_array_equal(d[0], d[r])
+    # model-parallel stream distinct across ranks
+    for a in range(TP):
+        for b in range(a + 1, TP):
+            assert not np.array_equal(m[a], m[b])
+
+
+def test_tracker_fork_advances_and_is_deterministic():
+    t1 = tp_random.RNGStatesTracker()
+    t1.add("model-parallel-rng", 7)
+    with t1.fork("model-parallel-rng") as k1:
+        v1 = jax.random.normal(k1, (3,))
+    with t1.fork("model-parallel-rng") as k2:
+        v2 = jax.random.normal(k2, (3,))
+    assert not np.array_equal(np.asarray(v1), np.asarray(v2))
+
+    # same seed -> same sequence (checkpoint/replay invariant)
+    t2 = tp_random.RNGStatesTracker()
+    t2.add("model-parallel-rng", 7)
+    with t2.fork("model-parallel-rng") as k1b:
+        v1b = jax.random.normal(k1b, (3,))
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v1b))
+
+
+def test_tracker_errors():
+    t = tp_random.RNGStatesTracker()
+    t.add("a", 0)
+    with pytest.raises(ValueError):
+        t.add("a", 1)
+    with pytest.raises(ValueError):
+        with t.fork("missing"):
+            pass
+
+
+def test_checkpoint_replays_rng():
+    """jax.checkpoint recompute must reproduce identical dropout masks —
+    the invariant the reference's CheckpointFunction RNG fork/restore exists
+    for (random.py::CheckpointFunction)."""
+
+    def layer(x, key):
+        mask = jax.random.bernoulli(key, 0.5, x.shape)
+        return jnp.where(mask, x, 0.0) * 2.0
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (32,))
+
+    plain = jax.grad(lambda x: jnp.sum(layer(x, key) ** 2))(x)
+    ckpt = jax.grad(
+        lambda x: jnp.sum(tp_random.checkpoint(layer)(x, key) ** 2)
+    )(x)
+    np.testing.assert_allclose(np.asarray(plain), np.asarray(ckpt), rtol=1e-6)

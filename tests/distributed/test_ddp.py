@@ -77,6 +77,17 @@ def test_predivide_and_no_average(eight_cpu_devices):
         rtol=1e-6,
     )
 
+    # ref order: predivide applies even without averaging -> sum / factor
+    ddp_pre_nosum = DistributedDataParallel(
+        gradient_average=False, gradient_predivide_factor=2.0
+    )
+    out3 = _run_ddp(mesh, stacked, ddp_pre_nosum, 2)
+    np.testing.assert_allclose(
+        np.asarray(out3["p0"]),
+        np.asarray((per_rank[0]["p0"] + per_rank[1]["p0"]) / 2),
+        rtol=1e-6,
+    )
+
 
 def test_always_fp32_with_bf16_grads(eight_cpu_devices):
     mesh = cpu_mesh({"data": 2})

@@ -154,3 +154,25 @@ def test_convert_syncbn_recurses_containers_and_keeps_axis():
     assert isinstance(conv.layers[1], SyncBatchNorm)
     assert conv.layers[1].feature_axis == 1
     assert isinstance(conv.layers[0], nn.Dense)
+
+
+def test_large_mean_variance_stability(eight_cpu_devices):
+    """Variance must survive |mean| >> std in fp32 (the reason the reference
+    uses Welford kernels, csrc/welford.cu)."""
+    from apex_tpu.parallel.sync_batchnorm import sync_batch_stats
+
+    mesh = cpu_mesh({"data": 2})
+    rng = np.random.default_rng(0)
+    x = (1e4 + rng.normal(0, 1.0, (2, 64, 8))).astype(np.float32)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P("data"),), out_specs=(P(), P()),
+        check_rep=False,
+    )
+    def stats(xb):
+        return sync_batch_stats(xb[0], "data")
+
+    mean, var = stats(jnp.asarray(x))
+    ref_var = x.reshape(-1, 8).astype(np.float64).var(0)
+    np.testing.assert_allclose(np.asarray(var), ref_var, rtol=1e-2)
+    assert np.all(np.asarray(var) > 0)
