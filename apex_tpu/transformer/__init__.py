@@ -6,6 +6,7 @@ groups for a 3D (TP x PP x DP) decomposition; here a single named
 """
 
 from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer import pipeline_parallel
 from apex_tpu.transformer import tensor_parallel
 from apex_tpu.transformer.enums import AttnType, AttnMaskType, LayerType, ModelType
 from apex_tpu.transformer.fused_softmax import (
@@ -21,6 +22,7 @@ from apex_tpu.transformer.microbatches import (
 
 __all__ = [
     "parallel_state",
+    "pipeline_parallel",
     "tensor_parallel",
     "AttnType",
     "AttnMaskType",

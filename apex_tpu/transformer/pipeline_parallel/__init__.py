@@ -1,0 +1,43 @@
+"""Pipeline parallelism, TPU-native.
+
+Ref: apex/transformer/pipeline_parallel/* (SURVEY.md §3.9): schedules
+(no-pipelining / 1F1B / interleaved-virtual), p2p communication over
+``batch_isend_irecv``, and microbatch bookkeeping.
+
+The TPU design replaces per-rank divergent send/recv programs with a single
+SPMD program over the mesh ``stage`` axis: activations circulate around the
+stage ring via ``lax.ppermute`` inside a ``lax.scan`` of pipeline clock
+ticks, and the backward pipeline is obtained by differentiating through the
+scan (the transpose of a ``ppermute`` is the reverse rotation, so
+``jax.grad`` *is* the reverse schedule). See schedules/common.py.
+"""
+
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    get_forward_backward_func,
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving,
+    forward_backward_pipelining_with_interleaving,
+)
+from apex_tpu.transformer.pipeline_parallel import p2p_communication
+from apex_tpu.transformer.pipeline_parallel.utils import (
+    setup_microbatch_calculator,
+    get_num_microbatches,
+    get_micro_batch_size,
+    get_current_global_batch_size,
+    update_num_microbatches,
+    listify_model,
+)
+
+__all__ = [
+    "get_forward_backward_func",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+    "p2p_communication",
+    "setup_microbatch_calculator",
+    "get_num_microbatches",
+    "get_micro_batch_size",
+    "get_current_global_batch_size",
+    "update_num_microbatches",
+    "listify_model",
+]

@@ -1,0 +1,105 @@
+"""Stage-ring point-to-point communication.
+
+Ref: apex/transformer/pipeline_parallel/p2p_communication.py::_communicate
+and its helpers (send_forward, recv_forward, send_forward_recv_backward, …)
+built on ``torch.distributed.batch_isend_irecv`` between pipeline neighbors.
+
+Under SPMD there are no per-rank send/recv programs: a "send to next stage"
+and a "receive from previous stage" are the *same* ``lax.ppermute`` viewed
+from the two ends. Every helper therefore takes the value this stage is
+sending and returns the value this stage receives; stages with no sender
+(stage 0 for a forward recv, the last stage for a backward recv) receive
+zeros, matching the reference where those ranks simply skip the recv.
+
+All helpers must run inside a mapped computation where ``axis`` is bound.
+The reference's scatter-gather p2p optimization (split activation across TP
+ranks before send, all-gather after recv) lives in
+apex_tpu/transformer/tensor_parallel/utils.py::split_tensor_into_1d_equal_chunks
+/ gather_split_1d_tensor and composes with these helpers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax import lax
+
+from apex_tpu.parallel.collectives import axis_size
+
+
+def _fwd_perm(n: int, ring: bool):
+    """(src, dst) pairs moving values to the next stage."""
+    if ring:
+        return [(i, (i + 1) % n) for i in range(n)]
+    return [(i, i + 1) for i in range(n - 1)]
+
+
+def _bwd_perm(n: int, ring: bool):
+    if ring:
+        return [(i, (i - 1) % n) for i in range(n)]
+    return [(i, i - 1) for i in range(1, n)]
+
+
+def _communicate(
+    tensor_send_next=None,
+    tensor_send_prev=None,
+    *,
+    axis: str,
+    ring: bool = False,
+):
+    """Ref: p2p_communication.py::_communicate(tensor_send_next,
+    tensor_send_prev, recv_prev, recv_next, …) -> (recv_prev, recv_next).
+
+    One ``ppermute`` per direction (the SPMD analog of one
+    ``batch_isend_irecv`` group). ``ring=True`` wraps last->first, used by
+    the circulating-pipeline engine; the reference's schedules never wrap.
+    """
+    n = axis_size(axis)
+    tensor_recv_prev = None
+    tensor_recv_next = None
+    if tensor_send_next is not None:
+        tensor_recv_prev = lax.ppermute(tensor_send_next, axis, _fwd_perm(n, ring))
+    if tensor_send_prev is not None:
+        tensor_recv_next = lax.ppermute(tensor_send_prev, axis, _bwd_perm(n, ring))
+    return tensor_recv_prev, tensor_recv_next
+
+
+def send_forward_recv_forward(x, *, axis: str, ring: bool = False):
+    """Send activation to the next stage; return the one arriving from the
+    previous stage. Ref: p2p_communication.py::send_forward /
+    ::recv_forward (one op seen from both ends)."""
+    recv_prev, _ = _communicate(tensor_send_next=x, axis=axis, ring=ring)
+    return recv_prev
+
+
+def send_backward_recv_backward(g, *, axis: str, ring: bool = False):
+    """Send grad to the previous stage; return the one arriving from the
+    next stage. Ref: ::send_backward / ::recv_backward."""
+    _, recv_next = _communicate(tensor_send_prev=g, axis=axis, ring=ring)
+    return recv_next
+
+
+# Reference-named aliases: in SPMD the send half and the recv half of each
+# reference helper collapse into one value-rotation.
+send_forward = send_forward_recv_forward
+recv_forward = send_forward_recv_forward
+send_backward = send_backward_recv_backward
+recv_backward = send_backward_recv_backward
+
+
+def send_forward_recv_backward(x, g, *, axis: str, ring: bool = False):
+    """Ref: ::send_forward_recv_backward — steady-state 1F1B pair."""
+    recv_prev, recv_next = _communicate(
+        tensor_send_next=x, tensor_send_prev=g, axis=axis, ring=ring
+    )
+    return recv_prev, recv_next
+
+
+send_backward_recv_forward = send_forward_recv_backward
+
+
+def send_forward_backward_recv_forward_backward(
+    x, g, *, axis: str, ring: bool = False
+):
+    """Ref: ::send_forward_backward_recv_forward_backward (interleaved)."""
+    return _communicate(tensor_send_next=x, tensor_send_prev=g, axis=axis, ring=ring)

@@ -1,0 +1,46 @@
+"""Schedule selection.
+
+Ref: apex/transformer/pipeline_parallel/schedules/__init__.py::
+get_forward_backward_func — picks no-pipelining / 1F1B / interleaved from
+(virtual) pipeline sizes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    PipelineResult,
+    run_pipeline,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_no_pipelining import (
+    forward_backward_no_pipelining,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_without_interleaving import (  # noqa: E501
+    forward_backward_pipelining_without_interleaving,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_with_interleaving import (  # noqa: E501
+    forward_backward_pipelining_with_interleaving,
+)
+
+
+def get_forward_backward_func(
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    pipeline_model_parallel_size: int = 1,
+):
+    """Ref: schedules/__init__.py::get_forward_backward_func."""
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            return forward_backward_pipelining_with_interleaving
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining
+
+
+__all__ = [
+    "PipelineResult",
+    "run_pipeline",
+    "get_forward_backward_func",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+]

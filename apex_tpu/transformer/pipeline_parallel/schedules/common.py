@@ -1,0 +1,177 @@
+"""The SPMD circulating-pipeline engine shared by all schedules.
+
+Ref: apex/transformer/pipeline_parallel/schedules/common.py (forward_step /
+backward_step / build_model) and the schedule bodies in
+fwd_bwd_pipelining_without_interleaving.py / _with_interleaving.py.
+
+Reference mechanism: each pipeline rank runs a *different* program — warmup
+forwards, steady 1F1B send/recv pairs, cooldown backwards — with manual
+``torch.autograd.backward`` calls stitching grads across ranks.
+
+TPU mechanism (this module): one program on every stage. Time advances in
+pipeline clock ticks inside a ``lax.scan``; each tick every stage
+
+  1. takes the activation arriving on the stage ring (or injects a fresh
+     microbatch at stage 0),
+  2. applies its local model chunk (selected by a tick-derived chunk index,
+     which makes the same loop serve the non-interleaved ``V=1`` and
+     interleaved-virtual ``V>1`` schedules),
+  3. computes the loss when a microbatch completes its final chunk on the
+     last stage (masked elsewhere),
+  4. rotates its output to the next stage with ``lax.ppermute``.
+
+The backward schedule is not hand-written at all: differentiating through
+the scan transposes every ``ppermute`` into the reverse rotation, so
+``jax.value_and_grad`` materializes the cooldown/steady/warmup backward
+phases automatically, with activation rematerialization
+(``jax.checkpoint``) standing in for the reference's
+tensor_parallel/random.py::CheckpointFunction.
+
+Scheduling bookkeeping (derivation used throughout):
+
+  P = stages, V = local chunks per stage, ring period ``rp = P*V``.
+  Microbatch ``m`` enters stage 0 at tick ``e(m) = (m//P)*rp + m%P`` (a wave
+  of P microbatches is injected per ring period — the ring holds at most P
+  live activations). At tick ``t`` the activation residing on stage ``s``
+  has ring offset ``r = (t - s) mod P``, hop ``h = (t - r) mod rp``, local
+  chunk ``k = h // P``, and microbatch ``m = ((t - r)//rp)*P + r``; it is
+  live iff ``m < M``. A microbatch finishes (hop ``rp-1``, necessarily on
+  stage P-1 with chunk V-1) at tick ``e(m) + rp - 1``; total ticks
+  ``T = ceil(M/P)*rp + P - 1``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.collectives import axis_size
+from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
+    send_forward_recv_forward,
+)
+
+StageFn = Callable[[Any, jax.Array], jax.Array]
+LossFn = Callable[[Any, jax.Array, Any], jax.Array]
+
+
+class PipelineResult(NamedTuple):
+    """What a fwd-bwd schedule returns.
+
+    losses: [M] per-microbatch losses, valid on every stage (psum'd over the
+        stage axis), mirroring the reference's ``losses_reduced`` list.
+    stage_grads: grads of this stage's chunk params, stacked [V, ...]
+        (``None`` when forward_only).
+    loss_grads: grads of the loss/head params, psum'd over the stage axis so
+        replicated head params see a consistent grad (``None`` when
+        forward_only or no loss params).
+    outputs: [M, ...] final-chunk outputs (only when collect_outputs; valid
+        on every stage via psum).
+    """
+
+    losses: jax.Array
+    stage_grads: Any = None
+    loss_grads: Any = None
+    outputs: Optional[jax.Array] = None
+
+
+def _chunk(tree, k):
+    return jax.tree.map(
+        lambda a: lax.dynamic_index_in_dim(a, k, 0, keepdims=False), tree
+    )
+
+
+def run_pipeline(
+    stage_fn: StageFn,
+    loss_fn: LossFn,
+    stage_params: Any,
+    loss_params: Any,
+    xs: jax.Array,
+    ys: Any,
+    *,
+    axis: str,
+    forward_only: bool = False,
+    checkpoint_activations: bool = False,
+    collect_outputs: bool = False,
+) -> PipelineResult:
+    """Run the circulating pipeline over ``M = xs.shape[0]`` microbatches.
+
+    stage_params is this stage's chunk stack [V, ...] (V=1 for the
+    non-interleaved schedule). ``xs`` (stage-0 inputs, activation-shaped)
+    and ``ys`` (last-stage targets) are replicated over the stage axis, the
+    analog of the reference broadcasting data to all ranks
+    (tensor_parallel/data.py::broadcast_data).
+
+    stage_fn: (chunk_params, x) -> y with y.shape == x.shape (uniform
+    transformer-block stack; embedding/head run outside or in loss_fn).
+    loss_fn: (loss_params, y, target) -> scalar. Grads are of the *sum* of
+    per-microbatch losses — fold any 1/M normalization into loss_fn.
+    """
+    P = axis_size(axis)
+    V = jax.tree.leaves(stage_params)[0].shape[0]
+    M = xs.shape[0]
+    rp = P * V
+    num_waves = -(-M // P)
+    T = num_waves * rp + P - 1
+
+    f = jax.checkpoint(stage_fn) if checkpoint_activations else stage_fn
+    s = lax.axis_index(axis)
+    on_last = lax.axis_index(axis) == P - 1
+    # Microbatch m finishes (last chunk, last stage) at tick e(m) + rp - 1.
+    finish = jnp.array(
+        [(m // P) * rp + m % P + rp - 1 for m in range(M)], jnp.int32
+    )
+
+    def run(params, lparams):
+        def tick(buf, t):
+            # Stage-0 injection: wave w, slot r_in within the ring period.
+            w_in = t // rp
+            r_in = t % rp
+            m_in = w_in * P + r_in
+            inject = (s == 0) & (r_in < P) & (m_in < M)
+            x = jnp.where(inject, xs[jnp.minimum(m_in, M - 1)], buf)
+            # Which chunk this stage applies this tick (see module docstring).
+            r = (t - s) % P
+            k = ((t - r) % rp) // P
+            y = f(_chunk(params, k), x)
+            buf_next = send_forward_recv_forward(y, axis=axis, ring=True)
+            return buf_next, y
+
+        buf0 = jnp.zeros_like(xs[0])
+        _, tick_y = lax.scan(tick, buf0, jnp.arange(T))
+        finals = tick_y[finish]  # [M, ...] valid on the last stage only
+        # Loss once per microbatch, not per tick (the vocab head is heavy).
+        # Double-where: dead stages evaluate loss_fn at a benign point so
+        # non-finite partials at garbage primals can't leak NaN into the
+        # zero-masked cotangents.
+        y_in = jnp.where(on_last, finals, jnp.ones_like(finals))
+        losses_m = jax.vmap(
+            lambda y, t: loss_fn(lparams, y, t).astype(jnp.float32)
+        )(y_in, ys)
+        losses_m = jnp.where(on_last, losses_m, 0.0)
+        return losses_m.sum(), (losses_m, finals)
+
+    if forward_only:
+        _, (losses_m, finals) = run(stage_params, loss_params)
+        stage_grads = loss_grads = None
+    else:
+        grad_fn = jax.value_and_grad(run, argnums=(0, 1), has_aux=True)
+        (_, (losses_m, finals)), (stage_grads, loss_grads) = grad_fn(
+            stage_params, loss_params
+        )
+        if loss_params is not None and jax.tree.leaves(loss_grads):
+            loss_grads = jax.tree.map(lambda g: lax.psum(g, axis), loss_grads)
+
+    # Replicate the per-microbatch losses (the reference's losses_reduced
+    # list lives on the last stage; we hand every stage a copy).
+    losses = lax.psum(losses_m, axis)
+
+    outputs = None
+    if collect_outputs:
+        outputs = lax.psum(
+            jnp.where(on_last, finals, jnp.zeros_like(finals)), axis
+        )
+
+    return PipelineResult(losses, stage_grads, loss_grads, outputs)

@@ -1,0 +1,80 @@
+"""No-pipelining schedule: sequential microbatch loop with grad accumulation.
+
+Ref: apex/transformer/pipeline_parallel/schedules/fwd_bwd_no_pipelining.py::
+forward_backward_no_pipelining — loops microbatches under a no-grad-sync
+context, accumulating grads; the reference relies on torch grad accumulation,
+here a ``lax.scan`` summing per-microbatch ``value_and_grad`` results (one
+grad buffer live at a time, same memory shape as the reference).
+
+Also the parity oracle for the pipelined schedules (SURVEY.md §5 pattern 3:
+1F1B(loss) == nopipe(loss)).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    LossFn,
+    PipelineResult,
+    StageFn,
+    _chunk,
+)
+
+
+def _compose_chunks(stage_fn, stage_params, x, checkpoint_activations):
+    """Fold the [V, ...] chunk stack in order — the single-stage model."""
+    f = jax.checkpoint(stage_fn) if checkpoint_activations else stage_fn
+
+    def body(h, p):
+        return f(p, h), None
+
+    y, _ = lax.scan(body, x, stage_params)
+    return y
+
+
+def forward_backward_no_pipelining(
+    stage_fn: StageFn,
+    loss_fn: LossFn,
+    stage_params: Any,
+    loss_params: Any,
+    xs: jax.Array,
+    ys: Any,
+    *,
+    axis: str = None,  # unused; signature-compatible with the pipelined schedules
+    forward_only: bool = False,
+    checkpoint_activations: bool = False,
+    collect_outputs: bool = False,
+) -> PipelineResult:
+    M = xs.shape[0]
+
+    def mb_loss(params, lparams, m):
+        y = _compose_chunks(stage_fn, params, xs[m], checkpoint_activations)
+        return loss_fn(lparams, y, _chunk(ys, m)).astype(jnp.float32), y
+
+    if forward_only:
+        def fwd(m):
+            loss, y = mb_loss(stage_params, loss_params, m)
+            return loss, (y if collect_outputs else 0.0)
+
+        losses, outs = lax.map(fwd, jnp.arange(M))
+        return PipelineResult(losses, None, None, outs if collect_outputs else None)
+
+    grad_fn = jax.value_and_grad(mb_loss, argnums=(0, 1), has_aux=True)
+
+    def step(carry, m):
+        gp, gl = carry
+        (loss, y), (gpm, glm) = grad_fn(stage_params, loss_params, m)
+        gp = jax.tree.map(jnp.add, gp, gpm)
+        gl = jax.tree.map(jnp.add, gl, glm)
+        return (gp, gl), (loss, y if collect_outputs else 0.0)
+
+    zeros = lambda t: jax.tree.map(jnp.zeros_like, t)
+    (gp, gl), (losses, outs) = lax.scan(
+        step, (zeros(stage_params), zeros(loss_params)), jnp.arange(M)
+    )
+    return PipelineResult(losses, gp, gl, outs if collect_outputs else None)

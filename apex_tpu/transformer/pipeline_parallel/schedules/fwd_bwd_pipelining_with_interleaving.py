@@ -1,0 +1,58 @@
+"""Interleaved (virtual-pipeline) schedule.
+
+Ref: apex/transformer/pipeline_parallel/schedules/
+fwd_bwd_pipelining_with_interleaving.py::_forward_backward_pipelining_with_
+interleaving — each rank holds ``V`` non-adjacent model chunks (global chunk
+``g`` lives on stage ``g % P`` as local chunk ``g // P``), microbatches
+visit every chunk in global order, and the tighter schedule shrinks the
+pipeline bubble by ~V.
+
+TPU form: the V>1 instantiation of the circulating-ring engine — the ring's
+wrap-around (last stage -> stage 0) *is* the chunk transition, so the
+interleaved dataflow needs no extra machinery beyond a tick-derived chunk
+index (see schedules/common.py's derivation). The bubble shrinks identically:
+total ticks ``ceil(M/P)*P*V + P - 1`` of 1/V-sized chunk steps, i.e. the
+same ``(P-1)/V``-chunk bubble as the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    LossFn,
+    PipelineResult,
+    StageFn,
+    run_pipeline,
+)
+
+
+def forward_backward_pipelining_with_interleaving(
+    stage_fn: StageFn,
+    loss_fn: LossFn,
+    stage_params: Any,
+    loss_params: Any,
+    xs: jax.Array,
+    ys: Any,
+    *,
+    axis: str,
+    forward_only: bool = False,
+    checkpoint_activations: bool = False,
+    collect_outputs: bool = False,
+) -> PipelineResult:
+    """stage_params: this stage's chunk stack [V, ...] in *local chunk
+    order* (local chunk k is global chunk ``k*P + stage``)."""
+    return run_pipeline(
+        stage_fn,
+        loss_fn,
+        stage_params,
+        loss_params,
+        xs,
+        ys,
+        axis=axis,
+        forward_only=forward_only,
+        checkpoint_activations=checkpoint_activations,
+        collect_outputs=collect_outputs,
+    )

@@ -1,0 +1,63 @@
+"""Non-interleaved pipeline schedule (the reference's 1F1B slot).
+
+Ref: apex/transformer/pipeline_parallel/schedules/
+fwd_bwd_pipelining_without_interleaving.py::forward_backward_pipelining_
+without_interleaving — warmup ``pp_size - pp_rank - 1`` forwards, steady
+1F1B send/recv pairs, cooldown backward drain.
+
+TPU form: the V=1 instantiation of the circulating-ring engine
+(schedules/common.py). The warmup/steady/cooldown phasing emerges from the
+ring rotation plus autodiff — stage s sits idle (masked compute) for its
+first s ticks (warmup bubble) and the transposed scan drains backwards
+(cooldown) — rather than being three hand-written loops. Loss/grad parity
+with the reference schedule is exact (same math, same microbatch order);
+the schedule-parity invariant vs no-pipelining is tested in
+tests/L0/run_transformer/test_pipeline_parallel_fwd_bwd.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    LossFn,
+    PipelineResult,
+    StageFn,
+    run_pipeline,
+)
+
+
+def forward_backward_pipelining_without_interleaving(
+    stage_fn: StageFn,
+    loss_fn: LossFn,
+    stage_params: Any,
+    loss_params: Any,
+    xs: jax.Array,
+    ys: Any,
+    *,
+    axis: str,
+    forward_only: bool = False,
+    checkpoint_activations: bool = False,
+    collect_outputs: bool = False,
+) -> PipelineResult:
+    """stage_params: this stage's params, unstacked (single chunk per stage)."""
+    stage_params = jax.tree.map(lambda a: a[None], stage_params)
+    res = run_pipeline(
+        stage_fn,
+        loss_fn,
+        stage_params,
+        loss_params,
+        xs,
+        ys,
+        axis=axis,
+        forward_only=forward_only,
+        checkpoint_activations=checkpoint_activations,
+        collect_outputs=collect_outputs,
+    )
+    if res.stage_grads is not None:
+        res = res._replace(
+            stage_grads=jax.tree.map(lambda a: a[0], res.stage_grads)
+        )
+    return res

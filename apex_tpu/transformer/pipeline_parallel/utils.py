@@ -1,0 +1,112 @@
+"""Pipeline-parallel bookkeeping: the global microbatch calculator and
+shape/model helpers.
+
+Ref: apex/transformer/pipeline_parallel/utils.py — setup_microbatch_
+calculator + _GLOBAL_NUM_MICROBATCHES_CALCULATOR global, get_num_
+microbatches / get_current_global_batch_size / update_num_microbatches,
+listify_model, and tensor-shape inference (seq divided by tp under the
+scatter-gather optimization / sequence parallelism).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+from apex_tpu.transformer.microbatches import (
+    NumMicroBatchesCalculator,
+    build_num_microbatches_calculator,
+)
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR: Optional[NumMicroBatchesCalculator] = None
+_GLOBAL_MICRO_BATCH_SIZE: Optional[int] = None
+
+
+def _ensure(name, value):
+    if value is None:
+        raise RuntimeError(f"{name} is not initialized; call "
+                           "setup_microbatch_calculator() first")
+    return value
+
+
+def setup_microbatch_calculator(
+    rank: int = 0,
+    rampup_batch_size: Optional[Sequence[int]] = None,
+    global_batch_size: int = 1,
+    micro_batch_size: int = 1,
+    data_parallel_size: int = 1,
+) -> None:
+    """Ref: pipeline_parallel/utils.py::setup_microbatch_calculator."""
+    if _GLOBAL_NUM_MICROBATCHES_CALCULATOR is not None:
+        raise RuntimeError("microbatch calculator is already initialized")
+    _reconfigure_microbatch_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size,
+    )
+
+
+def _reconfigure_microbatch_calculator(
+    rank: int = 0,
+    rampup_batch_size: Optional[Sequence[int]] = None,
+    global_batch_size: int = 1,
+    micro_batch_size: int = 1,
+    data_parallel_size: int = 1,
+) -> None:
+    """Ref: ::_reconfigure_microbatch_calculator (tests/finetune resets)."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR, _GLOBAL_MICRO_BATCH_SIZE
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size,
+    )
+    _GLOBAL_MICRO_BATCH_SIZE = micro_batch_size
+
+
+def destroy_microbatch_calculator() -> None:
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR, _GLOBAL_MICRO_BATCH_SIZE
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+    _GLOBAL_MICRO_BATCH_SIZE = None
+
+
+def get_num_microbatches() -> int:
+    """Ref: ::get_num_microbatches."""
+    return _ensure("microbatch calculator",
+                   _GLOBAL_NUM_MICROBATCHES_CALCULATOR).get()
+
+
+def get_current_global_batch_size() -> int:
+    """Ref: ::get_current_global_batch_size."""
+    return _ensure(
+        "microbatch calculator", _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    ).get_current_global_batch_size()
+
+
+def get_micro_batch_size() -> int:
+    return _ensure("micro batch size", _GLOBAL_MICRO_BATCH_SIZE)
+
+
+def update_num_microbatches(consumed_samples: int,
+                            consistency_check: bool = True) -> None:
+    """Ref: ::update_num_microbatches."""
+    _ensure("microbatch calculator", _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+            ).update(consumed_samples, consistency_check)
+
+
+def listify_model(model: Any) -> List[Any]:
+    """Ref: ::listify_model — interleaved schedules carry a list of chunks."""
+    return model if isinstance(model, list) else [model]
+
+
+def get_tensor_shapes(
+    seq_length: int,
+    micro_batch_size: int,
+    hidden_size: int,
+    *,
+    tensor_model_parallel_size: int = 1,
+    sequence_parallel_enabled: bool = False,
+) -> Tuple[int, int, int]:
+    """Inter-stage activation shape [s, b, h]. Ref: the shape bookkeeping in
+    pipeline_parallel/utils.py — seq divided by tp world size under
+    sequence parallelism (and under the scatter-gather p2p optimization)."""
+    if sequence_parallel_enabled:
+        seq_length = divide(seq_length, tensor_model_parallel_size)
+    return (seq_length, micro_batch_size, hidden_size)

@@ -1,0 +1,227 @@
+"""Schedule-parity tests for pipeline parallelism.
+
+Mirrors tests/L0/run_transformer/test_pipeline_parallel_fwd_bwd.py in the
+reference: 1F1B and interleaved losses/grads must equal the no-pipelining
+reference (SURVEY.md §5 pattern 3), here on a hermetic CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel import make_mesh
+from apex_tpu.transformer.pipeline_parallel import (
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+)
+from apex_tpu.transformer.pipeline_parallel import p2p_communication
+from apex_tpu.transformer.pipeline_parallel import utils as pp_utils
+
+HID = 8
+MB = 2  # microbatch size
+
+
+def stage_fn(p, x):
+    h = jnp.tanh(x @ p["w"] + p["b"])
+    return h + x  # residual keeps shapes and signal
+
+
+def loss_fn(lp, y, target):
+    logits = y @ lp["head"]
+    return jnp.mean((logits - target) ** 2)
+
+
+def make_params(key, n_chunks):
+    kw, kh = jax.random.split(key)
+    chunks = {
+        "w": 0.3 * jax.random.normal(kw, (n_chunks, HID, HID), jnp.float32),
+        "b": jnp.zeros((n_chunks, HID), jnp.float32),
+    }
+    lp = {"head": 0.3 * jax.random.normal(kh, (HID, 4), jnp.float32)}
+    return chunks, lp
+
+
+def make_batch(key, m):
+    kx, ky = jax.random.split(key)
+    xs = jax.random.normal(kx, (m, MB, HID), jnp.float32)
+    ys = jax.random.normal(ky, (m, MB, 4), jnp.float32)
+    return xs, ys
+
+
+def reference_run(all_chunks, lp, xs, ys):
+    """Oracle: no-pipelining over the full [P*V] chunk stack."""
+    return forward_backward_no_pipelining(
+        stage_fn, loss_fn, all_chunks, lp, xs, ys, collect_outputs=True
+    )
+
+
+def run_pipelined(schedule, all_chunks, lp, xs, ys, pp, vp, **kw):
+    """Shard chunks onto a pp-stage mesh (global chunk g -> stage g % pp,
+    local slot g // pp) and run the SPMD schedule."""
+    mesh = make_mesh({"stage": pp}, devices=jax.devices("cpu")[:pp])
+    n_chunks = jax.tree.leaves(all_chunks)[0].shape[0]
+    assert n_chunks == pp * vp
+    # reorder [g] -> [s, k] so shard s holds its local chunk stack
+    perm = np.argsort([g % pp * vp + g // pp for g in range(n_chunks)])
+    staged = jax.tree.map(lambda a: a[perm], all_chunks)
+
+    def body(chunks, lp, xs, ys):
+        chunks = jax.tree.map(lambda a: a[0], chunks)  # [1, V, ...] -> [V, ...]
+        if vp == 1 and schedule is forward_backward_pipelining_without_interleaving:
+            chunks = jax.tree.map(lambda a: a[0], chunks)
+        res = schedule(stage_fn, loss_fn, chunks, lp, xs, ys,
+                       axis="stage", **kw)
+        g = res.stage_grads
+        if g is not None:
+            if vp == 1 and schedule is forward_backward_pipelining_without_interleaving:
+                g = jax.tree.map(lambda a: a[None], g)
+            g = jax.tree.map(lambda a: a[None], g)  # re-add stage dim
+        return res.losses, g, res.loss_grads, res.outputs
+
+    shard = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P("stage"), P(), P(), P()),
+        out_specs=(P(), P("stage"), P(), P()),
+        check_vma=False,
+    )
+    staged4 = jax.tree.map(
+        lambda a: a.reshape((pp, vp) + a.shape[1:]), staged
+    )
+    losses, grads, lgrads, outs = shard(staged4, lp, xs, ys)
+    if grads is not None:
+        # [s, V, ...] -> global chunk order [g]
+        inv = np.argsort(perm)
+        grads = jax.tree.map(
+            lambda a: a.reshape((pp * vp,) + a.shape[2:])[inv], grads
+        )
+    return losses, grads, lgrads, outs
+
+
+@pytest.mark.parametrize("pp,m", [(4, 8), (4, 6), (2, 2)])
+def test_1f1b_parity(pp, m):
+    chunks, lp = make_params(jax.random.PRNGKey(0), pp)
+    xs, ys = make_batch(jax.random.PRNGKey(1), m)
+    ref = reference_run(chunks, lp, xs, ys)
+    losses, grads, lgrads, _ = run_pipelined(
+        forward_backward_pipelining_without_interleaving,
+        chunks, lp, xs, ys, pp, 1, collect_outputs=True,
+    )
+    np.testing.assert_allclose(losses, ref.losses, rtol=1e-5, atol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5),
+        grads, ref.stage_grads,
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5),
+        lgrads, ref.loss_grads,
+    )
+
+
+@pytest.mark.parametrize("pp,vp,m", [(2, 2, 4), (2, 2, 6), (4, 2, 8)])
+def test_interleaved_parity(pp, vp, m):
+    chunks, lp = make_params(jax.random.PRNGKey(2), pp * vp)
+    xs, ys = make_batch(jax.random.PRNGKey(3), m)
+    ref = reference_run(chunks, lp, xs, ys)
+    losses, grads, lgrads, _ = run_pipelined(
+        forward_backward_pipelining_with_interleaving,
+        chunks, lp, xs, ys, pp, vp,
+    )
+    np.testing.assert_allclose(losses, ref.losses, rtol=1e-5, atol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5),
+        grads, ref.stage_grads,
+    )
+
+
+def test_forward_only_outputs():
+    pp, m = 4, 8
+    chunks, lp = make_params(jax.random.PRNGKey(4), pp)
+    xs, ys = make_batch(jax.random.PRNGKey(5), m)
+    ref = reference_run(chunks, lp, xs, ys)
+    losses, grads, _, outs = run_pipelined(
+        forward_backward_pipelining_without_interleaving,
+        chunks, lp, xs, ys, pp, 1, forward_only=True, collect_outputs=True,
+    )
+    assert grads is None
+    np.testing.assert_allclose(losses, ref.losses, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs, ref.outputs, rtol=1e-5, atol=1e-5)
+
+
+def test_checkpoint_activations_parity():
+    pp, m = 4, 4
+    chunks, lp = make_params(jax.random.PRNGKey(6), pp)
+    xs, ys = make_batch(jax.random.PRNGKey(7), m)
+    ref = reference_run(chunks, lp, xs, ys)
+    losses, grads, _, _ = run_pipelined(
+        forward_backward_pipelining_without_interleaving,
+        chunks, lp, xs, ys, pp, 1, checkpoint_activations=True,
+    )
+    np.testing.assert_allclose(losses, ref.losses, rtol=1e-5, atol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5),
+        grads, ref.stage_grads,
+    )
+
+
+def test_get_forward_backward_func():
+    assert get_forward_backward_func(None, 1) is forward_backward_no_pipelining
+    assert (get_forward_backward_func(None, 4)
+            is forward_backward_pipelining_without_interleaving)
+    assert (get_forward_backward_func(2, 4)
+            is forward_backward_pipelining_with_interleaving)
+
+
+def test_p2p_ring_shift():
+    n = 4
+    mesh = make_mesh({"stage": n}, devices=jax.devices("cpu")[:n])
+
+    def body(x):
+        x = x.reshape(())
+        fwd = p2p_communication.send_forward_recv_forward(x, axis="stage")
+        bwd = p2p_communication.send_backward_recv_backward(x, axis="stage")
+        ring = p2p_communication.send_forward_recv_forward(
+            x, axis="stage", ring=True
+        )
+        return (fwd.reshape(1), bwd.reshape(1), ring.reshape(1))
+
+    xs = jnp.arange(n, dtype=jnp.float32)
+    fwd, bwd, ring = jax.shard_map(
+        body, mesh=mesh, in_specs=P("stage"),
+        out_specs=(P("stage"), P("stage"), P("stage")),
+        check_vma=False,
+    )(xs)
+    np.testing.assert_array_equal(fwd, [0, 0, 1, 2])   # stage0 recvs zeros
+    np.testing.assert_array_equal(bwd, [1, 2, 3, 0])   # last recvs zeros
+    np.testing.assert_array_equal(ring, [3, 0, 1, 2])
+
+
+def test_microbatch_calculator_globals():
+    pp_utils.destroy_microbatch_calculator()
+    pp_utils.setup_microbatch_calculator(
+        global_batch_size=32, micro_batch_size=2, data_parallel_size=2
+    )
+    assert pp_utils.get_num_microbatches() == 8
+    assert pp_utils.get_current_global_batch_size() == 32
+    assert pp_utils.get_micro_batch_size() == 2
+    with pytest.raises(RuntimeError):
+        pp_utils.setup_microbatch_calculator(global_batch_size=8)
+    pp_utils._reconfigure_microbatch_calculator(
+        global_batch_size=8, micro_batch_size=2, data_parallel_size=1
+    )
+    assert pp_utils.get_num_microbatches() == 4
+    pp_utils.update_num_microbatches(0, consistency_check=False)
+    pp_utils.destroy_microbatch_calculator()
+
+
+def test_tensor_shapes():
+    assert pp_utils.get_tensor_shapes(128, 4, 64) == (128, 4, 64)
+    assert pp_utils.get_tensor_shapes(
+        128, 4, 64, tensor_model_parallel_size=4,
+        sequence_parallel_enabled=True,
+    ) == (32, 4, 64)
+    assert pp_utils.listify_model("m") == ["m"]
