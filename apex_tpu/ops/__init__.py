@@ -6,6 +6,10 @@ through ``jax.custom_vjp``. See SURVEY.md §3.13 for the kernel roll-up.
 """
 
 from apex_tpu.ops import optim  # noqa: F401
+from apex_tpu.ops.attention import (  # noqa: F401
+    attention_reference,
+    flash_attention,
+)
 from apex_tpu.ops.layer_norm import (  # noqa: F401
     layer_norm,
     layer_norm_affine,

@@ -1,0 +1,524 @@
+"""Fused (flash-style) attention — Pallas fwd+bwd with jnp oracle.
+
+Ref: apex/contrib/csrc/fmha/* (``fmhalib``, fixed-seqlen fused attention
+fwd/bwd) and apex/contrib/csrc/multihead_attn/* (``fast_multihead_attn``
+softmax/dropout attention cores). Those kernels materialize nothing bigger
+than a tile of the score matrix; same here.
+
+TPU design: one kernel instance per (batch*heads, q-block). K/V for the
+whole row live in VMEM (the reference caps seqlen at 512; we allow any
+seqlen that fits VMEM — ~8k at d=128 in bf16) and the kernel streams over
+k-blocks with the online-softmax recurrence, keeping the (m, l, acc)
+carry in fp32. The backward is the standard flash backward split into two
+kernels: dq over q-blocks, (dk, dv) over k-blocks, both recomputing the
+probabilities from the saved log-sum-exp rather than storing the score
+matrix.
+
+Dropout on the attention probabilities follows the reference MHA semantics
+but lives in the jnp path only (kernel path requires p_dropout == 0 — the
+module layer falls back automatically; attention dropout is off in every
+headline config).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from apex_tpu.ops._utils import default_use_pallas, pallas_interpret
+
+_NEG_INF = -1e30
+_BLOCK_Q = 256
+_BLOCK_K = 256
+
+
+# ---------------------------------------------------------------------------
+# jnp reference (oracle + fallback; also the dropout path)
+# ---------------------------------------------------------------------------
+
+def _attn_ref(q, k, v, bias, causal, scale, dropout_p=0.0, dropout_rng=None):
+    """q,k,v: [B, S, D] (B = batch*heads flattened); bias: [B, Sq, Sk]|None."""
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    p = p / l
+    lse = (m + jnp.log(l))[..., 0]
+    if dropout_p > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    o = jnp.einsum("bqk,bkd->bqd", p, vf)
+    return o.astype(q.dtype), lse
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, offset, scale, block_k, sk):
+    if len(rest) == 3:
+        bias_ref, o_ref, lse_ref = rest
+    else:
+        bias_ref, (o_ref, lse_ref) = None, rest
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
+    bq, d = q.shape
+    nk = sk // block_k
+    qi = pl.program_id(1)
+
+    def body(j, carry):
+        acc, m_i, l_i = carry
+        kb = k_ref[0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
+        vb = v_ref[0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                             # [bq, bk]
+        if bias_ref is not None:
+            s = s + bias_ref[0, :, pl.dslice(j * block_k, block_k)].astype(
+                jnp.float32
+            )
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1
+            )
+            s = jnp.where(cols <= rows + offset, s, _NEG_INF)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_i - m_new)
+        l_new = l_i * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    if causal:
+        # blocks strictly above the (offset) diagonal contribute nothing
+        max_col = (qi + 1) * bq - 1 + offset
+        nk_eff = jnp.clip(max_col // block_k + 1, 0, nk)
+        acc, m_i, l_i = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
+    else:
+        acc, m_i, l_i = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = m_i + jnp.log(l_safe)                # [bq, 1]
+
+
+def _pad_seq(x, block, axis):
+    s = x.shape[axis]
+    pad = (-s) % block
+    if pad:
+        width = [(0, 0)] * x.ndim
+        width[axis] = (0, pad)
+        x = jnp.pad(x, width)
+    return x
+
+
+def _fwd_pallas(q, k, v, bias, causal, scale):
+    b, sq, d = q.shape
+    sk = k.shape[1]
+    bq = min(_BLOCK_Q, max(16, sq))
+    bk = min(_BLOCK_K, max(16, sk))
+    qp = _pad_seq(q, bq, 1)
+    kp = _pad_seq(k, bk, 1)
+    vp = _pad_seq(v, bk, 1)
+    sqp, skp = qp.shape[1], kp.shape[1]
+    if bias is not None:
+        bias_p = _pad_seq(_pad_seq(bias, bq, 1), bk, 2)
+        # padded key columns must not attend
+        if skp != sk:
+            pad_cols = jnp.arange(skp) >= sk
+            bias_p = jnp.where(pad_cols[None, None, :], _NEG_INF, bias_p)
+    elif skp != sk:
+        pad_cols = jnp.arange(skp) >= sk
+        bias_p = jnp.broadcast_to(
+            jnp.where(pad_cols, _NEG_INF, 0.0).astype(jnp.float32)[None, None, :],
+            (b, sqp, skp),
+        )
+    else:
+        bias_p = None
+
+    grid = (b, sqp // bq)
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, offset=sk - sq, scale=scale,
+        block_k=bk, sk=skp,
+    )
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, skp, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, skp, d), lambda i, j: (i, 0, 0)),
+    ]
+    args = [qp, kp, vp]
+    if bias_p is not None:
+        in_specs.append(pl.BlockSpec((1, bq, skp), lambda i, j: (i, j, 0)))
+        args.append(bias_p)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sqp, d), q.dtype),
+            jax.ShapeDtypeStruct((b, sqp, 1), jnp.float32),
+        ],
+        interpret=pallas_interpret(),
+    )(*args)
+    return o[:, :sq], lse[:, :sq, 0]
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref, *rest,
+                   causal, offset, scale, block_k, sk):
+    if len(rest) == 2:
+        bias_ref, dq_ref = rest
+    else:
+        bias_ref, (dq_ref,) = None, rest
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]                                  # [bq, 1]
+    delta = delta_ref[0]
+    bq, d = q.shape
+    qi = pl.program_id(1)
+    nk = sk // block_k
+
+    def body(j, dq):
+        kb = k_ref[0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
+        vb = v_ref[0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, :, pl.dslice(j * block_k, block_k)].astype(
+                jnp.float32
+            )
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1
+            )
+            s = jnp.where(cols <= rows + offset, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref, *rest,
+                    causal, offset, scale, block_q, sq):
+    if len(rest) == 3:
+        bias_ref, dk_ref, dv_ref = rest
+    else:
+        bias_ref, (dk_ref, dv_ref) = None, rest
+    kb = k_ref[0].astype(jnp.float32)                 # [bk, d]
+    vb = v_ref[0].astype(jnp.float32)
+    bk, d = kb.shape
+    ki = pl.program_id(1)
+    nq = sq // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(i * block_q, block_q)].astype(jnp.float32)
+        do = do_ref[0, pl.dslice(i * block_q, block_q)].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(i * block_q, block_q)]      # [bq, 1]
+        delta = delta_ref[0, pl.dslice(i * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, pl.dslice(i * block_q, block_q)].astype(
+                jnp.float32
+            )
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0
+            )
+            cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+            s = jnp.where(cols <= rows + offset, s, _NEG_INF)
+        p = jnp.exp(s - lse)                          # [bq, bk]
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, nq, body, (dk0, dv0))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do):
+    b, sq, d = q.shape
+    sk = k.shape[1]
+    bq = min(_BLOCK_Q, max(16, sq))
+    bk = min(_BLOCK_K, max(16, sk))
+    qp = _pad_seq(q, bq, 1)
+    kp = _pad_seq(k, bk, 1)
+    vp = _pad_seq(v, bk, 1)
+    dop = _pad_seq(do, bq, 1)
+    sqp, skp = qp.shape[1], kp.shape[1]
+    # delta = rowsum(do * o), carried as [b, sq, 1] for 2-D kernel loads
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )
+    deltap = _pad_seq(delta, bq, 1)
+    # padded q rows: lse would be 0 -> p = exp(0-0)=1 garbage; set lse huge
+    lsep = _pad_seq(lse[..., None], bq, 1)
+    if sqp != sq:
+        pad_rows = jnp.arange(sqp) >= sq
+        lsep = jnp.where(pad_rows[None, :, None], 1e30, lsep)
+    if bias is not None:
+        bias_p = _pad_seq(_pad_seq(bias, bq, 1), bk, 2)
+        if skp != sk:
+            pad_cols = jnp.arange(skp) >= sk
+            bias_p = jnp.where(pad_cols[None, None, :], _NEG_INF, bias_p)
+    elif skp != sk:
+        pad_cols = jnp.arange(skp) >= sk
+        bias_p = jnp.broadcast_to(
+            jnp.where(pad_cols, _NEG_INF, 0.0).astype(jnp.float32)[None, None, :],
+            (b, sqp, skp),
+        )
+    else:
+        bias_p = None
+
+    common = [qp, kp, vp, lsep, dop, deltap]
+    if bias_p is not None:
+        common.append(bias_p)
+
+    dq_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, skp, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, skp, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),
+    ]
+    if bias_p is not None:
+        dq_specs.append(pl.BlockSpec((1, bq, skp), lambda i, j: (i, j, 0)))
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, causal=causal, offset=sk - sq, scale=scale,
+            block_k=bk, sk=skp,
+        ),
+        grid=(b, sqp // bq),
+        in_specs=dq_specs,
+        out_specs=[pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b, sqp, d), q.dtype)],
+        interpret=pallas_interpret(),
+    )(*common)[0]
+
+    dkv_specs = [
+        pl.BlockSpec((1, sqp, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, sqp, 1), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, sqp, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, sqp, 1), lambda i, j: (i, 0, 0)),
+    ]
+    if bias_p is not None:
+        dkv_specs.append(pl.BlockSpec((1, sqp, bk), lambda i, j: (i, 0, j)))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, causal=causal, offset=sk - sq, scale=scale,
+            block_q=bq, sq=sqp,
+        ),
+        grid=(b, skp // bk),
+        in_specs=dkv_specs,
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, skp, d), k.dtype),
+            jax.ShapeDtypeStruct((b, skp, d), v.dtype),
+        ],
+        interpret=pallas_interpret(),
+    )(*common)
+    return dq[:, :sq], dk[:, :sk], dv[:, :sk]
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_core(q, k, v, bias, causal, scale, use_pallas):
+    return _flash_core_fwd(q, k, v, bias, causal, scale, use_pallas)[0]
+
+
+def _flash_core_fwd(q, k, v, bias, causal, scale, use_pallas):
+    use = default_use_pallas() if use_pallas is None else use_pallas
+    if use:
+        o, lse = _fwd_pallas(q, k, v, bias, causal, scale)
+    else:
+        o, lse = _attn_ref(q, k, v, bias, causal, scale)
+    return o, (q, k, v, bias, o, lse)
+
+
+def _flash_core_bwd(causal, scale, use_pallas, res, do):
+    q, k, v, bias, o, lse = res
+    use = default_use_pallas() if use_pallas is None else use_pallas
+    if use:
+        dq, dk, dv = _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do)
+    else:
+        dq, dk, dv = _bwd_ref(q, k, v, bias, causal, scale, lse, do)
+    dbias = None
+    if bias is not None:
+        # recompute ds for dbias via the reference path (bias grads are only
+        # used by additive-mask MHA variants, which are small)
+        dbias = _dbias_ref(q, k, v, bias, causal, scale, lse, do)
+    return dq, dk, dv, dbias
+
+
+def _bwd_ref(q, k, v, bias, causal, scale, lse, do):
+    s = jnp.einsum(
+        "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jnp.exp(s - lse[..., None])
+    do32 = do.astype(jnp.float32)
+    dv = jnp.einsum("bqk,bqd->bkd", p, do32)
+    dp = jnp.einsum("bqd,bkd->bqk", do32, v.astype(jnp.float32))
+    delta = jnp.sum(do32 * _o_from(p, v), axis=-1, keepdims=True)
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bqk,bkd->bqd", ds, k.astype(jnp.float32)) * scale
+    dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32)) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _o_from(p, v):
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
+
+
+def _dbias_ref(q, k, v, bias, causal, scale, lse, do):
+    s = jnp.einsum(
+        "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jnp.exp(s - lse[..., None])
+    do32 = do.astype(jnp.float32)
+    dp = jnp.einsum("bqd,bkd->bqk", do32, v.astype(jnp.float32))
+    delta = jnp.sum(do32 * _o_from(p, v), axis=-1, keepdims=True)
+    ds = p * (dp - delta)
+    return ds.astype(bias.dtype)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    bias=None,
+    mask=None,
+    causal: bool = False,
+    scale: float | None = None,
+    dropout_p: float = 0.0,
+    dropout_rng=None,
+    use_pallas: bool | None = None,
+):
+    """Fused scaled-dot-product attention.
+
+    q: [..., sq, d]; k, v: [..., sk, d] (matching leading dims — typically
+    [batch, heads, seq, head_dim]). ``bias`` is additive [..., sq, sk];
+    ``mask`` is boolean with True = MASKED (reference padding-mask
+    convention, see ops/softmax.py) and is folded into the bias. ``causal``
+    applies the upper-triangular mask in-kernel with no materialization.
+
+    Ref: apex/contrib/fmha/fmha.py::FMHAFun and the fast_multihead_attn
+    attention cores; the numerics (fp32 softmax, max-subtraction) match the
+    reference's fused kernels.
+    """
+    if q.ndim < 3:
+        raise ValueError("flash_attention expects [..., seq, head_dim]")
+    lead = q.shape[:-2]
+    sq, d = q.shape[-2:]
+    sk = k.shape[-2]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    if mask is not None:
+        mbias = jnp.where(jnp.asarray(mask, bool), _NEG_INF, 0.0).astype(
+            jnp.float32
+        )
+        bias = mbias if bias is None else bias.astype(jnp.float32) + mbias
+
+    q3 = q.reshape(-1, sq, d)
+    k3 = k.reshape(-1, sk, d)
+    v3 = v.reshape(-1, sk, d)
+    b = q3.shape[0]
+    bias3 = None
+    if bias is not None:
+        bias3 = jnp.broadcast_to(bias, lead + (sq, sk)).reshape(-1, sq, sk)
+
+    if dropout_p > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout_p > 0 requires dropout_rng")
+        o, _ = _attn_ref(
+            q3, k3, v3, bias3, causal, scale, dropout_p, dropout_rng
+        )
+    else:
+        o = _flash_core(q3, k3, v3, bias3, causal, scale, use_pallas)
+    return o.reshape(lead + (sq, d))
+
+
+def attention_reference(q, k, v, *, bias=None, mask=None, causal=False,
+                        scale=None, dropout_p=0.0, dropout_rng=None):
+    """Unfused oracle with identical semantics (for tests)."""
+    return flash_attention(
+        q, k, v, bias=bias, mask=mask, causal=causal, scale=scale,
+        dropout_p=dropout_p, dropout_rng=dropout_rng, use_pallas=False,
+    )
