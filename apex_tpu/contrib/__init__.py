@@ -1,0 +1,39 @@
+"""apex_tpu.contrib — optional extensions (ref: apex/contrib).
+
+Each submodule mirrors one reference contrib package; all compute paths are
+jnp/XLA + the Pallas kernels in :mod:`apex_tpu.ops` (the reference's CUDA
+extension modules are listed per-file). Imported lazily.
+"""
+
+_SUBMODULES = (
+    "multihead_attn",
+    "fmha",
+    "xentropy",
+    "focal_loss",
+    "group_norm",
+    "groupbn",
+    "cudnn_gbn",
+    "gpu_specific",
+    "layer_norm",
+    "clip_grad",
+    "sparsity",
+    "transducer",
+    "index_mul_2d",
+    "conv_bias_relu",
+    "bottleneck",
+    "peer_memory",
+)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+
+        mod = importlib.import_module(f"apex_tpu.contrib.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'apex_tpu.contrib' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals().keys()) + list(_SUBMODULES))

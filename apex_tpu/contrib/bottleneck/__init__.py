@@ -1,0 +1,10 @@
+"""Fused ResNet bottleneck + spatial-parallel variant (ref:
+apex/contrib/bottleneck)."""
+
+from apex_tpu.contrib.bottleneck.bottleneck import (  # noqa: F401
+    Bottleneck,
+    SpatialBottleneck,
+    bottleneck_apply,
+    bottleneck_init,
+    spatial_bottleneck_apply,
+)

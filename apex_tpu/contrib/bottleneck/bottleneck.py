@@ -1,0 +1,141 @@
+"""ResNet bottleneck with fused conv epilogues, plus the spatial-parallel
+variant with halo exchange.
+
+Ref: apex/contrib/bottleneck/bottleneck.py::Bottleneck/SpatialBottleneck +
+csrc ``fast_bottleneck`` (cudnn runtime fusion of conv+frozen-BN scale/bias
++relu chains) and ``halo_exchangers``. The reference folds BatchNorm into
+per-channel (scale, bias) — training keeps them frozen (the MLPerf
+RetinaNet trick) — and fuses everything into three conv+epilogue calls.
+XLA does the same fusion for the NHWC convs below.
+
+SpatialBottleneck: the input is sharded along H over a named mesh axis;
+only the 3x3 conv sees neighbor rows, so one ``halo_exchange_1d`` per
+block supplies a 1-row halo and the conv runs VALID along H. Must be
+called under ``shard_map``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.conv_bias_relu import (
+    _conv,
+    conv_frozen_scale_bias_relu,
+)
+from apex_tpu.contrib.peer_memory.halo_exchange import halo_exchange_1d
+
+
+def bottleneck_init(key, in_ch: int, bottleneck_ch: int, out_ch: int,
+                    *, stride: int = 1, dtype=jnp.float32):
+    """Conv weights (HWIO) + folded-BN scale/bias per conv; a projection
+    shortcut is created when shape changes (like torchvision/reference)."""
+    ks = jax.random.split(key, 4)
+
+    def he(k, shape):
+        fan_in = shape[0] * shape[1] * shape[2]
+        return (jax.random.normal(k, shape) * (2.0 / fan_in) ** 0.5).astype(dtype)
+
+    params = {
+        "conv1": {"w": he(ks[0], (1, 1, in_ch, bottleneck_ch)),
+                  "scale": jnp.ones((bottleneck_ch,), dtype),
+                  "bias": jnp.zeros((bottleneck_ch,), dtype)},
+        "conv2": {"w": he(ks[1], (3, 3, bottleneck_ch, bottleneck_ch)),
+                  "scale": jnp.ones((bottleneck_ch,), dtype),
+                  "bias": jnp.zeros((bottleneck_ch,), dtype)},
+        "conv3": {"w": he(ks[2], (1, 1, bottleneck_ch, out_ch)),
+                  "scale": jnp.ones((out_ch,), dtype),
+                  "bias": jnp.zeros((out_ch,), dtype)},
+    }
+    if stride != 1 or in_ch != out_ch:
+        params["downsample"] = {
+            "w": he(ks[3], (1, 1, in_ch, out_ch)),
+            "scale": jnp.ones((out_ch,), dtype),
+            "bias": jnp.zeros((out_ch,), dtype),
+        }
+    return params
+
+
+def bottleneck_apply(params, x, *, stride: int = 1):
+    """x: [N, H, W, C]. stride applies to the 3x3 (torchvision v1.5 / the
+    reference's layout)."""
+    c1 = params["conv1"]
+    y = conv_frozen_scale_bias_relu(x, c1["w"], c1["scale"], c1["bias"],
+                                    stride=1, padding=0)
+    c2 = params["conv2"]
+    y = conv_frozen_scale_bias_relu(y, c2["w"], c2["scale"], c2["bias"],
+                                    stride=stride, padding=1)
+    c3 = params["conv3"]
+    y = _conv(y, c3["w"], 1, [(0, 0), (0, 0)])
+    y = y * c3["scale"].astype(jnp.float32) + c3["bias"].astype(jnp.float32)
+    if "downsample" in params:
+        d = params["downsample"]
+        sc = _conv(x, d["w"], stride, [(0, 0), (0, 0)])
+        sc = sc * d["scale"].astype(jnp.float32) + d["bias"].astype(jnp.float32)
+    else:
+        sc = x.astype(jnp.float32)
+    return jax.nn.relu(y + sc).astype(x.dtype)
+
+
+def spatial_bottleneck_apply(params, x, axis_name: str, *,
+                             halo_dim: int = 1):
+    """Spatial-parallel bottleneck (stride 1): x is the local H-shard of an
+    NHWC tensor sharded over ``axis_name``. One halo exchange feeds the 3x3
+    conv; all 1x1 convs and the residual are purely local."""
+    c1 = params["conv1"]
+    y = conv_frozen_scale_bias_relu(x, c1["w"], c1["scale"], c1["bias"],
+                                    stride=1, padding=0)
+    # exchange 1-row halos, then conv VALID along H (the halo supplies the
+    # padding interior ranks need; edge ranks see zeros = zero padding)
+    y = halo_exchange_1d(y, axis_name, halo=1, dim=halo_dim)
+    c2 = params["conv2"]
+    y = _conv(y, c2["w"], 1, [(0, 0), (1, 1)])
+    y = jax.nn.relu(
+        y * c2["scale"].astype(jnp.float32) + c2["bias"].astype(jnp.float32)
+    ).astype(x.dtype)
+    c3 = params["conv3"]
+    y = _conv(y, c3["w"], 1, [(0, 0), (0, 0)])
+    y = y * c3["scale"].astype(jnp.float32) + c3["bias"].astype(jnp.float32)
+    if "downsample" in params:
+        d = params["downsample"]
+        sc = _conv(x, d["w"], 1, [(0, 0), (0, 0)])
+        sc = sc * d["scale"].astype(jnp.float32) + d["bias"].astype(jnp.float32)
+    else:
+        sc = x.astype(jnp.float32)
+    return jax.nn.relu(y + sc).astype(x.dtype)
+
+
+class Bottleneck:
+    """Veneer holding params (ref constructor: in_channels, bottleneck_
+    channels, out_channels, stride)."""
+
+    def __init__(self, in_channels: int, bottleneck_channels: int,
+                 out_channels: int, stride: int = 1, key=None,
+                 dtype=jnp.float32):
+        key = jax.random.PRNGKey(0) if key is None else key
+        self.stride = stride
+        self.params = bottleneck_init(
+            key, in_channels, bottleneck_channels, out_channels,
+            stride=stride, dtype=dtype,
+        )
+
+    def __call__(self, x, params=None):
+        return bottleneck_apply(self.params if params is None else params,
+                                x, stride=self.stride)
+
+
+class SpatialBottleneck(Bottleneck):
+    """Spatial-parallel veneer (ref: SpatialBottleneck; halo exchangers are
+    replaced by the mesh axis)."""
+
+    def __init__(self, in_channels: int, bottleneck_channels: int,
+                 out_channels: int, axis_name: str = "spatial", key=None,
+                 dtype=jnp.float32):
+        super().__init__(in_channels, bottleneck_channels, out_channels,
+                         stride=1, key=key, dtype=dtype)
+        self.axis_name = axis_name
+
+    def __call__(self, x, params=None):
+        return spatial_bottleneck_apply(
+            self.params if params is None else params, x, self.axis_name
+        )

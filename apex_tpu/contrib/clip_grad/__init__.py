@@ -1,0 +1,9 @@
+"""Fused-norm gradient clipping (ref: apex/contrib/clip_grad).
+
+Implementation lives in :mod:`apex_tpu.optimizers.clip_grad`.
+"""
+
+from apex_tpu.optimizers.clip_grad import (  # noqa: F401
+    clip_grad_norm,
+    clip_grad_norm_,
+)

@@ -1,0 +1,59 @@
+"""Fused Conv+Bias(+Mask)(+ReLU) (ref: apex/contrib/conv_bias_relu, ext
+``fused_conv_bias_relu`` over cudnn-frontend runtime fusion).
+
+On TPU, XLA fuses the bias/ReLU epilogue into the convolution automatically;
+these wrappers pin the reference's NHWC layout and epilogue set. All are
+differentiable through JAX autodiff (the reference ships hand backward
+passes for the same chains).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_DN = ("NHWC", "HWIO", "NHWC")
+
+
+def _conv(x, w, stride, padding):
+    strides = (stride, stride) if isinstance(stride, int) else stride
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding, dimension_numbers=_DN,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def conv_bias(x, weight, bias, stride=1, padding=0):
+    """ConvBias: NHWC conv + channel bias."""
+    y = _conv(x, weight, stride, padding) + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def conv_bias_relu(x, weight, bias, stride=1, padding=0):
+    """ConvBiasReLU (ref: ConvBiasReLU_.apply)."""
+    y = _conv(x, weight, stride, padding) + bias.astype(jnp.float32)
+    return jax.nn.relu(y).astype(x.dtype)
+
+
+def conv_bias_mask_relu(x, weight, bias, mask, stride=1, padding=0):
+    """ConvBiasMaskReLU: multiply by a (0/1) mask before the ReLU."""
+    y = _conv(x, weight, stride, padding) + bias.astype(jnp.float32)
+    y = y * mask.astype(jnp.float32)
+    return jax.nn.relu(y).astype(x.dtype)
+
+
+def conv_frozen_scale_bias_relu(x, weight, scale, bias, stride=1, padding=0):
+    """ConvFrozenScaleBiasReLU: conv, then y*scale + bias, then ReLU
+    (frozen-BatchNorm inference folding)."""
+    y = _conv(x, weight, stride, padding)
+    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return jax.nn.relu(y).astype(x.dtype)
+
+
+# reference class-style aliases
+ConvBias = conv_bias
+ConvBiasReLU = conv_bias_relu
+ConvBiasMaskReLU = conv_bias_mask_relu
+ConvFrozenScaleBiasReLU = conv_frozen_scale_bias_relu

@@ -1,0 +1,12 @@
+"""cuDNN group batch norm parity surface (ref: apex/contrib/cudnn_gbn).
+
+Same capability as :mod:`apex_tpu.contrib.groupbn` (NHWC BN with group
+statistics over a mesh axis); kept as a named module for reference-script
+parity.
+"""
+
+from apex_tpu.contrib.groupbn import (  # noqa: F401
+    BatchNorm2d_NHWC,
+    GroupBatchNorm2d,
+    batch_norm_nhwc,
+)

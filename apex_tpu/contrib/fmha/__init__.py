@@ -1,0 +1,77 @@
+"""FMHA — fused attention over packed variable-length batches.
+
+Ref: apex/contrib/fmha/fmha.py::FMHAFun (ext ``fmhalib``): fixed-seqlen
+(≤512) fused attention over a packed [total_tokens, 3, heads, d] qkv tensor
+with ``cu_seqlens`` prefix offsets. TPU/XLA wants static shapes, so the
+idiomatic equivalent takes the padded [batch, seq, 3, heads, d] layout plus
+per-example lengths and masks padded keys inside the flash kernel; helpers
+convert between the packed and padded layouts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.attention import flash_attention
+
+
+def fmha(qkv, seqlens=None, *, causal: bool = False, scale: float | None = None,
+         dropout_p: float = 0.0, dropout_rng=None, use_pallas=None):
+    """qkv: [batch, seq, 3, heads, d]; seqlens: [batch] int32 valid lengths
+    (None = all full). Returns [batch, seq, heads, d] with padded query rows
+    zeroed (the reference writes nothing for padded tokens)."""
+    b, s, three, h, d = qkv.shape
+    if three != 3:
+        raise ValueError("qkv must be [batch, seq, 3, heads, d]")
+    q = qkv[:, :, 0].transpose(0, 2, 1, 3)  # [b, h, s, d]
+    k = qkv[:, :, 1].transpose(0, 2, 1, 3)
+    v = qkv[:, :, 2].transpose(0, 2, 1, 3)
+    mask = None
+    if seqlens is not None:
+        valid = jnp.arange(s)[None, :] < seqlens[:, None]      # [b, s]
+        mask = (~valid)[:, None, None, :]                      # key mask
+    o = flash_attention(
+        q, k, v, mask=mask, causal=causal, scale=scale,
+        dropout_p=dropout_p, dropout_rng=dropout_rng, use_pallas=use_pallas,
+    )
+    o = o.transpose(0, 2, 1, 3)                                # [b, s, h, d]
+    if seqlens is not None:
+        o = jnp.where(valid[:, :, None, None], o, 0.0).astype(o.dtype)
+    return o
+
+
+def pack_qkv(qkv_padded, seqlens):
+    """[batch, seq, 3, h, d] + lengths -> packed [total, 3, h, d] +
+    cu_seqlens (host-side helper for reference-format interop)."""
+    b, s = qkv_padded.shape[:2]
+    valid = jnp.arange(s)[None, :] < seqlens[:, None]
+    idx = jnp.nonzero(valid.reshape(-1))[0]
+    packed = qkv_padded.reshape(b * s, *qkv_padded.shape[2:])[idx]
+    cu = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                          jnp.cumsum(seqlens).astype(jnp.int32)])
+    return packed, cu
+
+
+def unpack_output(packed, cu_seqlens, seq: int):
+    """Inverse of :func:`pack_qkv` for the output tensor."""
+    b = cu_seqlens.shape[0] - 1
+    out = jnp.zeros((b, seq) + packed.shape[1:], packed.dtype)
+    for i in range(b):  # host-side helper; not jitted
+        n = int(cu_seqlens[i + 1] - cu_seqlens[i])
+        out = out.at[i, :n].set(packed[int(cu_seqlens[i]):int(cu_seqlens[i + 1])])
+    return out
+
+
+class FMHA:
+    """Module veneer over :func:`fmha` (ref: apex/contrib/fmha)."""
+
+    def __init__(self, *, causal: bool = False, dropout_p: float = 0.0):
+        self.causal = causal
+        self.dropout_p = dropout_p
+
+    def __call__(self, qkv, seqlens=None, *, is_training=True,
+                 dropout_rng=None):
+        p = self.dropout_p if is_training else 0.0
+        return fmha(qkv, seqlens, causal=self.causal, dropout_p=p,
+                    dropout_rng=dropout_rng)

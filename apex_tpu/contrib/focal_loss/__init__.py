@@ -1,0 +1,112 @@
+"""Fused sigmoid focal loss (ref: apex/contrib/focal_loss, ext
+``focal_loss_cuda``) — the RetinaNet classification loss with label
+smoothing, fwd+bwd in one pass.
+
+The reference kernel fuses one-hot expansion + sigmoid + focal weighting +
+normalization (and writes the gradient in the same pass). On TPU this is a
+bandwidth-bound elementwise pipeline that XLA fuses into a single HBM pass;
+the custom_vjp below mirrors the reference's precomputed-gradient structure
+so the backward is one fused multiply instead of re-deriving the chain.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def focal_loss(
+    cls_output,
+    cls_targets,
+    num_positives_sum,
+    num_real_classes: int,
+    alpha: float = 0.25,
+    gamma: float = 2.0,
+    label_smoothing: float = 0.0,
+):
+    """Sum of sigmoid focal loss over all anchors / classes.
+
+    cls_output: [..., num_classes_padded] raw logits.
+    cls_targets: [...] int class ids; -1 = negative anchor (all-zero
+    one-hot, like the reference), -2 = ignored anchor (zero loss).
+    num_positives_sum: scalar normalizer (the reference divides the loss
+    and gradient by it).
+    num_real_classes: ignore padded logit columns beyond this count.
+    """
+    return _focal_fwd(cls_output, cls_targets, num_positives_sum,
+                      num_real_classes, alpha, gamma, label_smoothing)[0]
+
+
+def _focal_pieces(x, targets, num_real_classes, alpha, gamma,
+                  label_smoothing):
+    x = x.astype(jnp.float32)
+    ncls = x.shape[-1]
+    # one-hot with -1 -> all zeros; label smoothing as in the reference:
+    # t = t*(1-s) + s/2
+    onehot = jax.nn.one_hot(targets, ncls, dtype=jnp.float32)
+    t = onehot * (1.0 - label_smoothing) + 0.5 * label_smoothing
+    p = jax.nn.sigmoid(x)
+    # focal terms, numerically-stable BCE from logits
+    bce = jnp.maximum(x, 0.0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * t + (1.0 - p) * (1.0 - t)
+    alpha_t = alpha * t + (1.0 - alpha) * (1.0 - t)
+    w = alpha_t * (1.0 - p_t) ** gamma
+    loss = w * bce
+    # gradient of (w * bce) wrt x, fused like the reference kernel:
+    #   d/dx bce = p - t
+    #   d/dx w   = alpha_t * gamma * (1-p_t)^(gamma-1) * -(dp_t/dx)
+    #   dp_t/dx  = (2t - 1) * p * (1-p)
+    dpt_dx = (2.0 * t - 1.0) * p * (1.0 - p)
+    dw_dx = -alpha_t * gamma * (1.0 - p_t) ** (gamma - 1.0) * dpt_dx
+    grad = w * (p - t) + dw_dx * bce
+    # masks: ignored anchors (-2) and padded classes
+    keep_anchor = (targets >= -1)[..., None]
+    keep_class = (
+        jax.lax.broadcasted_iota(jnp.int32, (ncls,), 0) < num_real_classes
+    )
+    keep = keep_anchor & keep_class
+    loss = jnp.where(keep, loss, 0.0)
+    grad = jnp.where(keep, grad, 0.0)
+    return loss, grad
+
+
+def _focal_fwd(x, targets, num_positives_sum, num_real_classes, alpha,
+               gamma, label_smoothing):
+    nps = jnp.maximum(jnp.asarray(num_positives_sum, jnp.float32), 1.0)
+    loss, grad = _focal_pieces(x, targets, num_real_classes, alpha, gamma,
+                               label_smoothing)
+    total = loss.sum() / nps
+    dtype_token = jnp.zeros((), x.dtype)  # carries the primal dtype
+    return total, (grad, nps, dtype_token)
+
+
+def _focal_bwd(num_real_classes, alpha, gamma, label_smoothing, res, g):
+    grad, nps, dtype_token = res
+    dx = (g * grad / nps).astype(dtype_token.dtype)
+    # no gradient to integer targets; num_positives_sum treated as constant
+    # (the reference's kernel also only emits d/d_logits)
+    return dx, None, jnp.zeros_like(nps)
+
+
+focal_loss.defvjp(_focal_fwd, _focal_bwd)
+
+
+class FocalLoss:
+    """Module veneer matching the reference call shape."""
+
+    def __init__(self, num_real_classes: int, alpha: float = 0.25,
+                 gamma: float = 2.0, label_smoothing: float = 0.0):
+        self.num_real_classes = num_real_classes
+        self.alpha = alpha
+        self.gamma = gamma
+        self.label_smoothing = label_smoothing
+
+    def __call__(self, cls_output, cls_targets, num_positives_sum):
+        return focal_loss(
+            cls_output, cls_targets, num_positives_sum,
+            self.num_real_classes, self.alpha, self.gamma,
+            self.label_smoothing,
+        )

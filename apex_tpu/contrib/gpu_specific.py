@@ -1,0 +1,30 @@
+"""GPU-specific reference modules with no TPU analog (documented stubs).
+
+- apex/contrib/nccl_allocator — ``ncclMemAlloc``-backed CUDA allocator for
+  NCCL user-buffer registration. On TPU, XLA owns HBM allocation and
+  collective buffers; there is nothing to register. (SURVEY.md §3.13 #19)
+- apex/contrib/gpu_direct_storage — cuFile/GDS direct disk<->VRAM IO. The
+  TPU-stack analog is async checkpointing via orbax with host staging,
+  which is provided by the checkpoint helpers, not a file API here.
+
+Importing these names raises with this explanation, mirroring the
+reference's behavior when an extension was not built.
+"""
+
+
+def _unavailable(name: str, why: str):
+    def _raise(*args, **kwargs):
+        raise NotImplementedError(
+            f"{name} is GPU-specific and has no TPU analog: {why}"
+        )
+
+    return _raise
+
+
+nccl_allocator_init = _unavailable(
+    "nccl_allocator", "XLA owns device memory and collective buffers on TPU"
+)
+GDSFile = _unavailable(
+    "gpu_direct_storage.GDSFile",
+    "use orbax async checkpointing for high-throughput TPU IO",
+)

@@ -1,0 +1,90 @@
+"""NHWC BatchNorm with fused ReLU/add and cross-chip bn_group (ref:
+apex/contrib/groupbn, ext ``bnp``; also covers apex/contrib/cudnn_gbn's
+``GroupBatchNorm2d`` — same capability over cuDNN).
+
+The reference computes BN statistics across a ``bn_group`` of GPUs through
+CUDA-IPC peer memory. On TPU the group is a named mesh axis (or sub-axis):
+statistics are fp32 batch moments reduced with ``lax.psum`` when running
+under ``shard_map``. Fused epilogues (relu / residual add+relu) mirror the
+``bn_relu`` / ``bn_add_relu`` kernel variants.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def batch_norm_nhwc(x, params, state, *, training: bool, momentum: float = 0.9,
+                    eps: float = 1e-5, axis_name: Optional[str] = None,
+                    fuse_add=None, fuse_relu: bool = False):
+    """x: [N, H, W, C]; params: {gamma, beta}; state: {mean, var} running.
+
+    Returns (y, new_state). ``axis_name`` reduces stats over that mesh axis
+    (the bn_group). ``fuse_add`` is an optional residual added before the
+    (optionally fused) ReLU — the reference's bn_add_relu.
+    """
+    x32 = x.astype(jnp.float32)
+    if training:
+        mean = jnp.mean(x32, axis=(0, 1, 2))
+        mean_sq = jnp.mean(jnp.square(x32), axis=(0, 1, 2))
+        if axis_name is not None:
+            mean = lax.pmean(mean, axis_name)
+            mean_sq = lax.pmean(mean_sq, axis_name)
+        var = mean_sq - jnp.square(mean)
+        new_state = {
+            "mean": momentum * state["mean"] + (1 - momentum) * mean,
+            "var": momentum * state["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = state["mean"], state["var"]
+        new_state = state
+    y = (x32 - mean) * lax.rsqrt(var + eps)
+    y = y * params["gamma"].astype(jnp.float32) + params["beta"].astype(
+        jnp.float32
+    )
+    if fuse_add is not None:
+        y = y + fuse_add.astype(jnp.float32)
+    if fuse_relu:
+        y = jax.nn.relu(y)
+    return y.astype(x.dtype), new_state
+
+
+class BatchNorm2d_NHWC:
+    """Veneer with the reference constructor (ref: groupbn/batch_norm.py::
+    BatchNorm2d_NHWC(planes, fuse_relu, bn_group))."""
+
+    def __init__(self, num_features: int, fuse_relu: bool = False,
+                 bn_group: Optional[str] = None, momentum: float = 0.9,
+                 eps: float = 1e-5, dtype=jnp.float32):
+        self.fuse_relu = fuse_relu
+        self.bn_group = bn_group
+        self.momentum = momentum
+        self.eps = eps
+        self.params = {
+            "gamma": jnp.ones((num_features,), dtype),
+            "beta": jnp.zeros((num_features,), dtype),
+        }
+        self.state = {
+            "mean": jnp.zeros((num_features,), jnp.float32),
+            "var": jnp.ones((num_features,), jnp.float32),
+        }
+
+    def __call__(self, x, z=None, *, training: bool = True, params=None,
+                 state=None):
+        y, new_state = batch_norm_nhwc(
+            x, self.params if params is None else params,
+            self.state if state is None else state,
+            training=training, momentum=self.momentum, eps=self.eps,
+            axis_name=self.bn_group, fuse_add=z, fuse_relu=self.fuse_relu,
+        )
+        if state is None:
+            self.state = new_state
+        return y
+
+
+# cudnn_gbn parity name
+GroupBatchNorm2d = BatchNorm2d_NHWC

@@ -1,0 +1,17 @@
+"""index_mul_2d (ref: apex/contrib/index_mul_2d, ext ``index_mul_2d_cuda``
+— the OpenFold fused gather-multiply).
+
+Semantics: ``out[i] = in1[idx[i]] * in2[i]`` over 2-D feature rows. The
+reference fuses gather + multiply fwd and the scatter-add backward; XLA
+compiles ``take`` + multiply into a fused gather and the transpose into a
+segment-sum scatter, so a hand kernel adds nothing on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def index_mul_2d(in1, in2, idx):
+    """in1: [N, D]; in2: [M, D]; idx: [M] int -> [M, D]."""
+    return jnp.take(in1, idx, axis=0) * in2

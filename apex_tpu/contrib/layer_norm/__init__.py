@@ -1,0 +1,16 @@
+"""FastLayerNorm (ref: apex/contrib/layer_norm, ext ``fast_layer_norm``).
+
+The reference's persistent-CTA wide-hidden LN is a CUDA scheduling trick;
+the Pallas LN kernel in :mod:`apex_tpu.ops.layer_norm` already blocks rows
+in VMEM for any hidden size, so FastLayerNorm is the same kernel under the
+contrib name (SURVEY.md §3.13 item 10).
+"""
+
+from __future__ import annotations
+
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.ops.layer_norm import layer_norm  # noqa: F401
+
+
+class FastLayerNorm(FusedLayerNorm):
+    """Drop-in for apex.contrib.layer_norm.FastLayerNorm."""

@@ -1,0 +1,12 @@
+"""Fused multihead attention (ref: apex/contrib/multihead_attn)."""
+
+from apex_tpu.contrib.multihead_attn.self_multihead_attn import (  # noqa: F401
+    SelfMultiheadAttn,
+    self_attn_apply,
+    self_attn_init,
+)
+from apex_tpu.contrib.multihead_attn.encdec_multihead_attn import (  # noqa: F401
+    EncdecMultiheadAttn,
+    encdec_attn_apply,
+    encdec_attn_init,
+)

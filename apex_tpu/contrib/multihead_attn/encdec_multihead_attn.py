@@ -1,0 +1,136 @@
+"""Encoder-decoder multihead attention.
+
+Ref: apex/contrib/multihead_attn/encdec_multihead_attn.py::EncdecMultiheadAttn
+(q projected from the decoder stream, k/v from the encoder stream with a
+single fused [h, 2h] projection; optional fused pre-LN + residual on the
+query stream only, like the reference's encdec_*_norm_add kernels).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.layer_norm import layer_norm
+
+
+def encdec_attn_init(key, hidden_dim: int, heads: int, *, bias: bool = False,
+                     include_norm_add: bool = False, dtype=jnp.float32):
+    if hidden_dim % heads:
+        raise ValueError("hidden_dim must be divisible by heads")
+    k_q, k_kv, k_out = jax.random.split(key, 3)
+    bound_q = (6.0 / (2 * hidden_dim)) ** 0.5 / (2.0 ** 0.5)
+    bound_kv = (6.0 / (3 * hidden_dim)) ** 0.5 / (2.0 ** 0.5)
+    bound_out = (6.0 / (2 * hidden_dim)) ** 0.5
+    params = {
+        "q_kernel": jax.random.uniform(
+            k_q, (hidden_dim, hidden_dim), dtype, -bound_q, bound_q
+        ),
+        "kv_kernel": jax.random.uniform(
+            k_kv, (hidden_dim, 2 * hidden_dim), dtype, -bound_kv, bound_kv
+        ),
+        "out_kernel": jax.random.uniform(
+            k_out, (hidden_dim, hidden_dim), dtype, -bound_out, bound_out
+        ),
+    }
+    if bias:
+        params["q_bias"] = jnp.zeros((hidden_dim,), dtype)
+        params["kv_bias"] = jnp.zeros((2 * hidden_dim,), dtype)
+        params["out_bias"] = jnp.zeros((hidden_dim,), dtype)
+    if include_norm_add:
+        params["ln_gamma"] = jnp.ones((hidden_dim,), dtype)
+        params["ln_beta"] = jnp.zeros((hidden_dim,), dtype)
+    return params
+
+
+def encdec_attn_apply(
+    params,
+    query,
+    key_value,
+    heads: int,
+    *,
+    key_padding_mask=None,
+    attn_mask=None,
+    is_training: bool = True,
+    dropout_p: float = 0.0,
+    dropout_rng=None,
+    include_norm_add: bool = False,
+    use_pallas: bool | None = None,
+):
+    """query: [sq, batch, hidden] (decoder); key_value: [sk, batch, hidden]
+    (encoder). Masks follow the reference conventions (True = masked)."""
+    sq, b, h = query.shape
+    sk = key_value.shape[0]
+    d = h // heads
+    qin = query
+    if include_norm_add:
+        query = layer_norm(query, params["ln_gamma"], params["ln_beta"],
+                           use_pallas=use_pallas)
+    q = query @ params["q_kernel"]
+    if "q_bias" in params:
+        q = q + params["q_bias"]
+    kv = key_value @ params["kv_kernel"]
+    if "kv_bias" in params:
+        kv = kv + params["kv_bias"]
+    k, v = jnp.split(kv, 2, axis=-1)
+
+    def split_heads(t, s):
+        return t.reshape(s, b, heads, d).transpose(1, 2, 0, 3)
+
+    q = split_heads(q, sq)
+    k = split_heads(k, sk)
+    v = split_heads(v, sk)
+
+    mask = None
+    if attn_mask is not None:
+        mask = jnp.asarray(attn_mask, bool)[None, None]
+    if key_padding_mask is not None:
+        kp = jnp.asarray(key_padding_mask, bool)[:, None, None, :]
+        mask = kp if mask is None else (mask | kp)
+
+    p = dropout_p if is_training else 0.0
+    o = flash_attention(
+        q, k, v, mask=mask, dropout_p=p, dropout_rng=dropout_rng,
+        use_pallas=use_pallas,
+    )
+    o = o.transpose(2, 0, 1, 3).reshape(sq, b, h)
+    o = o @ params["out_kernel"]
+    if "out_bias" in params:
+        o = o + params["out_bias"]
+    if include_norm_add:
+        o = o + qin
+    return o
+
+
+class EncdecMultiheadAttn:
+    """Stateful-looking veneer with the reference constructor signature."""
+
+    def __init__(self, embed_dim: int, num_heads: int, *, dropout: float = 0.0,
+                 bias: bool = False, include_norm_add: bool = False,
+                 impl: str = "fast", dtype=jnp.float32, key=None):
+        if impl not in ("fast", "default"):
+            raise ValueError(f"unknown impl {impl!r}")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.dropout = dropout
+        self.include_norm_add = include_norm_add
+        self.use_pallas = None if impl == "fast" else False
+        key = jax.random.PRNGKey(0) if key is None else key
+        self.params = encdec_attn_init(
+            key, embed_dim, num_heads, bias=bias,
+            include_norm_add=include_norm_add, dtype=dtype,
+        )
+
+    def __call__(self, query, key_value, *, key_padding_mask=None,
+                 attn_mask=None, is_training=True, dropout_rng=None,
+                 params=None):
+        return encdec_attn_apply(
+            self.params if params is None else params,
+            query, key_value, self.num_heads,
+            key_padding_mask=key_padding_mask, attn_mask=attn_mask,
+            is_training=is_training, dropout_p=self.dropout,
+            dropout_rng=dropout_rng,
+            include_norm_add=self.include_norm_add,
+            use_pallas=self.use_pallas,
+        )

@@ -1,0 +1,23 @@
+"""Halo exchange over the ICI mesh (ref: apex/contrib/peer_memory +
+apex/contrib/nccl_p2p).
+
+The reference implements 1-D halo exchange two ways — CUDA-IPC peer memory
+(``PeerMemoryPool`` / ``PeerHaloExchanger1d``) and raw NCCL send/recv
+(``nccl_p2p_cuda``). On TPU both collapse to one idiom: a pair of
+``lax.ppermute`` shifts along a named mesh axis, which XLA lowers to direct
+ICI neighbor DMA — the hardware analog of peer memory. There is no pool to
+manage (XLA owns buffers), so the pool class is a documented no-op shim.
+"""
+
+from apex_tpu.contrib.peer_memory.halo_exchange import (  # noqa: F401
+    PeerHaloExchanger1d,
+    halo_exchange_1d,
+)
+
+
+class PeerMemoryPool:
+    """API shim (ref: peer_memory.PeerMemoryPool). On TPU, XLA manages
+    cross-chip buffers; nothing to allocate."""
+
+    def __init__(self, *args, **kwargs):
+        pass

@@ -1,0 +1,59 @@
+"""1-D halo exchange via paired ppermute shifts.
+
+Ref: apex/contrib/peer_memory/peer_halo_exchanger_1d.py::PeerHaloExchanger1d
+(and nccl_p2p's send/recv variant): each rank sends its top ``halo`` rows to
+the previous neighbor and its bottom rows to the next, concatenating the
+received halos around its local block of a spatially-partitioned tensor.
+
+Must be called inside ``shard_map`` over a mesh with the named spatial
+axis. Non-periodic boundaries (the reference's default: first/last rank
+keep zero halos) are realized by zeroing the wrapped-around halo.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def halo_exchange_1d(x, axis_name: str, *, halo: int, dim: int = 1,
+                     periodic: bool = False):
+    """x: local shard; returns x with ``halo`` rows from each neighbor
+    concatenated along ``dim`` (output grows by 2*halo).
+
+    dim counts into the *local* array (reference splits H of NHWC, dim=1).
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+
+    top = lax.slice_in_dim(x, 0, halo, axis=dim)            # my first rows
+    bot = lax.slice_in_dim(x, x.shape[dim] - halo, x.shape[dim], axis=dim)
+
+    fwd = [(i, (i + 1) % n) for i in range(n)]   # bottom rows go to next
+    bwd = [(i, (i - 1) % n) for i in range(n)]   # top rows go to prev
+
+    from_prev = lax.ppermute(bot, axis_name, fwd)  # received halo above
+    from_next = lax.ppermute(top, axis_name, bwd)  # received halo below
+
+    if not periodic:
+        from_prev = jnp.where(idx == 0, jnp.zeros_like(from_prev), from_prev)
+        from_next = jnp.where(idx == n - 1, jnp.zeros_like(from_next),
+                              from_next)
+    return jnp.concatenate([from_prev, x, from_next], axis=dim)
+
+
+class PeerHaloExchanger1d:
+    """Veneer with the reference's constructor shape (ranks/pool args are
+    replaced by the mesh axis name)."""
+
+    def __init__(self, axis_name: str, halo: int, dim: int = 1,
+                 periodic: bool = False):
+        self.axis_name = axis_name
+        self.halo = halo
+        self.dim = dim
+        self.periodic = periodic
+
+    def __call__(self, x):
+        return halo_exchange_1d(x, self.axis_name, halo=self.halo,
+                                dim=self.dim, periodic=self.periodic)

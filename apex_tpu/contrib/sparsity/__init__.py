@@ -1,0 +1,4 @@
+"""ASP — automatic structured (2:4) sparsity (ref: apex/contrib/sparsity)."""
+
+from apex_tpu.contrib.sparsity.asp import ASP  # noqa: F401
+from apex_tpu.contrib.sparsity.sparse_masklib import create_mask  # noqa: F401

@@ -1,0 +1,100 @@
+"""ASP — the pruning workflow around the mask library.
+
+Ref: apex/contrib/sparsity/asp.py::ASP (init_model_for_pruning /
+init_optimizer_for_pruning / compute_sparse_masks / restore_pruned_weights).
+The reference hooks torch optimizer.step to re-mask weights after every
+update; the JAX equivalent is an ``optax`` wrapper that masks the updates
+(params, once masked, then stay masked), plus functional helpers. A thin
+class keeps the reference's classmethod workflow for script parity.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.contrib.sparsity.sparse_masklib import create_mask
+
+
+def _eligible(path, leaf, whitelist: Optional[Callable]) -> bool:
+    if leaf.ndim < 2:
+        return False
+    if whitelist is None:
+        return True
+    return whitelist(path, leaf)
+
+
+def compute_sparse_masks(params, pattern: str = "m4n2_1d",
+                         whitelist: Optional[Callable] = None):
+    """Returns a mask pytree (1.0 everywhere for ineligible leaves).
+
+    ``whitelist(path, leaf) -> bool`` selects prunable leaves (the
+    reference whitelists [nn.Linear, nn.Conv2d] module types; paths play
+    that role here)."""
+    def mask_leaf(path, leaf):
+        if _eligible(path, leaf, whitelist):
+            return create_mask(leaf, pattern)
+        return jnp.ones_like(leaf)
+
+    return jax.tree_util.tree_map_with_path(mask_leaf, params)
+
+
+def apply_masks(params, masks):
+    return jax.tree.map(lambda p, m: (p * m).astype(p.dtype), params, masks)
+
+
+def masked_optimizer(tx: optax.GradientTransformation,
+                     masks) -> optax.GradientTransformation:
+    """Wrap an optax transform so updates (and hence params) stay sparse —
+    the analog of the reference's optimizer step/state masking hooks."""
+
+    def init_fn(params):
+        return tx.init(params)
+
+    def update_fn(grads, state, params=None):
+        updates, new_state = tx.update(grads, state, params)
+        updates = jax.tree.map(
+            lambda u, m: (u * m).astype(u.dtype), updates, masks
+        )
+        return updates, new_state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class ASP:
+    """Classmethod workflow mirroring the reference's ASP surface."""
+
+    _masks = None
+    _pattern = "m4n2_1d"
+    _whitelist = None
+
+    @classmethod
+    def init_model_for_pruning(cls, params, mask_calculator: str = "m4n2_1d",
+                               whitelist: Optional[Callable] = None,
+                               allow_recompute_mask: bool = False):
+        del allow_recompute_mask  # masks are cheap to recompute in JAX
+        cls._pattern = mask_calculator
+        cls._whitelist = whitelist
+        cls._masks = compute_sparse_masks(params, mask_calculator, whitelist)
+        return cls._masks
+
+    @classmethod
+    def init_optimizer_for_pruning(cls, tx: optax.GradientTransformation):
+        if cls._masks is None:
+            raise RuntimeError("call init_model_for_pruning first")
+        return masked_optimizer(tx, cls._masks)
+
+    @classmethod
+    def compute_sparse_masks(cls, params):
+        cls._masks = compute_sparse_masks(params, cls._pattern, cls._whitelist)
+        return apply_masks(params, cls._masks), cls._masks
+
+    @classmethod
+    def restore_pruned_weights(cls, params):
+        """Pruning is non-destructive here (masks live outside params);
+        restoring = dropping the masks."""
+        cls._masks = None
+        return params

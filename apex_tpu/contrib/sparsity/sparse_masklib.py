@@ -1,0 +1,45 @@
+"""m:n structured sparsity mask computation.
+
+Ref: apex/contrib/sparsity/sparse_masklib.py::create_mask — computes 0/1
+masks keeping the n largest-magnitude entries of every group of m along the
+row dimension (pattern "m4n2_1d" = 2:4, the Ampere sparse-tensor-core
+layout). TPU has no 2:4 hardware path, but the capability (mask search,
+pruning workflow, mask maintenance across optimizer steps) is
+hardware-agnostic; masks are computed with a vectorized top-k per group.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _mn_1d_mask(w2, m: int, n: int):
+    """w2: [R, C] with C % m == 0. Keep the n largest |w| per group of m."""
+    r, c = w2.shape
+    groups = w2.reshape(r, c // m, m)
+    mag = jnp.abs(groups)
+    # rank entries within each group; keep the top n
+    order = jnp.argsort(mag, axis=-1)  # ascending
+    ranks = jnp.argsort(order, axis=-1)
+    keep = ranks >= (m - n)
+    return keep.reshape(r, c).astype(w2.dtype)
+
+
+def create_mask(tensor, pattern: str = "m4n2_1d"):
+    """Returns a 0/1 mask of ``tensor``'s shape for the given pattern.
+
+    Supported patterns (reference names): "m4n2_1d" (2:4), "m8n2_1d",
+    and the generic "m<M>n<N>_1d". 1-D/0-D tensors and tensors whose last
+    dim is not divisible by m are left dense (mask of ones) — matching the
+    reference's eligibility rule (it only prunes >=2-D weights with
+    compatible shapes).
+    """
+    if not (pattern.startswith("m") and "_1d" in pattern and "n" in pattern):
+        raise ValueError(f"unsupported sparsity pattern {pattern!r}")
+    body = pattern[: pattern.index("_")]
+    m_str, n_str = body[1:].split("n")
+    m, n = int(m_str), int(n_str)
+    if tensor.ndim < 2 or tensor.shape[-1] % m != 0:
+        return jnp.ones_like(tensor)
+    w2 = tensor.reshape(-1, tensor.shape[-1])
+    return _mn_1d_mask(w2, m, n).reshape(tensor.shape)

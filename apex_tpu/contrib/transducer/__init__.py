@@ -1,0 +1,9 @@
+"""RNN-T transducer joint + loss (ref: apex/contrib/transducer, exts
+``transducer_joint_cuda`` / ``transducer_loss_cuda``)."""
+
+from apex_tpu.contrib.transducer.transducer import (  # noqa: F401
+    TransducerJoint,
+    TransducerLoss,
+    transducer_joint,
+    transducer_loss,
+)

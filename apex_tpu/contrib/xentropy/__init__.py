@@ -1,0 +1,37 @@
+"""Fused softmax cross-entropy (ref: apex/contrib/xentropy).
+
+The kernel lives in :mod:`apex_tpu.ops.xentropy` (ref: ext
+``xentropy_cuda``); this package provides the reference's contrib surface.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.ops.xentropy import softmax_cross_entropy  # noqa: F401
+
+
+class SoftmaxCrossEntropyLoss:
+    """Drop-in for apex.contrib.xentropy.SoftmaxCrossEntropyLoss: callable
+    loss with label smoothing; ``padding_idx`` entries contribute 0 loss
+    (the reference's ignore behavior)."""
+
+    def __init__(self, smoothing: float = 0.0, padding_idx: int = 0,
+                 reduction: str = "mean"):
+        self.smoothing = smoothing
+        self.padding_idx = padding_idx
+        self.reduction = reduction
+
+    def __call__(self, logits, labels):
+        loss = softmax_cross_entropy(logits, labels, self.smoothing)
+        if self.padding_idx is not None:
+            keep = labels != self.padding_idx
+            loss = jnp.where(keep, loss, 0.0)
+            denom = jnp.maximum(keep.sum(), 1)
+        else:
+            denom = loss.size
+        if self.reduction == "mean":
+            return loss.sum() / denom
+        if self.reduction == "sum":
+            return loss.sum()
+        return loss

@@ -9,15 +9,22 @@ TPU design: one kernel instance per (batch*heads, q-block). K/V for the
 whole row live in VMEM (the reference caps seqlen at 512; we allow any
 seqlen that fits VMEM — ~8k at d=128 in bf16) and the kernel streams over
 k-blocks with the online-softmax recurrence, keeping the (m, l, acc)
-carry in fp32. The backward is the standard flash backward split into two
-kernels: dq over q-blocks, (dk, dv) over k-blocks, both recomputing the
-probabilities from the saved log-sum-exp rather than storing the score
-matrix.
+carry in fp32. Block sizes are always multiples of 128 (Mosaic requires
+provably lane-aligned dynamic slices) and sequences are padded up. The
+backward is the standard flash backward split into two kernels: dq over
+q-blocks, (dk, dv) over k-blocks, both recomputing the probabilities from
+the saved log-sum-exp rather than storing the score matrix.
 
-Dropout on the attention probabilities follows the reference MHA semantics
-but lives in the jnp path only (kernel path requires p_dropout == 0 — the
-module layer falls back automatically; attention dropout is off in every
-headline config).
+Semantics notes:
+- A query row whose keys are ALL masked outputs 0 with zero gradient
+  (deliberately diverging from ops/softmax.scaled_masked_softmax, which
+  matches the reference kernels' uniform-attention fill for full rows —
+  for attention, 0 is the only gradient-safe choice).
+- A boolean padding mask stays compact ([B, 1, Sk] bias) instead of being
+  broadcast to the full score shape, and produces no bias gradient.
+- Dropout on the probabilities follows the reference MHA semantics but
+  lives in the jnp path only (kernel path requires p_dropout == 0 — the
+  module layer falls back automatically).
 """
 
 from __future__ import annotations
@@ -31,8 +38,14 @@ from jax.experimental import pallas as pl
 from apex_tpu.ops._utils import default_use_pallas, pallas_interpret
 
 _NEG_INF = -1e30
-_BLOCK_Q = 256
-_BLOCK_K = 256
+_VALID_THRESHOLD = -5e29  # scores below this are treated as masked-out
+_HIGHEST = jax.lax.Precision.HIGHEST
+
+
+def _block_size(s: int) -> int:
+    """Block sizes must be multiples of 128 so every dynamic slice is
+    provably lane-aligned for Mosaic."""
+    return 128 if s <= 128 else 256
 
 
 # ---------------------------------------------------------------------------
@@ -40,11 +53,11 @@ _BLOCK_K = 256
 # ---------------------------------------------------------------------------
 
 def _attn_ref(q, k, v, bias, causal, scale, dropout_p=0.0, dropout_rng=None):
-    """q,k,v: [B, S, D] (B = batch*heads flattened); bias: [B, Sq, Sk]|None."""
+    """q,k,v: [B, S, D] (B = batch*heads flattened); bias: [B, Sq|1, Sk]|None."""
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
-    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf, precision=_HIGHEST) * scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
     if causal:
@@ -52,14 +65,16 @@ def _attn_ref(q, k, v, bias, causal, scale, dropout_p=0.0, dropout_rng=None):
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         s = jnp.where(mask, s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
+    valid = s > _VALID_THRESHOLD
+    p = jnp.where(valid, jnp.exp(s - m), 0.0)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    p = p / l
-    lse = (m + jnp.log(l))[..., 0]
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    p = p / l_safe
+    lse = (m + jnp.log(l_safe))[..., 0]
     if dropout_p > 0.0:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_p, p.shape)
         p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
-    o = jnp.einsum("bqk,bkd->bqd", p, vf)
+    o = jnp.einsum("bqk,bkd->bqd", p, vf, precision=_HIGHEST)
     return o.astype(q.dtype), lse
 
 
@@ -86,6 +101,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, offset, scale, block_k, sk):
             preferred_element_type=jnp.float32,
         )                                             # [bq, bk]
         if bias_ref is not None:
+            # bias block is [bq, skp] or [1, skp] (broadcast over queries)
             s = s + bias_ref[0, :, pl.dslice(j * block_k, block_k)].astype(
                 jnp.float32
             )
@@ -96,7 +112,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, offset, scale, block_k, sk):
             )
             s = jnp.where(cols <= rows + offset, s, _NEG_INF)
         m_new = jnp.maximum(m_i, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # masked-out entries contribute exactly 0 (a fully-masked row keeps
+        # l == 0 and yields output 0, not uniform attention)
+        p = jnp.where(s > _VALID_THRESHOLD, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_i - m_new)
         l_new = l_i * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc = acc * alpha + jax.lax.dot_general(
@@ -130,29 +148,43 @@ def _pad_seq(x, block, axis):
     return x
 
 
+def _prep_bias(bias, b, sq, sk, bq, bk, sqp, skp):
+    """Pad a [B, Sq|1, Sk] bias and mask out padded key columns. Returns
+    (bias_p, broadcast_q)."""
+    if bias is not None:
+        broadcast_q = bias.shape[1] == 1
+        bias_p = bias if broadcast_q else _pad_seq(bias, bq, 1)
+        bias_p = _pad_seq(bias_p, bk, 2)
+        if skp != sk:
+            pad_cols = jnp.arange(skp) >= sk
+            bias_p = jnp.where(pad_cols[None, None, :], _NEG_INF, bias_p)
+        return bias_p, broadcast_q
+    if skp != sk:
+        pad_cols = jnp.arange(skp) >= sk
+        bias_p = jnp.broadcast_to(
+            jnp.where(pad_cols, _NEG_INF, 0.0).astype(jnp.float32)[None, None, :],
+            (b, 1, skp),
+        )
+        return bias_p, True
+    return None, False
+
+
+def _bias_spec(broadcast_q, bq, skp):
+    if broadcast_q:
+        return pl.BlockSpec((1, 1, skp), lambda i, j: (i, 0, 0))
+    return pl.BlockSpec((1, bq, skp), lambda i, j: (i, j, 0))
+
+
 def _fwd_pallas(q, k, v, bias, causal, scale):
     b, sq, d = q.shape
     sk = k.shape[1]
-    bq = min(_BLOCK_Q, max(16, sq))
-    bk = min(_BLOCK_K, max(16, sk))
+    bq = _block_size(sq)
+    bk = _block_size(sk)
     qp = _pad_seq(q, bq, 1)
     kp = _pad_seq(k, bk, 1)
     vp = _pad_seq(v, bk, 1)
     sqp, skp = qp.shape[1], kp.shape[1]
-    if bias is not None:
-        bias_p = _pad_seq(_pad_seq(bias, bq, 1), bk, 2)
-        # padded key columns must not attend
-        if skp != sk:
-            pad_cols = jnp.arange(skp) >= sk
-            bias_p = jnp.where(pad_cols[None, None, :], _NEG_INF, bias_p)
-    elif skp != sk:
-        pad_cols = jnp.arange(skp) >= sk
-        bias_p = jnp.broadcast_to(
-            jnp.where(pad_cols, _NEG_INF, 0.0).astype(jnp.float32)[None, None, :],
-            (b, sqp, skp),
-        )
-    else:
-        bias_p = None
+    bias_p, broadcast_q = _prep_bias(bias, b, sq, sk, bq, bk, sqp, skp)
 
     grid = (b, sqp // bq)
     kernel = functools.partial(
@@ -166,7 +198,7 @@ def _fwd_pallas(q, k, v, bias, causal, scale):
     ]
     args = [qp, kp, vp]
     if bias_p is not None:
-        in_specs.append(pl.BlockSpec((1, bq, skp), lambda i, j: (i, j, 0)))
+        in_specs.append(_bias_spec(broadcast_q, bq, skp))
         args.append(bias_p)
     o, lse = pl.pallas_call(
         kernel,
@@ -220,7 +252,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref, *rest,
                 jnp.int32, (bq, block_k), 1
             )
             s = jnp.where(cols <= rows + offset, s, _NEG_INF)
-        p = jnp.exp(s - lse)
+        p = jnp.where(s > _VALID_THRESHOLD, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -258,16 +290,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref, *rest,
             preferred_element_type=jnp.float32,
         ) * scale
         if bias_ref is not None:
-            s = s + bias_ref[0, pl.dslice(i * block_q, block_q)].astype(
-                jnp.float32
-            )
+            if bias_ref.shape[1] == 1:                # query-broadcast bias
+                s = s + bias_ref[0].astype(jnp.float32)
+            else:
+                s = s + bias_ref[0, pl.dslice(i * block_q, block_q)].astype(
+                    jnp.float32
+                )
         if causal:
             rows = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 0
             )
             cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
             s = jnp.where(cols <= rows + offset, s, _NEG_INF)
-        p = jnp.exp(s - lse)                          # [bq, bk]
+        p = jnp.where(s > _VALID_THRESHOLD, jnp.exp(s - lse), 0.0)  # [bq, bk]
         dv = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -293,8 +328,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref, *rest,
 def _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do):
     b, sq, d = q.shape
     sk = k.shape[1]
-    bq = min(_BLOCK_Q, max(16, sq))
-    bk = min(_BLOCK_K, max(16, sk))
+    bq = _block_size(sq)
+    bk = _block_size(sk)
     qp = _pad_seq(q, bq, 1)
     kp = _pad_seq(k, bk, 1)
     vp = _pad_seq(v, bk, 1)
@@ -310,19 +345,7 @@ def _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do):
     if sqp != sq:
         pad_rows = jnp.arange(sqp) >= sq
         lsep = jnp.where(pad_rows[None, :, None], 1e30, lsep)
-    if bias is not None:
-        bias_p = _pad_seq(_pad_seq(bias, bq, 1), bk, 2)
-        if skp != sk:
-            pad_cols = jnp.arange(skp) >= sk
-            bias_p = jnp.where(pad_cols[None, None, :], _NEG_INF, bias_p)
-    elif skp != sk:
-        pad_cols = jnp.arange(skp) >= sk
-        bias_p = jnp.broadcast_to(
-            jnp.where(pad_cols, _NEG_INF, 0.0).astype(jnp.float32)[None, None, :],
-            (b, sqp, skp),
-        )
-    else:
-        bias_p = None
+    bias_p, broadcast_q = _prep_bias(bias, b, sq, sk, bq, bk, sqp, skp)
 
     common = [qp, kp, vp, lsep, dop, deltap]
     if bias_p is not None:
@@ -337,7 +360,7 @@ def _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do):
         pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),
     ]
     if bias_p is not None:
-        dq_specs.append(pl.BlockSpec((1, bq, skp), lambda i, j: (i, j, 0)))
+        dq_specs.append(_bias_spec(broadcast_q, bq, skp))
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, causal=causal, offset=sk - sq, scale=scale,
@@ -359,7 +382,10 @@ def _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do):
         pl.BlockSpec((1, sqp, 1), lambda i, j: (i, 0, 0)),
     ]
     if bias_p is not None:
-        dkv_specs.append(pl.BlockSpec((1, sqp, bk), lambda i, j: (i, 0, j)))
+        if broadcast_q:
+            dkv_specs.append(pl.BlockSpec((1, 1, bk), lambda i, j: (i, 0, j)))
+        else:
+            dkv_specs.append(pl.BlockSpec((1, sqp, bk), lambda i, j: (i, 0, j)))
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, causal=causal, offset=sk - sq, scale=scale,
@@ -381,15 +407,64 @@ def _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do):
 
 
 # ---------------------------------------------------------------------------
+# unfused backward pieces (fallback path + dbias)
+# ---------------------------------------------------------------------------
+
+def _scores(q, k, bias, causal, scale):
+    s = jnp.einsum(
+        "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32),
+        precision=_HIGHEST,
+    ) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, _NEG_INF)
+    return s
+
+
+def _bwd_pieces(q, k, v, bias, causal, scale, o, lse, do):
+    """Shared unfused backward prologue: probabilities p and score grads ds
+    (ds IS the bias gradient pre-reduction). Materializes the [Sq, Sk]
+    score tile — used only on the fallback path and for dbias."""
+    s = _scores(q, k, bias, causal, scale)
+    p = jnp.where(s > _VALID_THRESHOLD, jnp.exp(s - lse[..., None]), 0.0)
+    do32 = do.astype(jnp.float32)
+    dp = jnp.einsum("bqd,bkd->bqk", do32, v.astype(jnp.float32),
+                    precision=_HIGHEST)
+    delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta)
+    return p, ds, do32
+
+
+def _bwd_ref(q, k, v, bias, causal, scale, o, lse, do):
+    p, ds, do32 = _bwd_pieces(q, k, v, bias, causal, scale, o, lse, do)
+    dv = jnp.einsum("bqk,bqd->bkd", p, do32, precision=_HIGHEST)
+    dq = jnp.einsum("bqk,bkd->bqd", ds, k.astype(jnp.float32),
+                    precision=_HIGHEST) * scale
+    dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32),
+                    precision=_HIGHEST) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), ds
+
+
+def _dbias_from_ds(ds, bias):
+    if bias.shape[1] == 1:
+        ds = jnp.sum(ds, axis=1, keepdims=True)
+    return ds.astype(bias.dtype)
+
+
+# ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_core(q, k, v, bias, causal, scale, use_pallas):
-    return _flash_core_fwd(q, k, v, bias, causal, scale, use_pallas)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_core(q, k, v, bias, causal, scale, use_pallas, need_dbias):
+    return _flash_core_fwd(q, k, v, bias, causal, scale, use_pallas,
+                           need_dbias)[0]
 
 
-def _flash_core_fwd(q, k, v, bias, causal, scale, use_pallas):
+def _flash_core_fwd(q, k, v, bias, causal, scale, use_pallas, need_dbias):
     use = default_use_pallas() if use_pallas is None else use_pallas
     if use:
         o, lse = _fwd_pallas(q, k, v, bias, causal, scale)
@@ -398,60 +473,24 @@ def _flash_core_fwd(q, k, v, bias, causal, scale, use_pallas):
     return o, (q, k, v, bias, o, lse)
 
 
-def _flash_core_bwd(causal, scale, use_pallas, res, do):
+def _flash_core_bwd(causal, scale, use_pallas, need_dbias, res, do):
     q, k, v, bias, o, lse = res
     use = default_use_pallas() if use_pallas is None else use_pallas
+    ds = None
     if use:
         dq, dk, dv = _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do)
     else:
-        dq, dk, dv = _bwd_ref(q, k, v, bias, causal, scale, lse, do)
+        dq, dk, dv, ds = _bwd_ref(q, k, v, bias, causal, scale, o, lse, do)
     dbias = None
     if bias is not None:
-        # recompute ds for dbias via the reference path (bias grads are only
-        # used by additive-mask MHA variants, which are small)
-        dbias = _dbias_ref(q, k, v, bias, causal, scale, lse, do)
+        if need_dbias:
+            if ds is None:  # pallas path: one unfused pass just for dbias
+                _, ds, _ = _bwd_pieces(q, k, v, bias, causal, scale, o, lse,
+                                       do)
+            dbias = _dbias_from_ds(ds, bias)
+        else:  # bias came from a boolean mask — no gradient wanted
+            dbias = jnp.zeros_like(bias)
     return dq, dk, dv, dbias
-
-
-def _bwd_ref(q, k, v, bias, causal, scale, lse, do):
-    s = jnp.einsum(
-        "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)
-    ) * scale
-    if bias is not None:
-        s = s + bias.astype(jnp.float32)
-    if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        s = jnp.where(mask, s, _NEG_INF)
-    p = jnp.exp(s - lse[..., None])
-    do32 = do.astype(jnp.float32)
-    dv = jnp.einsum("bqk,bqd->bkd", p, do32)
-    dp = jnp.einsum("bqd,bkd->bqk", do32, v.astype(jnp.float32))
-    delta = jnp.sum(do32 * _o_from(p, v), axis=-1, keepdims=True)
-    ds = p * (dp - delta)
-    dq = jnp.einsum("bqk,bkd->bqd", ds, k.astype(jnp.float32)) * scale
-    dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32)) * scale
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
-
-
-def _o_from(p, v):
-    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
-
-
-def _dbias_ref(q, k, v, bias, causal, scale, lse, do):
-    s = jnp.einsum(
-        "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)
-    ) * scale + bias.astype(jnp.float32)
-    if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        s = jnp.where(mask, s, _NEG_INF)
-    p = jnp.exp(s - lse[..., None])
-    do32 = do.astype(jnp.float32)
-    dp = jnp.einsum("bqd,bkd->bqk", do32, v.astype(jnp.float32))
-    delta = jnp.sum(do32 * _o_from(p, v), axis=-1, keepdims=True)
-    ds = p * (dp - delta)
-    return ds.astype(bias.dtype)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -475,12 +514,13 @@ def flash_attention(
     q: [..., sq, d]; k, v: [..., sk, d] (matching leading dims — typically
     [batch, heads, seq, head_dim]). ``bias`` is additive [..., sq, sk];
     ``mask`` is boolean with True = MASKED (reference padding-mask
-    convention, see ops/softmax.py) and is folded into the bias. ``causal``
-    applies the upper-triangular mask in-kernel with no materialization.
+    convention, see ops/softmax.py) and adds no O(sq*sk) materialization
+    when it only varies over keys. ``causal`` applies the upper-triangular
+    mask (diagonal offset sk-sq) in-kernel with no materialization.
 
     Ref: apex/contrib/fmha/fmha.py::FMHAFun and the fast_multihead_attn
-    attention cores; the numerics (fp32 softmax, max-subtraction) match the
-    reference's fused kernels.
+    attention cores; numerics (fp32 softmax, max-subtraction) match the
+    reference's fused kernels, except fully-masked rows (see module doc).
     """
     if q.ndim < 3:
         raise ValueError("flash_attention expects [..., seq, head_dim]")
@@ -490,6 +530,7 @@ def flash_attention(
     if scale is None:
         scale = 1.0 / (d ** 0.5)
 
+    need_dbias = bias is not None
     if mask is not None:
         mbias = jnp.where(jnp.asarray(mask, bool), _NEG_INF, 0.0).astype(
             jnp.float32
@@ -499,10 +540,14 @@ def flash_attention(
     q3 = q.reshape(-1, sq, d)
     k3 = k.reshape(-1, sk, d)
     v3 = v.reshape(-1, sk, d)
-    b = q3.shape[0]
     bias3 = None
     if bias is not None:
-        bias3 = jnp.broadcast_to(bias, lead + (sq, sk)).reshape(-1, sq, sk)
+        # keep a query-invariant bias compact: [B, 1, sk] not [B, sq, sk]
+        bsq = bias.shape[-2] if bias.ndim >= 2 else 1
+        tgt_q = 1 if bsq == 1 else sq
+        bias3 = jnp.broadcast_to(
+            bias, lead + (tgt_q, sk)
+        ).reshape(-1, tgt_q, sk)
 
     if dropout_p > 0.0:
         if dropout_rng is None:
@@ -511,7 +556,8 @@ def flash_attention(
             q3, k3, v3, bias3, causal, scale, dropout_p, dropout_rng
         )
     else:
-        o = _flash_core(q3, k3, v3, bias3, causal, scale, use_pallas)
+        o = _flash_core(q3, k3, v3, bias3, causal, scale, use_pallas,
+                        need_dbias)
     return o.reshape(lead + (sq, d))
 
 

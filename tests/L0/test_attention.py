@@ -140,3 +140,42 @@ def test_jit_and_vmap_compose():
     out = f(q, k, v)
     assert out.shape == q.shape
     assert np.isfinite(np.asarray(out, np.float32)).all()
+
+
+def test_fully_masked_rows_zero_output_and_grad():
+    # a zero-length sequence (all keys masked) must output 0 with zero grads
+    q, k, v = _make_qkv(1, 1, 32, 32, 32, jnp.float32)
+    mask = jnp.ones((1, 1, 32, 32), bool)  # everything masked
+
+    for up in (True, False):
+        out = flash_attention(q, k, v, mask=mask, use_pallas=up)
+        np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, mask=mask, use_pallas=up))
+
+        gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_array_equal(np.asarray(gq), 0.0)
+        np.testing.assert_array_equal(np.asarray(gk), 0.0)
+        np.testing.assert_array_equal(np.asarray(gv), 0.0)
+
+
+def test_key_mask_stays_compact_no_dense_bias():
+    # a [b, 1, 1, sk] padding mask must not materialize an O(sq*sk) bias
+    from apex_tpu.ops import attention as A
+
+    captured = {}
+    orig = A._fwd_pallas
+
+    def spy(q, k, v, bias, causal, scale):
+        captured["bias_shape"] = None if bias is None else bias.shape
+        return orig(q, k, v, bias, causal, scale)
+
+    A._fwd_pallas = spy
+    try:
+        q, k, v = _make_qkv(2, 2, 256, 256, 32, jnp.float32)
+        mask = jnp.zeros((2, 1, 1, 256), bool).at[..., 200:].set(True)
+        flash_attention(q, k, v, mask=mask, use_pallas=True)
+    finally:
+        A._fwd_pallas = orig
+    assert captured["bias_shape"] == (4, 1, 256), captured

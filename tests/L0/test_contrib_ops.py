@@ -1,0 +1,169 @@
+"""Contrib op tests (ref: apex/contrib/test/{focal_loss,group_norm,
+xentropy,index_mul_2d,conv_bias_relu} parity pattern: fused vs pure
+reference, values + grads)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.conv_bias_relu import conv_bias_relu, conv_bias_mask_relu
+from apex_tpu.contrib.focal_loss import FocalLoss, focal_loss
+from apex_tpu.contrib.group_norm import GroupNorm, group_norm_nhwc
+from apex_tpu.contrib.index_mul_2d import index_mul_2d
+from apex_tpu.contrib.xentropy import SoftmaxCrossEntropyLoss
+
+
+# ----------------------------------------------------------------- focal loss
+
+def _focal_ref(x, targets, nps, num_real, alpha, gamma, smoothing):
+    """Plain autodiff-able reference (no fused gradient)."""
+    x = x.astype(jnp.float32)
+    ncls = x.shape[-1]
+    t = jax.nn.one_hot(targets, ncls, dtype=jnp.float32)
+    t = t * (1.0 - smoothing) + 0.5 * smoothing
+    p = jax.nn.sigmoid(x)
+    bce = jnp.maximum(x, 0.0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * t + (1 - p) * (1 - t)
+    alpha_t = alpha * t + (1 - alpha) * (1 - t)
+    loss = alpha_t * (1 - p_t) ** gamma * bce
+    keep = (targets >= -1)[..., None] & (jnp.arange(ncls) < num_real)
+    return jnp.where(keep, loss, 0.0).sum() / jnp.maximum(nps, 1.0)
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_focal_loss_value_and_grad(smoothing):
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (64, 10)) * 2.0
+    targets = jax.random.randint(jax.random.PRNGKey(1), (64,), -2, 8)
+    nps = jnp.float32(13.0)
+
+    fused = focal_loss(x, targets, nps, 8, 0.25, 2.0, smoothing)
+    ref = _focal_ref(x, targets, nps, 8, 0.25, 2.0, smoothing)
+    np.testing.assert_allclose(float(fused), float(ref), rtol=1e-5)
+
+    g_fused = jax.grad(
+        lambda x: focal_loss(x, targets, nps, 8, 0.25, 2.0, smoothing)
+    )(x)
+    g_ref = jax.grad(
+        lambda x: _focal_ref(x, targets, nps, 8, 0.25, 2.0, smoothing)
+    )(x)
+    np.testing.assert_allclose(np.asarray(g_fused), np.asarray(g_ref),
+                               atol=1e-5, rtol=1e-4)
+    # ignored anchors (-2) and padded classes get exactly zero grad
+    ignored = np.asarray(targets) == -2
+    assert np.all(np.asarray(g_fused)[ignored] == 0)
+    assert np.all(np.asarray(g_fused)[:, 8:] == 0)
+
+
+def test_focal_loss_module():
+    fl = FocalLoss(num_real_classes=5)
+    x = jax.random.normal(jax.random.PRNGKey(2), (16, 8))
+    t = jax.random.randint(jax.random.PRNGKey(3), (16,), -1, 5)
+    out = fl(x, t, jnp.float32(4.0))
+    assert np.isfinite(float(out))
+
+
+# ----------------------------------------------------------------- group norm
+
+@pytest.mark.parametrize("act", ["none", "silu"])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_group_norm_nhwc(act, dtype):
+    n, h, w, c, g = 2, 8, 8, 32, 8
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, h, w, c)).astype(dtype)
+    gamma = jax.random.normal(jax.random.PRNGKey(1), (c,)) * 0.1 + 1.0
+    beta = jax.random.normal(jax.random.PRNGKey(2), (c,)) * 0.1
+
+    out = group_norm_nhwc(x, gamma, beta, g, act=act)
+    # reference via explicit per-group normalization
+    x32 = np.asarray(x, np.float32).reshape(n, h * w * (c // g), 1, g, order="A")
+    xr = np.asarray(x, np.float32).reshape(n, h * w, g, c // g)
+    mean = xr.mean(axis=(1, 3), keepdims=True)
+    var = xr.var(axis=(1, 3), keepdims=True)
+    ref = ((xr - mean) / np.sqrt(var + 1e-5)).reshape(n, h, w, c)
+    ref = ref * np.asarray(gamma) + np.asarray(beta)
+    if act == "silu":
+        ref = ref / (1 + np.exp(-ref)) * 1.0
+    tol = 1e-5 if dtype == jnp.float32 else 5e-2
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref, atol=tol,
+                               rtol=tol)
+    assert out.dtype == dtype
+
+
+def test_group_norm_module_and_grad():
+    gn = GroupNorm(num_groups=4, num_channels=16, act="silu")
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 16))
+
+    def loss(p):
+        return jnp.sum(gn(x, params=p) ** 2)
+
+    g = jax.grad(loss)(gn.params)
+    assert np.isfinite(np.asarray(g["weight"])).all()
+    assert np.isfinite(np.asarray(g["bias"])).all()
+
+
+# ------------------------------------------------------------------- xentropy
+
+def test_softmax_xent_loss_padding():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (32, 100))
+    labels = jax.random.randint(jax.random.PRNGKey(1), (32,), 0, 100)
+    crit = SoftmaxCrossEntropyLoss(smoothing=0.1, padding_idx=0)
+    loss = crit(logits, labels)
+    # padding entries excluded from the mean
+    keep = np.asarray(labels) != 0
+    assert np.isfinite(float(loss))
+    crit_sum = SoftmaxCrossEntropyLoss(smoothing=0.1, padding_idx=0,
+                                       reduction="sum")
+    per = SoftmaxCrossEntropyLoss(smoothing=0.1, padding_idx=0,
+                                  reduction="none")(logits, labels)
+    np.testing.assert_allclose(float(crit_sum(logits, labels)),
+                               float(np.asarray(per).sum()), rtol=1e-6)
+    np.testing.assert_allclose(
+        float(loss), float(np.asarray(per).sum() / keep.sum()), rtol=1e-6
+    )
+    assert np.all(np.asarray(per)[~keep] == 0)
+
+
+# --------------------------------------------------------------- index_mul_2d
+
+def test_index_mul_2d_fwd_bwd():
+    in1 = jax.random.normal(jax.random.PRNGKey(0), (10, 8))
+    in2 = jax.random.normal(jax.random.PRNGKey(1), (6, 8))
+    idx = jnp.array([0, 3, 3, 7, 9, 1])
+    out = index_mul_2d(in1, in2, idx)
+    ref = np.asarray(in1)[np.asarray(idx)] * np.asarray(in2)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-6)
+    # backward: scatter-add into duplicated rows of in1
+    g1 = jax.grad(lambda a: jnp.sum(index_mul_2d(a, in2, idx)))(in1)
+    expect_row3 = np.asarray(in2)[1] + np.asarray(in2)[2]
+    np.testing.assert_allclose(np.asarray(g1)[3], expect_row3, atol=1e-6)
+    assert np.all(np.asarray(g1)[2] == 0)  # unreferenced row
+
+
+# ------------------------------------------------------------- conv_bias_relu
+
+def test_conv_bias_relu_nhwc():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 3))
+    w = jax.random.normal(jax.random.PRNGKey(1), (3, 3, 3, 16)) * 0.1
+    b = jax.random.normal(jax.random.PRNGKey(2), (16,)) * 0.1
+    y = conv_bias_relu(x, w, b, stride=1, padding=1)
+    assert y.shape == (2, 8, 8, 16)
+    assert float(jnp.min(y)) >= 0.0
+    # mask variant zeroes where mask == 0
+    mask = jnp.zeros((2, 8, 8, 16)).at[:, :4].set(1.0)
+    ym = conv_bias_mask_relu(x, w, b, mask, stride=1, padding=1)
+    assert np.all(np.asarray(ym)[:, 4:] == 0)
+    g = jax.grad(lambda w: jnp.sum(conv_bias_relu(x, w, b, 1, 1) ** 2))(w)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_fast_layer_norm_alias():
+    from apex_tpu.contrib.layer_norm import FastLayerNorm
+    from apex_tpu.normalization import FusedLayerNorm
+
+    assert issubclass(FastLayerNorm, FusedLayerNorm)
+    ln = FastLayerNorm(normalized_shape=64)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 64))
+    params = ln.init(jax.random.PRNGKey(1), x)
+    y = ln.apply(params, x)
+    np.testing.assert_allclose(np.asarray(y).mean(-1), 0.0, atol=1e-5)
