@@ -22,6 +22,7 @@ _SUBMODULES = (
     "conv_bias_relu",
     "bottleneck",
     "peer_memory",
+    "optimizers",
 )
 
 

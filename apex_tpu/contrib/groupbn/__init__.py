@@ -29,12 +29,15 @@ def batch_norm_nhwc(x, params, state, *, training: bool, momentum: float = 0.9,
     """
     x32 = x.astype(jnp.float32)
     if training:
+        # two-pass moments (centered-square form): stable for large-mean
+        # inputs where E[x^2]-E[x]^2 cancels; with a bn_group the second
+        # pass reuses the group mean, so the result is still exact
         mean = jnp.mean(x32, axis=(0, 1, 2))
-        mean_sq = jnp.mean(jnp.square(x32), axis=(0, 1, 2))
         if axis_name is not None:
             mean = lax.pmean(mean, axis_name)
-            mean_sq = lax.pmean(mean_sq, axis_name)
-        var = mean_sq - jnp.square(mean)
+        var = jnp.mean(jnp.square(x32 - mean), axis=(0, 1, 2))
+        if axis_name is not None:
+            var = lax.pmean(var, axis_name)
         new_state = {
             "mean": momentum * state["mean"] + (1 - momentum) * mean,
             "var": momentum * state["var"] + (1 - momentum) * var,
@@ -81,7 +84,10 @@ class BatchNorm2d_NHWC:
             training=training, momentum=self.momentum, eps=self.eps,
             axis_name=self.bn_group, fuse_add=z, fuse_relu=self.fuse_relu,
         )
-        if state is None:
+        if state is None and not isinstance(new_state["mean"], jax.core.Tracer):
+            # only persist concrete stats: under jit, silently storing a
+            # tracer would poison the module (use the functional
+            # batch_norm_nhwc + explicit state inside train steps)
             self.state = new_state
         return y
 

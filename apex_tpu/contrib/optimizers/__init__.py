@@ -1,0 +1,17 @@
+"""Distributed (ZeRO-style) optimizers + deprecated contrib aliases
+(ref: apex/contrib/optimizers)."""
+
+from apex_tpu.contrib.optimizers.distributed_fused_adam import (  # noqa: F401
+    DistributedFusedAdam,
+)
+from apex_tpu.contrib.optimizers.distributed_fused_lamb import (  # noqa: F401
+    DistributedFusedLAMB,
+)
+
+# Deprecated reference names (apex/contrib/optimizers/fused_adam.py etc.)
+# alias the core implementations, as SURVEY.md §3.13 #16 prescribes.
+from apex_tpu.optimizers import (  # noqa: F401
+    FusedAdam,
+    FusedLAMB,
+)
+from apex_tpu.fp16_utils import FP16_Optimizer  # noqa: F401

@@ -76,7 +76,6 @@ def test_group_norm_nhwc(act, dtype):
 
     out = group_norm_nhwc(x, gamma, beta, g, act=act)
     # reference via explicit per-group normalization
-    x32 = np.asarray(x, np.float32).reshape(n, h * w * (c // g), 1, g, order="A")
     xr = np.asarray(x, np.float32).reshape(n, h * w, g, c // g)
     mean = xr.mean(axis=(1, 3), keepdims=True)
     var = xr.var(axis=(1, 3), keepdims=True)

@@ -1,0 +1,188 @@
+"""ZeRO-style distributed optimizer tests on the 8-CPU mesh (ref:
+apex/contrib/test/optimizers/test_dist_adam.py pattern: distributed result
+== single-process reference, state-sharding checks, step-skip)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from apex_tpu.contrib.optimizers import (
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+)
+
+N = 4
+
+
+def _mesh():
+    return Mesh(jax.devices("cpu")[:N], ("data",))
+
+
+def _params():
+    k = jax.random.PRNGKey(0)
+    return {
+        "dense": {"kernel": jax.random.normal(k, (13, 7)),
+                  "bias": jnp.ones((7,)) * 0.3},
+        "out": jax.random.normal(jax.random.PRNGKey(1), (7, 3)),
+    }
+
+
+def _grads(seed=2):
+    return jax.tree.map(
+        lambda p: jax.random.normal(jax.random.PRNGKey(seed), p.shape) * 0.1,
+        _params(),
+    )
+
+
+def _run_dist(opt_cls, steps=3, **kw):
+    mesh = _mesh()
+    params = _params()
+    opt = opt_cls(learning_rate=1e-2, axis_name="data", **kw)
+    opt.prepare(params, N)
+
+    def train(params):
+        state = opt.init_shard(params)
+        for i in range(steps):
+            grads = _grads(i + 10)
+            params, state = opt.step(params, grads, state)
+        return params, state.master, state.step
+
+    fn = shard_map(train, mesh=mesh, in_specs=P(),
+                   out_specs=(P(), P("data"), P()))
+    return jax.jit(fn)(params)
+
+
+def _adam_ref(params, steps, lr=1e-2, b1=0.9, b2=0.999, eps=1e-8, wd=0.0):
+    flatp, tree = jax.tree.flatten(params)
+    m = [jnp.zeros_like(p) for p in flatp]
+    v = [jnp.zeros_like(p) for p in flatp]
+    for t in range(1, steps + 1):
+        grads = jax.tree.leaves(_grads(t + 9))
+        for i, g in enumerate(grads):
+            m[i] = b1 * m[i] + (1 - b1) * g
+            v[i] = b2 * v[i] + (1 - b2) * g * g
+            mhat = m[i] / (1 - b1 ** t)
+            vhat = v[i] / (1 - b2 ** t)
+            upd = mhat / (jnp.sqrt(vhat) + eps) + wd * flatp[i]
+            flatp[i] = flatp[i] - lr * upd
+    return jax.tree.unflatten(tree, flatp)
+
+
+def test_dist_adam_matches_reference():
+    out_params, _, _ = _run_dist(DistributedFusedAdam, steps=3,
+                                 grad_averaging=False)
+    ref = _adam_ref(_params(), steps=3)
+    for a, b in zip(jax.tree.leaves(out_params), jax.tree.leaves(ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_dist_adam_state_is_sharded():
+    _, master, _ = _run_dist(DistributedFusedAdam, steps=1,
+                             grad_averaging=False)
+    total = sum(p.size for p in jax.tree.leaves(_params()))
+    padded = -(-total // N) * N
+    # each device's live shard is 1/N of the flat space (the ZeRO memory
+    # win); gathered over the mesh axis it reassembles to [padded]
+    assert master.shape == (padded,)
+
+
+def test_dist_adam_skips_on_nonfinite():
+    mesh = _mesh()
+    params = _params()
+    opt = DistributedFusedAdam(learning_rate=1e-2, axis_name="data",
+                               grad_averaging=False)
+    opt.prepare(params, N)
+    bad = jax.tree.map(lambda p: jnp.full(p.shape, jnp.nan), params)
+
+    def train(params):
+        state = opt.init_shard(params)
+        new_params, new_state = opt.step(params, bad, state)
+        return new_params, new_state.step
+
+    out, step = jax.jit(
+        shard_map(train, mesh=mesh, in_specs=P(), out_specs=(P(), P()))
+    )(params)
+    for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
+    assert int(step) == 0  # step not incremented
+
+
+def test_dist_adam_scale_unscales_grads():
+    mesh = _mesh()
+    params = _params()
+    opt = DistributedFusedAdam(learning_rate=1e-2, axis_name="data",
+                               grad_averaging=False)
+    opt.prepare(params, N)
+    g = _grads(10)
+    g_scaled = jax.tree.map(lambda x: x * 128.0, g)
+
+    def train_with(grads, scale):
+        def f(params):
+            state = opt.init_shard(params)
+            return opt.step(params, grads, state, scale=scale)[0]
+        return jax.jit(
+            shard_map(f, mesh=mesh, in_specs=P(), out_specs=P())
+        )(params)
+
+    np.testing.assert_allclose(
+        np.asarray(jax.tree.leaves(train_with(g_scaled, 128.0))[0]),
+        np.asarray(jax.tree.leaves(train_with(g, 1.0))[0]),
+        atol=1e-6,
+    )
+
+
+def _lamb_ref(params, steps, lr=1e-2, b1=0.9, b2=0.999, eps=1e-6, wd=0.01,
+              max_norm=1.0):
+    leaves, tree = jax.tree.flatten(params)
+    m = [jnp.zeros_like(p) for p in leaves]
+    v = [jnp.zeros_like(p) for p in leaves]
+    for t in range(1, steps + 1):
+        grads = jax.tree.leaves(_grads(t + 9))
+        gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in grads))
+        clip = jnp.minimum(1.0, max_norm / (gnorm + 1e-6))
+        grads = [g * clip for g in grads]
+        for i, g in enumerate(grads):
+            m[i] = b1 * m[i] + (1 - b1) * g
+            v[i] = b2 * v[i] + (1 - b2) * g * g
+            mhat = m[i] / (1 - b1 ** t)
+            vhat = v[i] / (1 - b2 ** t)
+            upd = mhat / (jnp.sqrt(vhat) + eps) + wd * leaves[i]
+            wn = jnp.sqrt(jnp.sum(leaves[i] ** 2))
+            un = jnp.sqrt(jnp.sum(upd ** 2))
+            ratio = jnp.where((wn > 0) & (un > 0), wn / un, 1.0)
+            leaves[i] = leaves[i] - lr * ratio * upd
+    return jax.tree.unflatten(tree, leaves)
+
+
+def test_dist_lamb_matches_reference():
+    out_params, _, _ = _run_dist(DistributedFusedLAMB, steps=3,
+                                 grad_averaging=False)
+    ref = _lamb_ref(_params(), steps=3)
+    for a, b in zip(jax.tree.leaves(out_params), jax.tree.leaves(ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5,
+                                   rtol=1e-5)
+
+
+def test_dist_lamb_global_scale():
+    mesh = _mesh()
+    params = _params()
+    opt = DistributedFusedLAMB(learning_rate=1e-2, axis_name="data",
+                               grad_averaging=False, max_grad_norm=None)
+    opt.prepare(params, N)
+    g = _grads(10)
+    g2 = jax.tree.map(lambda x: x * 64.0, g)
+
+    def run(grads, scale):
+        def f(params):
+            st = opt.init_shard(params)
+            st = opt.set_global_scale(st, scale)
+            return opt.step(params, grads, st)[0]
+        return jax.jit(shard_map(f, mesh=mesh, in_specs=P(), out_specs=P()))(
+            params
+        )
+
+    a = jax.tree.leaves(run(g2, 64.0))[0]
+    b = jax.tree.leaves(run(g, 1.0))[0]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
