@@ -96,8 +96,11 @@ class DistributedFusedLAMB:
 
         flat_g = flatten_fp32(grads, meta)
         if not self.clip_after_ar and self.max_grad_norm is not None:
-            # pre-allreduce clip: local grad norm (reference's fallback mode)
-            lnorm = jnp.sqrt(jnp.sum(jnp.square(flat_g)))
+            # pre-allreduce clip (reference's fallback mode). The local
+            # grads are still loss-scaled, so the norm is measured in
+            # UNSCALED units to keep the threshold comparable to the
+            # post-AR path.
+            lnorm = jnp.sqrt(jnp.sum(jnp.square(flat_g))) / state.global_scale
             flat_g = flat_g * jnp.minimum(
                 1.0, self.max_grad_norm / (lnorm + 1e-6)
             )
@@ -130,12 +133,16 @@ class DistributedFusedLAMB:
             usq = per_tensor_sq_norms(update, state.ids, nt, ax)
             wnorm = jnp.sqrt(wsq)
             unorm = jnp.sqrt(usq)
-            ratio = jnp.where(
-                (wnorm > 0) & (unorm > 0), wnorm / unorm, 1.0
-            )
-            if not self.use_nvlamb:
-                # phase-2 LAMB skips the ratio for tensors with zero norm
-                ratio = jnp.where(wnorm > 0, ratio, 1.0)
+            if self.use_nvlamb:
+                # NVLAMB applies the adaptive ratio unconditionally — a
+                # zero-norm tensor gets ratio 0 (ref: multi_tensor_lamb's
+                # use_nvlamb path has no zero guards)
+                ratio = jnp.where(unorm > 0, wnorm / unorm, 1.0)
+            else:
+                # phase-2 LAMB skips the ratio for zero-norm tensors
+                ratio = jnp.where(
+                    (wnorm > 0) & (unorm > 0), wnorm / unorm, 1.0
+                )
             # append neutral ratio for the padding segment
             ratio_full = jnp.concatenate([ratio, jnp.ones((1,), jnp.float32)])
             scale_elt = ratio_full[jnp.clip(state.ids, 0, nt)]

@@ -1,0 +1,23 @@
+"""apex_tpu.testing — standalone models + fixtures (ref:
+apex/transformer/testing).
+
+The reference ships ``standalone_gpt.py`` / ``standalone_bert.py`` (minimal
+Megatron models built only from apex.transformer parts) and a spawn-based
+``distributed_test_base``. Here the distributed base is the hermetic
+N-device CPU mesh (see tests/conftest.py); the standalone models below are
+the TP/SP-parallel flagships used by the model-level tests, the graft
+entry, and the benchmark.
+"""
+
+from apex_tpu.testing.commons import set_random_seed, smap  # noqa: F401
+from apex_tpu.testing.standalone_transformer import (  # noqa: F401
+    TransformerConfig,
+    bert_loss,
+    gpt_loss,
+    param_specs,
+    stack_layer_params,
+    transformer_forward,
+    transformer_init,
+)
+from apex_tpu.testing import standalone_gpt  # noqa: F401
+from apex_tpu.testing import standalone_bert  # noqa: F401

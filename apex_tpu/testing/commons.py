@@ -1,0 +1,24 @@
+"""Shared test/benchmark helpers (ref: apex/transformer/testing/commons.py)."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def set_random_seed(seed: int):
+    """Ref: commons.py::set_random_seed — one seed for every stream. JAX
+    PRNG is explicit, so this just returns the root key (numpy is seeded
+    for host-side data generation)."""
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def smap(body, mesh, in_specs, out_specs):
+    """shard_map with VMA checking off — model bodies mix collectives whose
+    replication the static checker cannot always infer (see
+    contrib/optimizers/_sharding.all_gather_flat for the long story)."""
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False,
+    )

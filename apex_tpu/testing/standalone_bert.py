@@ -1,0 +1,26 @@
+"""Standalone BERT (ref: apex/transformer/testing/standalone_bert.py).
+
+A bidirectional masked-LM assembled purely from apex_tpu.transformer
+parallel layers; see standalone_transformer.py for the body.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.testing.standalone_transformer import (
+    TransformerConfig,
+    bert_loss,
+    param_specs,
+    transformer_forward,
+    transformer_init,
+)
+
+
+def bert_config(**kw) -> TransformerConfig:
+    return TransformerConfig(causal=False, **kw)
+
+
+bert_init = transformer_init
+bert_forward = transformer_forward
+bert_param_specs = param_specs
+__all__ = ["bert_config", "bert_init", "bert_forward", "bert_loss",
+           "bert_param_specs"]

@@ -1,0 +1,26 @@
+"""Standalone GPT (ref: apex/transformer/testing/standalone_gpt.py).
+
+A causal LM assembled purely from apex_tpu.transformer parallel layers;
+see standalone_transformer.py for the body.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.testing.standalone_transformer import (
+    TransformerConfig,
+    gpt_loss,
+    param_specs,
+    transformer_forward,
+    transformer_init,
+)
+
+
+def gpt_config(**kw) -> TransformerConfig:
+    return TransformerConfig(causal=True, **kw)
+
+
+gpt_init = transformer_init
+gpt_forward = transformer_forward
+gpt_param_specs = param_specs
+__all__ = ["gpt_config", "gpt_init", "gpt_forward", "gpt_loss",
+           "gpt_param_specs"]

@@ -1,0 +1,255 @@
+"""Minimal Megatron-style transformer built ONLY from apex_tpu.transformer
+parts (ref: apex/transformer/testing/standalone_gpt.py /
+standalone_bert.py — the reference's parity models are likewise assembled
+purely from the library's parallel layers).
+
+Architecture (pre-LN GPT/BERT body):
+  vocab-parallel embedding (+ learned positions)
+  N x [ LN -> TP attention (column QKV, flash kernel, row proj) -> +res
+        LN -> TP MLP (column h->4h, gelu, row 4h->h)           -> +res ]
+  final LN -> vocab-parallel logits (tied embedding) -> vocab-parallel CE
+
+Everything runs shard_map-local over a mesh with ("data", "model") axes:
+the TP layers issue their own collectives, batch is sharded over "data",
+and gradient reduction over "data" is the caller's choice (DDP bucketing
+or plain psum). ``sequence_parallel`` switches the activations between TP
+blocks to seq-sharded layout with the reduce-scatter/all-gather pairs
+(Megatron SP) — the LN + dropout then run on 1/tp of the tokens.
+
+GPT = causal attention, next-token loss. BERT = bidirectional attention,
+masked-position loss. Dropout keys follow the frozen MP RNG spec
+(random.py): TP-rank-varying for activation dropout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.layer_norm import layer_norm
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_embedding,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    gather_from_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.random import model_parallel_seed
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 512
+    seq_len: int = 64
+    hidden: int = 64
+    layers: int = 2
+    heads: int = 4
+    ffn_mult: int = 4
+    causal: bool = True            # GPT; False = BERT
+    sequence_parallel: bool = False
+    dropout_p: float = 0.0
+    dtype: object = jnp.float32
+    model_axis: str = "model"
+    remat: bool = False            # activation checkpointing per block
+    scan_layers: bool = False      # lax.scan over stacked layer params
+                                   # (compile time O(1) in depth; pass
+                                   # params through stack_layer_params)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+
+def transformer_init(key, cfg: TransformerConfig):
+    """Full (unsharded) parameters; shard via ``param_specs`` in_specs."""
+    h, ffn = cfg.hidden, cfg.hidden * cfg.ffn_mult
+    keys = iter(jax.random.split(key, 4 + 6 * cfg.layers))
+
+    def norm(k, shape, scale):
+        return (jax.random.normal(k, shape) * scale).astype(cfg.dtype)
+
+    params = {
+        "embedding": norm(next(keys), (cfg.vocab_size, h), 0.02),
+        "pos_embedding": norm(next(keys), (cfg.seq_len, h), 0.02),
+        "final_ln": {"gamma": jnp.ones((h,), cfg.dtype),
+                     "beta": jnp.zeros((h,), cfg.dtype)},
+        "layers": [],
+    }
+    for _ in range(cfg.layers):
+        params["layers"].append({
+            "ln1": {"gamma": jnp.ones((h,), cfg.dtype),
+                    "beta": jnp.zeros((h,), cfg.dtype)},
+            "qkv": {"kernel": norm(next(keys), (h, 3 * h), 0.02),
+                    "bias": jnp.zeros((3 * h,), cfg.dtype)},
+            "proj": {"kernel": norm(next(keys), (h, h),
+                                    0.02 / (2 * cfg.layers) ** 0.5),
+                     "bias": jnp.zeros((h,), cfg.dtype)},
+            "ln2": {"gamma": jnp.ones((h,), cfg.dtype),
+                    "beta": jnp.zeros((h,), cfg.dtype)},
+            "fc1": {"kernel": norm(next(keys), (h, ffn), 0.02),
+                    "bias": jnp.zeros((ffn,), cfg.dtype)},
+            "fc2": {"kernel": norm(next(keys), (ffn, h),
+                                   0.02 / (2 * cfg.layers) ** 0.5),
+                    "bias": jnp.zeros((h,), cfg.dtype)},
+        })
+    return params
+
+
+def stack_layer_params(params):
+    """[{...}] * L -> one pytree of [L, ...] arrays (for scan_layers)."""
+    return dict(params, layers=jax.tree.map(
+        lambda *xs: jnp.stack(xs), *params["layers"]
+    ))
+
+
+def param_specs(cfg: TransformerConfig):
+    """PartitionSpecs for shard_map in_specs (Megatron layout: QKV/fc1
+    column-split on the out dim, proj/fc2 row-split on the in dim, embedding
+    vocab-split). With ``scan_layers`` the per-layer specs gain the stacked
+    leading dim."""
+    ax = cfg.model_axis
+
+    def lspec(*tail):
+        return P(None, *tail) if cfg.scan_layers else P(*tail)
+
+    layer = {
+        "ln1": {"gamma": lspec(), "beta": lspec()},
+        "qkv": {"kernel": lspec(None, ax), "bias": lspec(ax)},
+        "proj": {"kernel": lspec(ax, None), "bias": lspec()},
+        "ln2": {"gamma": lspec(), "beta": lspec()},
+        "fc1": {"kernel": lspec(None, ax), "bias": lspec(ax)},
+        "fc2": {"kernel": lspec(ax, None), "bias": lspec()},
+    }
+    return {
+        "embedding": P(ax, None),
+        "pos_embedding": P(),
+        "final_ln": {"gamma": P(), "beta": P()},
+        "layers": layer if cfg.scan_layers
+        else [dict(layer) for _ in range(cfg.layers)],
+    }
+
+
+def _attention(lp, x, cfg: TransformerConfig, dropout_key):
+    """x: [s(, /tp if SP), b, h] -> same. Column QKV (no output gather) ->
+    flash attention on the tp-local heads -> row projection."""
+    ax = cfg.model_axis
+    qkv = column_parallel_linear(
+        x, lp["qkv"]["kernel"], lp["qkv"]["bias"], axis=ax,
+        gather_output=False,
+        sequence_parallel_enabled=cfg.sequence_parallel,
+    )                                     # [s, b, 3h/tp]
+    s, b = qkv.shape[0], qkv.shape[1]
+    n_local = qkv.shape[-1] // (3 * cfg.head_dim)
+    qkv = qkv.reshape(s, b, 3, n_local, cfg.head_dim)
+    # [s, b, 3, nh, d] -> 3 x [b, nh, s, d]
+    q, k, v = (qkv[:, :, i].transpose(1, 2, 0, 3) for i in range(3))
+    o = flash_attention(q, k, v, causal=cfg.causal)
+    o = o.transpose(2, 0, 1, 3).reshape(s, b, n_local * cfg.head_dim)
+    o = row_parallel_linear(
+        o, lp["proj"]["kernel"], lp["proj"]["bias"], axis=ax,
+        input_is_parallel=True,
+        sequence_parallel_enabled=cfg.sequence_parallel,
+    )
+    if cfg.dropout_p > 0.0:
+        keep = jax.random.bernoulli(dropout_key, 1 - cfg.dropout_p, o.shape)
+        o = jnp.where(keep, o / (1 - cfg.dropout_p), 0.0).astype(o.dtype)
+    return o
+
+
+def _mlp(lp, x, cfg: TransformerConfig, dropout_key):
+    ax = cfg.model_axis
+    y = column_parallel_linear(
+        x, lp["fc1"]["kernel"], lp["fc1"]["bias"], axis=ax,
+        gather_output=False,
+        sequence_parallel_enabled=cfg.sequence_parallel,
+    )
+    y = jax.nn.gelu(y)
+    y = row_parallel_linear(
+        y, lp["fc2"]["kernel"], lp["fc2"]["bias"], axis=ax,
+        input_is_parallel=True,
+        sequence_parallel_enabled=cfg.sequence_parallel,
+    )
+    if cfg.dropout_p > 0.0:
+        keep = jax.random.bernoulli(dropout_key, 1 - cfg.dropout_p, y.shape)
+        y = jnp.where(keep, y / (1 - cfg.dropout_p), 0.0).astype(y.dtype)
+    return y
+
+
+def transformer_forward(params, tokens, cfg: TransformerConfig, *,
+                        seed: int = 1234):
+    """tokens: [b, s] int32 (shard_map-local batch shard). Returns
+    vocab-parallel logits [s, b, v/tp]."""
+    ax = cfg.model_axis
+    emb = vocab_parallel_embedding(tokens, params["embedding"], axis=ax)
+    x = (emb + params["pos_embedding"][None, : tokens.shape[1]]).astype(
+        cfg.dtype
+    )
+    x = x.transpose(1, 0, 2)              # [s, b, h] (Megatron layout)
+    if cfg.sequence_parallel:
+        x = scatter_to_sequence_parallel_region(x, ax)
+    # TP-rank-varying dropout keys per the frozen MP RNG spec
+    mp_key = model_parallel_seed(seed, ax).model_parallel
+
+    def block(x, lp, i):
+        k1 = jax.random.fold_in(mp_key, 2 * i)
+        k2 = jax.random.fold_in(mp_key, 2 * i + 1)
+        x = x + _attention(
+            lp, layer_norm(x, lp["ln1"]["gamma"], lp["ln1"]["beta"]), cfg, k1
+        )
+        x = x + _mlp(
+            lp, layer_norm(x, lp["ln2"]["gamma"], lp["ln2"]["beta"]), cfg, k2
+        )
+        return x
+
+    if cfg.remat:
+        block = jax.checkpoint(block)
+    if cfg.scan_layers:
+        x, _ = jax.lax.scan(
+            lambda carry, li: (block(carry, li[0], li[1]), None),
+            x, (params["layers"], jnp.arange(cfg.layers)),
+        )
+    else:
+        for i, lp in enumerate(params["layers"]):
+            x = block(x, lp, i)
+    if cfg.sequence_parallel:
+        x = gather_from_sequence_parallel_region(x, ax, True)
+    x = layer_norm(x, params["final_ln"]["gamma"], params["final_ln"]["beta"])
+    # tied-embedding vocab-parallel logits: [s, b, h] @ [h, v/tp]
+    logits = jnp.matmul(
+        x.astype(jnp.float32),
+        params["embedding"].astype(jnp.float32).T,
+        preferred_element_type=jnp.float32,
+    )
+    return logits
+
+
+def gpt_loss(params, tokens, cfg: TransformerConfig, *, seed: int = 1234):
+    """Next-token LM loss, mean over (s-1)*b tokens (shard_map-local; mean
+    over the data axis is the caller's psum)."""
+    logits = transformer_forward(params, tokens, cfg, seed=seed)
+    targets = tokens[:, 1:].transpose(1, 0)          # [s-1, b]
+    losses = vocab_parallel_cross_entropy(
+        logits[:-1], targets, axis=cfg.model_axis
+    )
+    return losses.mean()
+
+
+def bert_loss(params, tokens, labels, loss_mask, cfg: TransformerConfig, *,
+              seed: int = 1234):
+    """Masked-LM loss: CE at masked positions only (labels [b, s],
+    loss_mask [b, s] with 1 = predict here)."""
+    logits = transformer_forward(params, tokens, cfg, seed=seed)
+    losses = vocab_parallel_cross_entropy(
+        logits, labels.transpose(1, 0), axis=cfg.model_axis
+    )
+    mask = loss_mask.transpose(1, 0).astype(jnp.float32)
+    return (losses * mask).sum() / jnp.maximum(mask.sum(), 1.0)

@@ -1,0 +1,158 @@
+"""Standalone GPT/BERT model-level tests (ref: tests/L0/run_transformer/
+test_gpt_minimal.py / test_bert_minimal.py: the models train for N steps
+across a (tp, dp) grid and losses match the single-device reference)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel.mesh import cpu_mesh
+from apex_tpu.testing import (
+    TransformerConfig,
+    bert_loss,
+    gpt_loss,
+    param_specs,
+    smap,
+    transformer_init,
+)
+
+CFG = dict(vocab_size=96, seq_len=16, hidden=32, layers=2, heads=4)
+
+
+def _tokens(b=8, s=16, seed=0):
+    return jax.random.randint(jax.random.PRNGKey(seed), (b, s), 0, 96)
+
+
+def _single_device_loss(cfg1, params, tokens, loss_fn=gpt_loss, **kw):
+    """tp=1 reference run on a 1-device mesh axis."""
+    mesh = cpu_mesh({"model": 1})
+    fn = smap(
+        lambda p, t: loss_fn(p, t, cfg1, **kw),
+        mesh, (param_specs(cfg1), P()), P(),
+    )
+    return float(jax.jit(fn)(params, tokens))
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_gpt_tp_matches_single_device(tp):
+    cfg = TransformerConfig(**CFG)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    tokens = _tokens()
+    ref = _single_device_loss(cfg, params, tokens)
+
+    mesh = cpu_mesh({"model": tp})
+    fn = smap(lambda p, t: gpt_loss(p, t, cfg), mesh,
+              (param_specs(cfg), P()), P())
+    out = float(jax.jit(fn)(params, tokens))
+    np.testing.assert_allclose(out, ref, rtol=1e-3)
+
+
+def test_gpt_sequence_parallel_matches(tp=4):
+    cfg = TransformerConfig(**CFG)
+    cfg_sp = TransformerConfig(**CFG, sequence_parallel=True)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    tokens = _tokens()
+    ref = _single_device_loss(cfg, params, tokens)
+
+    mesh = cpu_mesh({"model": tp})
+    fn = smap(lambda p, t: gpt_loss(p, t, cfg_sp), mesh,
+              (param_specs(cfg_sp), P()), P())
+    out = float(jax.jit(fn)(params, tokens))
+    np.testing.assert_allclose(out, ref, rtol=1e-3)
+
+
+def test_bert_tp_matches_single_device():
+    cfg = TransformerConfig(**CFG, causal=False)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    tokens = _tokens()
+    labels = _tokens(seed=1)
+    mask = (jax.random.uniform(jax.random.PRNGKey(2), (8, 16)) < 0.15)
+
+    mesh1 = cpu_mesh({"model": 1})
+    ref = float(jax.jit(smap(
+        lambda p, t: bert_loss(p, t, labels, mask, cfg),
+        mesh1, (param_specs(cfg), P()), P(),
+    ))(params, tokens))
+
+    mesh = cpu_mesh({"model": 4})
+    out = float(jax.jit(smap(
+        lambda p, t: bert_loss(p, t, labels, mask, cfg),
+        mesh, (param_specs(cfg), P()), P(),
+    ))(params, tokens))
+    np.testing.assert_allclose(out, ref, rtol=1e-3)
+
+
+def test_gpt_tp_dp_grid_trains():
+    """2x2 (dp, tp) grid: grads psum'd over data; loss decreases."""
+    cfg = TransformerConfig(**CFG)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    mesh = cpu_mesh({"data": 2, "model": 2})
+    tokens = _tokens(b=8)
+    tx = optax.adam(5e-3)
+
+    specs = param_specs(cfg)
+
+    def train(params, tokens):
+        state = tx.init(params)
+
+        def body(carry, _):
+            params, state = carry
+            loss, grads = jax.value_and_grad(
+                lambda p: gpt_loss(p, tokens, cfg)
+            )(params)
+            grads = jax.tree.map(
+                lambda g: jax.lax.pmean(g, "data"), grads
+            )
+            loss = jax.lax.pmean(loss, "data")
+            updates, state = tx.update(grads, state, params)
+            return (optax.apply_updates(params, updates), state), loss
+
+        (params, _), losses = jax.lax.scan(body, (params, state), None,
+                                           length=20)
+        return losses
+
+    losses = jax.jit(smap(
+        train, mesh, (specs, P("data")), P(),
+    ))(params, tokens)
+    losses = np.asarray(losses)
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_gpt_dropout_tp_rank_varying():
+    """Dropout masks differ across TP ranks (the MP RNG contract)."""
+    cfg = TransformerConfig(**CFG, dropout_p=0.5)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    tokens = _tokens()
+    mesh = cpu_mesh({"model": 2})
+
+    # per-rank *pre-reduction* attention outputs must differ between ranks;
+    # easiest observable: the final loss changes between two different seeds
+    # but is deterministic for a fixed seed
+    fn = lambda seed: float(jax.jit(smap(
+        lambda p, t: gpt_loss(p, t, cfg, seed=seed),
+        mesh, (param_specs(cfg), P()), P(),
+    ))(params, tokens))
+    a, b, c = fn(1), fn(1), fn(2)
+    assert a == b
+    assert a != c
+
+
+def test_gpt_scan_layers_and_remat_match_loop():
+    from apex_tpu.testing import stack_layer_params
+
+    cfg = TransformerConfig(**CFG)
+    cfg_scan = TransformerConfig(**CFG, scan_layers=True, remat=True)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    tokens = _tokens()
+    ref = _single_device_loss(cfg, params, tokens)
+
+    stacked = stack_layer_params(params)
+    mesh = cpu_mesh({"model": 2})
+    out = float(jax.jit(smap(
+        lambda p, t: gpt_loss(p, t, cfg_scan), mesh,
+        (param_specs(cfg_scan), P()), P(),
+    ))(stacked, tokens))
+    np.testing.assert_allclose(out, ref, rtol=1e-3)
