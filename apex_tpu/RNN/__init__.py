@@ -1,0 +1,15 @@
+"""apex.RNN parity stub (ref: apex/RNN — deprecated upstream).
+
+The reference's fp16 RNN wrappers were deprecated and frozen years ago
+(apex/RNN/README: "under construction... use at your own risk"). Per
+SURVEY.md §3.11 these are documented-and-skipped: importing raises with
+guidance, mirroring how the reference steers users away.
+"""
+
+
+def __getattr__(name):
+    raise ImportError(
+        "apex_tpu.RNN is intentionally not implemented: the reference "
+        "apex.RNN is deprecated/frozen upstream. Use flax.linen RNN cells "
+        "with apex_tpu.amp for mixed precision."
+    )

@@ -1,0 +1,72 @@
+"""Native host-side helpers with numpy fallback (ref: ext ``apex_C``).
+
+``flatten``/``unflatten`` mirror apex_C.flatten/unflatten for host arrays
+(checkpoint staging, data paths); ``has_inf_or_nan`` is the loss-scaler
+host scan. The C extension is built on first import (cc -O3, ~1s) and the
+pure-numpy fallback keeps everything working where no compiler exists.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from apex_tpu._native.build import build as _build
+
+_C = None
+_so = _build()
+if _so is not None:
+    try:
+        import importlib.util
+
+        _spec = importlib.util.spec_from_file_location("_apex_tpu_C", _so)
+        _C = importlib.util.module_from_spec(_spec)
+        _spec.loader.exec_module(_C)
+    except Exception:  # pragma: no cover
+        _C = None
+
+HAVE_NATIVE = _C is not None
+
+
+def flatten(arrays):
+    """Concatenate host arrays into one flat array of the common dtype
+    (ref: apex_C.flatten)."""
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    if not arrays:
+        return np.empty((0,), np.float32)
+    dtype = arrays[0].dtype
+    if any(a.dtype != dtype for a in arrays):
+        raise ValueError("flatten: arrays must share a dtype (ref asserts)")
+    total = sum(a.size for a in arrays)
+    out = np.empty((total,), dtype)
+    if HAVE_NATIVE:
+        _C.flatten_into(out, list(arrays))
+    else:
+        off = 0
+        for a in arrays:
+            out[off:off + a.size] = a.reshape(-1)
+            off += a.size
+    return out
+
+
+def unflatten(flat, like):
+    """Split a flat array back into arrays shaped like ``like``
+    (ref: apex_C.unflatten)."""
+    flat = np.ascontiguousarray(flat)
+    outs = [np.empty(np.shape(a), flat.dtype) for a in like]
+    if HAVE_NATIVE:
+        _C.unflatten_from(flat, outs)
+    else:
+        off = 0
+        for o in outs:
+            o[...] = flat[off:off + o.size].reshape(o.shape)
+            off += o.size
+    return outs
+
+
+def has_inf_or_nan(array) -> bool:
+    """Host-side overflow check (ref: fp16_utils
+    DynamicLossScaler.has_inf_or_nan)."""
+    a = np.ascontiguousarray(array)
+    if HAVE_NATIVE and a.dtype == np.float32:
+        return bool(_C.has_inf_or_nan_f32(a))
+    return not bool(np.isfinite(a).all())

@@ -1,0 +1,36 @@
+"""One-shot in-place build of the _apex_tpu_C extension via setuptools
+(no pybind11 in the image — plain CPython C API; see csrc/apex_tpu_C.c)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def build(verbose: bool = False) -> str | None:
+    """Compile csrc/apex_tpu_C.c into this package directory. Returns the
+    built path or None on failure (callers fall back to numpy)."""
+    src = os.path.join(_PKG_DIR, "csrc", "apex_tpu_C.c")
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = os.path.join(_PKG_DIR, "_apex_tpu_C" + suffix)
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    cc = sysconfig.get_config_var("CC") or "cc"
+    include = sysconfig.get_paths()["include"]
+    cmd = cc.split() + [
+        "-O3", "-shared", "-fPIC", f"-I{include}", src, "-o", out,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True,
+            capture_output=not verbose,
+        )
+        return out
+    except (subprocess.CalledProcessError, OSError) as e:  # pragma: no cover
+        if verbose:
+            print(f"_apex_tpu_C build failed: {e}", file=sys.stderr)
+        return None

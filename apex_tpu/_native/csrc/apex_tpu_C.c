@@ -1,0 +1,154 @@
+/* _apex_tpu_C — native host-side helpers.
+ *
+ * Ref: csrc/flatten_unflatten.cpp (ext `apex_C`: flatten/unflatten used by
+ * apex.parallel.DistributedDataParallel's flat buckets) and the host-side
+ * inf/nan scan in apex/fp16_utils/loss_scaler.py::DynamicLossScaler.
+ *
+ * On TPU the *device-side* flattening is XLA's job (see parallel/ddp.py),
+ * but host-side staging still shows up in checkpoint IO and data paths;
+ * these helpers do GIL-released memcpy/scans over any objects exporting
+ * the buffer protocol. Pure C (CPython API only — no pybind11 in the
+ * image), built by apex_tpu/_native/build.py via setuptools.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <math.h>
+#include <string.h>
+
+/* flatten_into(dst, [src, ...]) -> bytes copied
+ * dst: writable contiguous buffer; srcs are copied back-to-back. */
+static PyObject *
+flatten_into(PyObject *self, PyObject *args)
+{
+    PyObject *dst_obj, *src_list;
+    if (!PyArg_ParseTuple(args, "OO!", &dst_obj, &PyList_Type, &src_list))
+        return NULL;
+
+    Py_buffer dst;
+    if (PyObject_GetBuffer(dst_obj, &dst, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS))
+        return NULL;
+
+    Py_ssize_t n = PyList_GET_SIZE(src_list);
+    Py_ssize_t total = 0;
+    Py_buffer *srcs = PyMem_Malloc(sizeof(Py_buffer) * (n ? n : 1));
+    if (!srcs) {
+        PyBuffer_Release(&dst);
+        return PyErr_NoMemory();
+    }
+    Py_ssize_t got = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (PyObject_GetBuffer(PyList_GET_ITEM(src_list, i), &srcs[i],
+                               PyBUF_C_CONTIGUOUS)) {
+            for (Py_ssize_t j = 0; j < got; j++)
+                PyBuffer_Release(&srcs[j]);
+            PyMem_Free(srcs);
+            PyBuffer_Release(&dst);
+            return NULL;
+        }
+        got++;
+        total += srcs[i].len;
+    }
+    if (total > dst.len) {
+        for (Py_ssize_t j = 0; j < got; j++)
+            PyBuffer_Release(&srcs[j]);
+        PyMem_Free(srcs);
+        PyBuffer_Release(&dst);
+        PyErr_SetString(PyExc_ValueError, "flatten_into: dst too small");
+        return NULL;
+    }
+
+    char *out = (char *)dst.buf;
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t i = 0; i < n; i++) {
+        memcpy(out, srcs[i].buf, (size_t)srcs[i].len);
+        out += srcs[i].len;
+    }
+    Py_END_ALLOW_THREADS
+
+    for (Py_ssize_t j = 0; j < got; j++)
+        PyBuffer_Release(&srcs[j]);
+    PyMem_Free(srcs);
+    PyBuffer_Release(&dst);
+    return PyLong_FromSsize_t(total);
+}
+
+/* unflatten_from(src, [dst, ...]) -> bytes copied */
+static PyObject *
+unflatten_from(PyObject *self, PyObject *args)
+{
+    PyObject *src_obj, *dst_list;
+    if (!PyArg_ParseTuple(args, "OO!", &src_obj, &PyList_Type, &dst_list))
+        return NULL;
+
+    Py_buffer src;
+    if (PyObject_GetBuffer(src_obj, &src, PyBUF_C_CONTIGUOUS))
+        return NULL;
+
+    Py_ssize_t n = PyList_GET_SIZE(dst_list);
+    Py_ssize_t off = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        Py_buffer dst;
+        if (PyObject_GetBuffer(PyList_GET_ITEM(dst_list, i), &dst,
+                               PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS)) {
+            PyBuffer_Release(&src);
+            return NULL;
+        }
+        if (off + dst.len > src.len) {
+            PyBuffer_Release(&dst);
+            PyBuffer_Release(&src);
+            PyErr_SetString(PyExc_ValueError, "unflatten_from: src too small");
+            return NULL;
+        }
+        Py_BEGIN_ALLOW_THREADS
+        memcpy(dst.buf, (char *)src.buf + off, (size_t)dst.len);
+        Py_END_ALLOW_THREADS
+        off += dst.len;
+        PyBuffer_Release(&dst);
+    }
+    PyBuffer_Release(&src);
+    return PyLong_FromSsize_t(off);
+}
+
+/* has_inf_or_nan_f32(buf) -> bool — GIL-released scan of float32 data */
+static PyObject *
+has_inf_or_nan_f32(PyObject *self, PyObject *args)
+{
+    PyObject *obj;
+    if (!PyArg_ParseTuple(args, "O", &obj))
+        return NULL;
+    Py_buffer buf;
+    if (PyObject_GetBuffer(obj, &buf, PyBUF_C_CONTIGUOUS))
+        return NULL;
+    const float *p = (const float *)buf.buf;
+    Py_ssize_t count = buf.len / (Py_ssize_t)sizeof(float);
+    int found = 0;
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t i = 0; i < count; i++) {
+        if (!isfinite(p[i])) { found = 1; break; }
+    }
+    Py_END_ALLOW_THREADS
+    PyBuffer_Release(&buf);
+    if (found) Py_RETURN_TRUE;
+    Py_RETURN_FALSE;
+}
+
+static PyMethodDef Methods[] = {
+    {"flatten_into", flatten_into, METH_VARARGS,
+     "Copy a list of contiguous buffers back-to-back into dst."},
+    {"unflatten_from", unflatten_from, METH_VARARGS,
+     "Scatter a contiguous buffer into a list of writable buffers."},
+    {"has_inf_or_nan_f32", has_inf_or_nan_f32, METH_VARARGS,
+     "True if any float32 element is inf or NaN."},
+    {NULL, NULL, 0, NULL}
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_apex_tpu_C", NULL, -1, Methods
+};
+
+PyMODINIT_FUNC
+PyInit__apex_tpu_C(void)
+{
+    return PyModule_Create(&moduledef);
+}

@@ -79,11 +79,23 @@ class AmpOptimizer:
     def scale_loss(self, loss, state: AmpOptState):
         return self.scaler.scale_loss(state.scaler, loss)
 
-    def apply_gradients(self, grads, state: AmpOptState, params):
-        """Returns ``(new_params, new_state)`` with overflow-safe semantics."""
+    def apply_gradients(self, grads, state: AmpOptState, params,
+                        found_inf_axes=()):
+        """Returns ``(new_params, new_state)`` with overflow-safe semantics.
+
+        ``found_inf_axes``: mesh axis names to reduce the overflow flag
+        over — the analog of apex/transformer/amp/grad_scaler.py's
+        MP-aware GradScaler (allreduce found_inf across the model-parallel
+        group so all TP/PP ranks skip steps together). Pass e.g.
+        ``("model",)`` when grads are TP-sharded inside shard_map.
+        """
         import optax
 
         grads32, found_inf = self.scaler.unscale(state.scaler, grads)
+        for ax in found_inf_axes:
+            found_inf = jax.lax.psum(
+                found_inf.astype(jnp.float32), ax
+            ) > 0.0
 
         target = state.master if state.master is not None else params
         updates, inner_new = self.tx.update(grads32, state.inner, target)

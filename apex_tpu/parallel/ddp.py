@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.parallel.mesh import DATA_AXIS
+from apex_tpu.utils.profiling import trace_range
 
 
 def _leaf_bytes(x) -> int:
@@ -110,15 +111,17 @@ class DistributedDataParallel:
 
         flat_buckets = []
         reduced_leaves = [None] * len(leaves)
-        for bucket in self._buckets(leaves):
-            parts = []
-            for i in bucket:
-                x = leaves[i]
-                x32 = x.astype(jnp.float32) if self.allreduce_always_fp32 else x
-                parts.append((x32 * pre).reshape(-1))
-            flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
-            flat = lax.psum(flat, self.axis_name)
-            flat = flat * post
+        for bi, bucket in enumerate(self._buckets(leaves)):
+            # profiling seam (ref: DDP prof flag -> nvtx around bucket ops)
+            with trace_range(f"ddp_bucket_allreduce_{bi}"):
+                parts = []
+                for i in bucket:
+                    x = leaves[i]
+                    x32 = x.astype(jnp.float32) if self.allreduce_always_fp32 else x
+                    parts.append((x32 * pre).reshape(-1))
+                flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+                flat = lax.psum(flat, self.axis_name)
+                flat = flat * post
             flat_buckets.append(flat)
             # unpack
             offset = 0

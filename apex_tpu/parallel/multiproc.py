@@ -1,0 +1,45 @@
+"""Multi-host launcher helper (ref: apex/parallel/multiproc.py).
+
+The reference's launcher spawns one process per GPU and sets RANK/WORLD_SIZE
+for ``torch.distributed``. On TPU pods the runtime launches one process per
+host; what remains is coordinator discovery — ``jax.distributed.initialize``
+— after which every chip appears in ``jax.devices()`` and SPMD takes over
+(no per-chip processes, no process groups).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+import jax
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Bring up multi-host JAX (ref capability: multiproc launcher + torch
+    init_process_group rendezvous). On Cloud TPU the arguments are
+    auto-detected; pass them explicitly elsewhere."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def main():  # pragma: no cover - host-environment dependent
+    """CLI shim: the reference's ``python -m apex.parallel.multiproc`` is a
+    GPU process spawner; on TPU it reduces to an env sanity check."""
+    warnings.warn(
+        "apex_tpu.parallel.multiproc: TPU runtimes launch one process per "
+        "host; call apex_tpu.parallel.multiproc.initialize() (or rely on "
+        "auto-init) instead of spawning per-chip processes.",
+        stacklevel=1,
+    )
+    print(f"process {os.environ.get('CLOUD_TPU_TASK_ID', '?')}: "
+          f"{jax.device_count()} devices visible")
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

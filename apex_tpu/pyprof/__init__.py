@@ -1,0 +1,13 @@
+"""apex.pyprof parity stub (ref: apex/pyprof/__init__.py — REMOVED upstream,
+stub raising ImportError pointing at NVIDIA/PyProf).
+
+The TPU profiling path is :mod:`apex_tpu.utils.profiling` (jax.profiler
+traces viewable in TensorBoard/Perfetto).
+"""
+
+
+def __getattr__(name):
+    raise ImportError(
+        "apex_tpu.pyprof mirrors the reference's removed apex.pyprof stub. "
+        "Use apex_tpu.utils.profiling (jax.profiler) instead."
+    )

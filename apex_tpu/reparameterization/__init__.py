@@ -1,0 +1,38 @@
+"""Weight-norm reparameterization (ref: apex/reparameterization/*, ≈700 LoC
+of fp16-safe weight norm; deprecated upstream).
+
+w = g * v / ||v||, with the norm over all dims except ``dim``. Functional:
+params hold (v, g); ``weight_norm_apply`` materializes w inside the forward
+(autodiff produces the same gradients the reference's hand backward
+computes, in fp32).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def weight_norm_init(weight, dim: int = 0):
+    """Split a weight into (v, g) such that apply(v, g) == weight."""
+    norm = _norm_except(weight, dim)
+    return {"v": weight, "g": norm}
+
+
+def weight_norm_apply(v, g, dim: int = 0):
+    """w = g * v / ||v|| (norm over all dims except ``dim``), fp32 math."""
+    v32 = v.astype(jnp.float32)
+    norm = _norm_except(v32, dim)
+    return (v32 * (g.astype(jnp.float32) / norm)).astype(v.dtype)
+
+
+def remove_weight_norm(v, g, dim: int = 0):
+    """Collapse back to a plain weight (ref: remove_weight_norm)."""
+    return weight_norm_apply(v, g, dim)
+
+
+def _norm_except(w, dim: int):
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    norm = jnp.sqrt(
+        jnp.sum(jnp.square(w.astype(jnp.float32)), axis=axes, keepdims=True)
+    )
+    return jnp.maximum(norm, 1e-12)

@@ -196,8 +196,14 @@ def transformer_forward(params, tokens, cfg: TransformerConfig, *,
     x = x.transpose(1, 0, 2)              # [s, b, h] (Megatron layout)
     if cfg.sequence_parallel:
         x = scatter_to_sequence_parallel_region(x, ax)
-    # TP-rank-varying dropout keys per the frozen MP RNG spec
-    mp_key = model_parallel_seed(seed, ax).model_parallel
+    # Output dropout follows the reference's RNG discipline: the outputs of
+    # row-parallel layers are TP-REPLICATED when SP is off, so their dropout
+    # uses the *default* (TP-synced) stream — every rank must apply the same
+    # mask or the residual stream desynchronizes. Under SP the activations
+    # are seq-sharded (each rank holds different tokens), so the
+    # rank-varying model-parallel stream is the right one.
+    keys = model_parallel_seed(seed, ax)
+    mp_key = keys.model_parallel if cfg.sequence_parallel else keys.default
 
     def block(x, lp, i):
         k1 = jax.random.fold_in(mp_key, 2 * i)
@@ -244,12 +250,45 @@ def gpt_loss(params, tokens, cfg: TransformerConfig, *, seed: int = 1234):
 
 
 def bert_loss(params, tokens, labels, loss_mask, cfg: TransformerConfig, *,
-              seed: int = 1234):
+              seed: int = 1234, reduce_axes=()):
     """Masked-LM loss: CE at masked positions only (labels [b, s],
-    loss_mask [b, s] with 1 = predict here)."""
+    loss_mask [b, s] with 1 = predict here).
+
+    ``reduce_axes``: mesh axes holding batch shards (e.g. ``("data",)``).
+    The masked-token count varies per shard, so the sum and count are
+    psum'd over those axes BEFORE dividing — a naive pmean of per-shard
+    means would weight shards with few masked tokens too heavily.
+    """
     logits = transformer_forward(params, tokens, cfg, seed=seed)
     losses = vocab_parallel_cross_entropy(
         logits, labels.transpose(1, 0), axis=cfg.model_axis
     )
     mask = loss_mask.transpose(1, 0).astype(jnp.float32)
-    return (losses * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    total = (losses * mask).sum()
+    count = mask.sum()
+    for axis in reduce_axes:
+        total = jax.lax.psum(total, axis)
+        count = jax.lax.psum(count, axis)
+    return total / jnp.maximum(count, 1.0)
+
+
+def sp_grad_sync(grads, cfg: TransformerConfig):
+    """All-reduce over the model axis the gradients of TP-REPLICATED params
+    computed in the sequence-sharded region (LN gammas/betas, row-parallel
+    biases). Megatron does exactly this extra reduction when
+    sequence_parallel is on (each TP rank only saw s/tp tokens); without SP
+    those grads are already identical across ranks. No-op when SP is off.
+    """
+    if not cfg.sequence_parallel:
+        return grads
+    specs = param_specs(cfg)
+
+    def sync(g, spec):
+        if cfg.model_axis in jax.tree.leaves(tuple(spec)):
+            return g  # TP-sharded leaf: grad is rank-local by design
+        return jax.lax.psum(g, cfg.model_axis)
+
+    return jax.tree.map(
+        sync, grads, specs,
+        is_leaf=lambda x: isinstance(x, P) or not isinstance(x, (dict, list)),
+    )

@@ -1,0 +1,28 @@
+"""GlobalMemoryBuffer (ref: apex/transformer/tensor_parallel/memory.py).
+
+The reference hand-recycles large activation buffers to dodge the CUDA
+caching allocator. Under XLA, buffer reuse is the compiler's job (donation +
+liveness analysis), so the TPU-correct implementation is an API shim that
+returns freshly-traced zeros — inside jit these become XLA temporaries that
+the compiler already aliases and reuses. Kept so Megatron-style ports run
+unchanged.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class GlobalMemoryBuffer:
+    """Ref: memory.py::GlobalMemoryBuffer.get_tensor(shape, dtype, name)."""
+
+    def get_tensor(self, tensor_shape, dtype, name):
+        del name  # XLA names/aliases buffers itself
+        return jnp.zeros(tensor_shape, dtype)
+
+
+_GLOBAL_MEMORY_BUFFER = GlobalMemoryBuffer()
+
+
+def get_global_memory_buffer() -> GlobalMemoryBuffer:
+    return _GLOBAL_MEMORY_BUFFER

@@ -1,0 +1,56 @@
+"""Checkpoint/resume helpers (ref: SURVEY.md §6 — amp.state_dict scaler
+checkpointing + examples/imagenet save_checkpoint; TPU idiom: the whole
+train state is one pytree, saved async via orbax when available).
+
+The amp/optimizer states in this library are already pytrees (scaler scale,
+growth counters, master weights, moments), so "checkpointable" is the
+default; these helpers add the IO.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+try:
+    import orbax.checkpoint as ocp
+
+    _HAVE_ORBAX = True
+except ImportError:  # pragma: no cover
+    _HAVE_ORBAX = False
+
+
+def save_checkpoint(path: str, state: Any, *, async_save: bool = False):
+    """Save a train-state pytree. Uses orbax (async-capable, TPU-friendly
+    sharded IO) when importable, else a host-side pickle of numpy leaves.
+
+    Returns the async save handle (orbax) or None.
+    """
+    if _HAVE_ORBAX:
+        ckptr = (ocp.AsyncCheckpointer if async_save else ocp.Checkpointer)(
+            ocp.PyTreeCheckpointHandler()
+        )
+        ckptr.save(os.path.abspath(path), state, force=True)
+        return ckptr if async_save else None
+    host_state = jax.tree.map(np.asarray, jax.device_get(state))
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    with open(tmp, "wb") as f:
+        pickle.dump(host_state, f)
+    os.replace(tmp, path)
+    return None
+
+
+def load_checkpoint(path: str, target: Optional[Any] = None):
+    """Restore a pytree saved by :func:`save_checkpoint`. ``target`` (an
+    abstract/like-typed pytree) restores dtypes/shardings under orbax."""
+    if _HAVE_ORBAX:
+        ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+        restored = ckptr.restore(os.path.abspath(path), item=target)
+        return restored
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,66 @@
+"""Tracing / profiling seams (ref: SURVEY.md §6 — the reference's nvtx
+range_push/pop calls in DDP bucket ops and distributed_fused_adam, plus the
+``prof`` ctor flag).
+
+TPU equivalents: ``jax.profiler.TraceAnnotation`` ranges (visible in
+TensorBoard/Perfetto traces) at the same seams — bucket flush, scaler
+update, pipeline schedule phases — plus a capture helper. Annotation is
+zero-cost when no trace is being captured.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+import jax
+
+# master switch mirroring the reference's DistributedDataParallel(prof=...)
+_PROF_ENABLED = os.environ.get("APEX_TPU_PROF", "1") == "1"
+
+
+def set_profiling_enabled(enabled: bool) -> None:
+    global _PROF_ENABLED
+    _PROF_ENABLED = enabled
+
+
+@contextlib.contextmanager
+def trace_range(name: str) -> Iterator[None]:
+    """nvtx.range_push/pop analog. Two mechanisms, because jit splits the
+    timeline: ``jax.named_scope`` names the *ops emitted during tracing* so
+    the range survives into compiled device traces (the nvtx-in-kernel
+    analog), and ``TraceAnnotation`` marks host-side eager execution."""
+    if _PROF_ENABLED:
+        with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+            yield
+    else:
+        yield
+
+
+def annotate(name: str):
+    """Decorator form of :func:`trace_range`."""
+    def deco(fn):
+        def wrapped(*a, **k):
+            with trace_range(name):
+                return fn(*a, **k)
+        wrapped.__name__ = getattr(fn, "__name__", name)
+        return wrapped
+    return deco
+
+
+@contextlib.contextmanager
+def capture(logdir: str = "/tmp/apex_tpu_trace",
+            host_tracer_level: Optional[int] = None) -> Iterator[str]:
+    """Capture a device+host trace around a block; view in TensorBoard
+    (`tensorboard --logdir ...`) or Perfetto. Returns the logdir."""
+    if host_tracer_level is not None:
+        opts = jax.profiler.ProfileOptions()
+        opts.host_tracer_level = host_tracer_level
+        jax.profiler.start_trace(logdir, profiler_options=opts)
+    else:
+        jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,119 @@
+"""AMP training CLI (ref: examples/imagenet/main_amp.py — the reference's
+ResNet AMP+DDP script with --opt-level / --loss-scale flags).
+
+Synthetic-data convnet so it runs hermetically; the flags and the training
+loop structure mirror the reference CLI.
+
+    python examples/main_amp.py --opt-level O2 --epochs 2 --ddp
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import amp
+from apex_tpu.contrib.groupbn import batch_norm_nhwc
+from apex_tpu.optimizers import fused_sgd
+from apex_tpu.parallel import DistributedDataParallel
+
+
+def conv_net_init(key, num_classes=10):
+    k = jax.random.split(key, 3)
+    return {
+        "conv1": jax.random.normal(k[0], (3, 3, 3, 32)) * 0.1,
+        "conv2": jax.random.normal(k[1], (3, 3, 32, 64)) * 0.05,
+        "head": jax.random.normal(k[2], (64, num_classes)) * 0.05,
+        "bn": {"gamma": jnp.ones((32,)), "beta": jnp.zeros((32,))},
+    }
+
+
+def conv_net_apply(params, x, bn_state, *, axis_name=None):
+    dn = ("NHWC", "HWIO", "NHWC")
+    y = jax.lax.conv_general_dilated(x, params["conv1"], (1, 1), "SAME",
+                                     dimension_numbers=dn)
+    y, bn_state = batch_norm_nhwc(y, params["bn"], bn_state, training=True,
+                                  axis_name=axis_name, fuse_relu=True)
+    y = jax.lax.conv_general_dilated(y, params["conv2"], (2, 2), "SAME",
+                                     dimension_numbers=dn)
+    y = jax.nn.relu(y).mean(axis=(1, 2))
+    return y @ params["head"], bn_state
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--opt-level", default="O1",
+                    choices=["O0", "O1", "O2", "O3"])
+    ap.add_argument("--loss-scale", default=None, type=float)
+    ap.add_argument("--epochs", default=1, type=int)
+    ap.add_argument("--batch", default=64, type=int)
+    ap.add_argument("--lr", default=0.05, type=float)
+    ap.add_argument("--ddp", action="store_true",
+                    help="data-parallel over all visible devices (SyncBN)")
+    args = ap.parse_args()
+
+    n = len(jax.devices()) if args.ddp else 1
+    mesh = Mesh(jax.devices()[:n], ("data",))
+    print(f"opt_level={args.opt_level} ddp={args.ddp} devices={n}")
+
+    params = conv_net_init(jax.random.PRNGKey(0))
+    bn_state = {"mean": jnp.zeros((32,), jnp.float32),
+                "var": jnp.ones((32,), jnp.float32)}
+
+    def model_fn(p, x, bn_state):
+        return conv_net_apply(p, x, bn_state,
+                              axis_name="data" if args.ddp else None)
+
+    model_fn, params, opt = amp.initialize(
+        model_fn, params, fused_sgd(args.lr, momentum=0.9),
+        opt_level=args.opt_level, loss_scale=args.loss_scale, verbosity=1,
+    )
+    ddp = DistributedDataParallel() if args.ddp else None
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (args.batch * n, 32, 32, 3))
+    labels = jax.random.randint(jax.random.PRNGKey(2), (args.batch * n,), 0, 10)
+
+    def step_body(params, state, bn_state, x, labels):
+        def loss_fn(p):
+            logits, new_bn = model_fn(p, x, bn_state)
+            loss = -jnp.mean(
+                jax.nn.log_softmax(logits.astype(jnp.float32))[
+                    jnp.arange(labels.shape[0]), labels
+                ]
+            )
+            return amp.scale_loss(loss, state), (loss, new_bn)
+
+        grads, (loss, new_bn) = jax.grad(loss_fn, has_aux=True)(params)
+        if ddp is not None:
+            grads = ddp.allreduce_gradients(grads)
+            loss = jax.lax.pmean(loss, "data")
+        params, state = opt.apply_gradients(grads, state, params)
+        return params, state, new_bn, loss
+
+    state = opt.init(params)
+    step = jax.jit(jax.shard_map(
+        step_body, mesh=mesh,
+        in_specs=(P(), P(), P(), P("data"), P("data")),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    ))
+
+    steps_per_epoch = 20
+    for epoch in range(args.epochs):
+        t0 = time.time()
+        for _ in range(steps_per_epoch):
+            params, state, bn_state, loss = step(params, state, bn_state, x,
+                                                 labels)
+        jax.block_until_ready(loss)
+        print(f"epoch {epoch}: loss={float(loss):.4f} "
+              f"scale={float(state.scaler.scale):.0f} "
+              f"({time.time() - t0:.1f}s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Native apex_C-parity helpers (ref: csrc/flatten_unflatten.cpp tests)."""
+
+import numpy as np
+
+from apex_tpu import _native
+
+
+def test_native_extension_built():
+    # the image ships a C toolchain; the extension must actually build
+    assert _native.HAVE_NATIVE
+
+
+def test_flatten_unflatten_roundtrip():
+    arrays = [np.random.randn(3, 4).astype(np.float32),
+              np.random.randn(7).astype(np.float32),
+              np.random.randn(2, 2, 2).astype(np.float32)]
+    flat = _native.flatten(arrays)
+    assert flat.shape == (3 * 4 + 7 + 8,)
+    outs = _native.unflatten(flat, arrays)
+    for a, b in zip(arrays, outs):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_flatten_dtype_mismatch_raises():
+    import pytest
+    with pytest.raises(ValueError):
+        _native.flatten([np.zeros(2, np.float32), np.zeros(2, np.float16)])
+
+
+def test_has_inf_or_nan():
+    a = np.random.randn(1000).astype(np.float32)
+    assert not _native.has_inf_or_nan(a)
+    a[777] = np.inf
+    assert _native.has_inf_or_nan(a)
+    a[777] = np.nan
+    assert _native.has_inf_or_nan(a)
+    # non-f32 path falls back to numpy
+    assert not _native.has_inf_or_nan(np.zeros(4, np.float16))
