@@ -2,29 +2,56 @@
 
 ``flatten``/``unflatten`` mirror apex_C.flatten/unflatten for host arrays
 (checkpoint staging, data paths); ``has_inf_or_nan`` is the loss-scaler
-host scan. The C extension is built on first import (cc -O3, ~1s) and the
-pure-numpy fallback keeps everything working where no compiler exists.
+host scan. The C extension is built lazily on FIRST USE (cc -O3, ~1s), not
+at import time, so importing apex_tpu stays side-effect-free in sandboxed /
+no-toolchain environments; when the build fails, a one-line warning makes
+the numpy-fallback activation observable (round-1 advisor finding).
 """
 
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 
-from apex_tpu._native.build import build as _build
+_logger = logging.getLogger(__name__)
 
 _C = None
-_so = _build()
-if _so is not None:
+_tried = False
+
+
+def _native():
+    """Build+load the C extension on first call; None => numpy fallback."""
+    global _C, _tried
+    if _tried:
+        return _C
+    _tried = True
+    from apex_tpu._native.build import build as _build
+
+    so = _build()
+    if so is None:
+        _logger.warning(
+            "apex_tpu._native: C extension build failed; using numpy fallback"
+        )
+        return None
     try:
         import importlib.util
 
-        _spec = importlib.util.spec_from_file_location("_apex_tpu_C", _so)
-        _C = importlib.util.module_from_spec(_spec)
-        _spec.loader.exec_module(_C)
-    except Exception:  # pragma: no cover
+        spec = importlib.util.spec_from_file_location("_apex_tpu_C", so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _C = mod
+    except Exception as e:  # pragma: no cover
+        _logger.warning(
+            "apex_tpu._native: C extension load failed (%s); numpy fallback", e
+        )
         _C = None
+    return _C
 
-HAVE_NATIVE = _C is not None
+
+def have_native() -> bool:
+    """True when the C extension is (buildable and) loaded."""
+    return _native() is not None
 
 
 def flatten(arrays):
@@ -38,8 +65,9 @@ def flatten(arrays):
         raise ValueError("flatten: arrays must share a dtype (ref asserts)")
     total = sum(a.size for a in arrays)
     out = np.empty((total,), dtype)
-    if HAVE_NATIVE:
-        _C.flatten_into(out, list(arrays))
+    C = _native()
+    if C is not None:
+        C.flatten_into(out, list(arrays))
     else:
         off = 0
         for a in arrays:
@@ -53,8 +81,9 @@ def unflatten(flat, like):
     (ref: apex_C.unflatten)."""
     flat = np.ascontiguousarray(flat)
     outs = [np.empty(np.shape(a), flat.dtype) for a in like]
-    if HAVE_NATIVE:
-        _C.unflatten_from(flat, outs)
+    C = _native()
+    if C is not None:
+        C.unflatten_from(flat, outs)
     else:
         off = 0
         for o in outs:
@@ -67,6 +96,15 @@ def has_inf_or_nan(array) -> bool:
     """Host-side overflow check (ref: fp16_utils
     DynamicLossScaler.has_inf_or_nan)."""
     a = np.ascontiguousarray(array)
-    if HAVE_NATIVE and a.dtype == np.float32:
-        return bool(_C.has_inf_or_nan_f32(a))
+    C = _native()
+    if C is not None and a.dtype == np.float32:
+        return bool(C.has_inf_or_nan_f32(a))
     return not bool(np.isfinite(a).all())
+
+
+def __getattr__(name):
+    # HAVE_NATIVE was an eager module constant pre-round-2; keep it working
+    # for callers/tests without forcing a build at import time.
+    if name == "HAVE_NATIVE":
+        return have_native()
+    raise AttributeError(name)

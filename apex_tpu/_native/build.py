@@ -1,8 +1,16 @@
-"""One-shot in-place build of the _apex_tpu_C extension via setuptools
-(no pybind11 in the image — plain CPython C API; see csrc/apex_tpu_C.c)."""
+"""One-shot in-place build of the _apex_tpu_C extension via the system C
+compiler (no pybind11 in the image — plain CPython C API; see
+csrc/apex_tpu_C.c).
+
+The built .so is a local cache, never committed: it is validated against a
+content hash of the C source (sidecar ``.build_hash``), so a stale or
+foreign binary is never loaded (round-1 advisor finding: mtime-based reuse
+would execute an unauditable committed artifact on fresh checkouts).
+"""
 
 from __future__ import annotations
 
+import hashlib
 import os
 import subprocess
 import sys
@@ -11,14 +19,28 @@ import sysconfig
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
 
 
+def _source_hash(src: str) -> str:
+    with open(src, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
 def build(verbose: bool = False) -> str | None:
     """Compile csrc/apex_tpu_C.c into this package directory. Returns the
     built path or None on failure (callers fall back to numpy)."""
     src = os.path.join(_PKG_DIR, "csrc", "apex_tpu_C.c")
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
     out = os.path.join(_PKG_DIR, "_apex_tpu_C" + suffix)
-    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
-        return out
+    stamp = os.path.join(_PKG_DIR, ".build_hash")
+    try:
+        want = _source_hash(src)
+    except OSError as e:  # stripped checkout without csrc — numpy fallback
+        if verbose:
+            print(f"_apex_tpu_C source unavailable: {e}", file=sys.stderr)
+        return None
+    if os.path.exists(out) and os.path.exists(stamp):
+        with open(stamp) as f:
+            if f.read().strip() == want:
+                return out
     cc = sysconfig.get_config_var("CC") or "cc"
     include = sysconfig.get_paths()["include"]
     cmd = cc.split() + [
@@ -29,6 +51,8 @@ def build(verbose: bool = False) -> str | None:
             cmd, check=True,
             capture_output=not verbose,
         )
+        with open(stamp, "w") as f:
+            f.write(want)
         return out
     except (subprocess.CalledProcessError, OSError) as e:  # pragma: no cover
         if verbose:

@@ -17,7 +17,6 @@ import jax
 import jax.numpy as jnp
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def focal_loss(
     cls_output,
     cls_targets,
@@ -33,9 +32,28 @@ def focal_loss(
     cls_targets: [...] int class ids; -1 = negative anchor (all-zero
     one-hot, like the reference), -2 = ignored anchor (zero loss).
     num_positives_sum: scalar normalizer (the reference divides the loss
-    and gradient by it).
+    and gradient by it); an integer count (the natural caller type, and
+    what the reference kernel takes) is cast to float HERE so the
+    custom_vjp's zero cotangent matches the primal dtype under grad.
     num_real_classes: ignore padded logit columns beyond this count.
     """
+    nps = jnp.asarray(num_positives_sum)
+    if not jnp.issubdtype(nps.dtype, jnp.floating):
+        nps = nps.astype(jnp.float32)
+    return _focal_loss(cls_output, cls_targets, nps,
+                       num_real_classes, alpha, gamma, label_smoothing)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _focal_loss(
+    cls_output,
+    cls_targets,
+    num_positives_sum,
+    num_real_classes: int,
+    alpha: float = 0.25,
+    gamma: float = 2.0,
+    label_smoothing: float = 0.0,
+):
     return _focal_fwd(cls_output, cls_targets, num_positives_sum,
                       num_real_classes, alpha, gamma, label_smoothing)[0]
 
@@ -91,7 +109,7 @@ def _focal_bwd(num_real_classes, alpha, gamma, label_smoothing, res, g):
     return dx, None, jnp.zeros_like(nps)
 
 
-focal_loss.defvjp(_focal_fwd, _focal_bwd)
+_focal_loss.defvjp(_focal_fwd, _focal_bwd)
 
 
 class FocalLoss:

@@ -54,7 +54,8 @@ class DistributedFusedAdam:
                  weight_decay: float = 0.0, adam_w_mode: bool = True,
                  bias_correction: bool = True,
                  max_grad_norm: Optional[float] = None,
-                 grad_averaging: bool = True, axis_name: str = "data"):
+                 grad_averaging: bool = True, axis_name: str = "data",
+                 use_pallas: Optional[bool] = None):
         self.lr = learning_rate
         self.b1, self.b2, self.eps = b1, b2, eps
         self.weight_decay = weight_decay
@@ -63,6 +64,11 @@ class DistributedFusedAdam:
         self.max_grad_norm = max_grad_norm
         self.grad_averaging = grad_averaging
         self.axis_name = axis_name
+        # Pallas flat-shard update kernel (ops/pallas_optim.py, the analog
+        # of csrc/multi_tensor_adam.cu over the reference's flat bucket
+        # shards); None = platform default (TPU on, CPU oracle path off —
+        # decided by benchmarks/bench_optim_kernels.py, see BASELINE.md).
+        self.use_pallas = use_pallas
         self._meta: Optional[FlatMeta] = None
 
     # -- metadata ----------------------------------------------------------
@@ -109,8 +115,29 @@ class DistributedFusedAdam:
 
         finite = jnp.isfinite(lax.psum(jnp.sum(gshard), ax))
 
+        use_pallas = self.use_pallas
+        if use_pallas is None:
+            from apex_tpu.ops._utils import default_use_pallas
+
+            use_pallas = default_use_pallas()
+
         def do_update(_):
             t = state.step + 1
+            if use_pallas:
+                from apex_tpu.ops import pallas_optim as PK
+
+                master, m, v = PK.adam_flat(
+                    gshard, state.master, state.m, state.v,
+                    lr=self.lr, beta1=self.b1, beta2=self.b2, eps=self.eps,
+                    step=t,
+                    mode=(PK.ADAM_MODE_ADAMW if self.adam_w_mode
+                          else PK.ADAM_MODE_ADAM),
+                    bias_correction=self.bias_correction,
+                    # ADAM (L2) mode decay was already folded into gshard
+                    weight_decay=(self.weight_decay if self.adam_w_mode
+                                  else 0.0),
+                )
+                return DistAdamState(t, master, m, v)
             m = self.b1 * state.m + (1 - self.b1) * gshard
             v = self.b2 * state.v + (1 - self.b2) * jnp.square(gshard)
             if self.bias_correction:

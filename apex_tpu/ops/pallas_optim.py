@@ -1,0 +1,248 @@
+"""Pallas optimizer-update + fused L2-norm kernels over FLAT fp32 buffers.
+
+Ref: csrc/multi_tensor_adam.cu, csrc/multi_tensor_lamb.cu,
+csrc/multi_tensor_l2norm_kernel.cu — the reference's chunked CUDA kernels
+that apply one optimizer step across hundreds of tensors in a single
+launch, and the single-pass L2 norm feeding LAMB trust ratios / clipping.
+
+TPU design: the natural home for these kernels is the FLAT layout the
+ZeRO-2 distributed optimizers already use (contrib/optimizers/_sharding.py
+flattens params into one fp32 buffer per rank — the analog of the
+reference's flat bucket shards). A flat [N] buffer is viewed as
+[N/128, 128] lanes and blocked over a 1-D grid; each step streams one
+(rows x 128) tile of every operand through VMEM, does the fp32 update, and
+writes the tile back with the inputs donated (``input_output_aliases``) so
+HBM traffic is the theoretical minimum. For tree-shaped (non-flat) params
+the fused-jit path in multi_tensor/functional.py remains the default —
+XLA already fuses that into the same loops, and concat/split round trips
+would only add traffic; the microbenchmark in
+benchmarks/bench_optim_kernels.py decides per hardware generation.
+
+All kernels run in interpret mode off-TPU so the CPU test suite pins
+numerics against the jnp oracles.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is TPU-only at import time in some versions; guard for CPU
+    from jax.experimental.pallas import tpu as pltpu
+
+    _SMEM = pltpu.SMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _SMEM = None
+
+from apex_tpu.ops._utils import pallas_interpret
+
+LANES = 128
+_BLOCK_ROWS = 2048  # 2048 x 128 fp32 = 1 MiB per operand tile in VMEM
+
+ADAM_MODE_ADAM = 0  # L2 regularization folded into the gradient
+ADAM_MODE_ADAMW = 1  # decoupled weight decay
+
+
+def _pad_rows(flat: jax.Array, block_rows: int):
+    """[N] f32 -> ([rows, 128], original N) with rows % block_rows == 0."""
+    n = flat.shape[0]
+    per_block = block_rows * LANES
+    padded = -(-n // per_block) * per_block
+    if padded != n:
+        flat = jnp.pad(flat, (0, padded - n))
+    return flat.reshape(-1, LANES), n
+
+
+def _unpad(tiled: jax.Array, n: int) -> jax.Array:
+    return tiled.reshape(-1)[:n]
+
+
+# ---------------------------------------------------------------------------
+# fused Adam / AdamW over a flat buffer
+# ---------------------------------------------------------------------------
+
+def _adam_kernel(s_ref, g_ref, p_ref, m_ref, v_ref,
+                 po_ref, mo_ref, vo_ref, *, mode):
+    lr = s_ref[0]
+    b1 = s_ref[1]
+    b2 = s_ref[2]
+    eps = s_ref[3]
+    bc1 = s_ref[4]
+    bc2 = s_ref[5]
+    wd = s_ref[6]
+    skip = s_ref[7] != 0.0
+
+    g = g_ref[:]
+    p = p_ref[:]
+    m = m_ref[:]
+    v = v_ref[:]
+    if mode == ADAM_MODE_ADAM:
+        g = g + wd * p
+    m_n = b1 * m + (1.0 - b1) * g
+    v_n = b2 * v + (1.0 - b2) * g * g
+    update = (m_n / bc1) / (jnp.sqrt(v_n / bc2) + eps)
+    if mode == ADAM_MODE_ADAMW:
+        update = update + wd * p
+    p_n = p - lr * update
+    po_ref[:] = jnp.where(skip, p, p_n)
+    mo_ref[:] = jnp.where(skip, m, m_n)
+    vo_ref[:] = jnp.where(skip, v, v_n)
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "bias_correction"))
+def adam_flat(grads, params, exp_avg, exp_avg_sq, *, lr, beta1, beta2, eps,
+              step, mode=ADAM_MODE_ADAMW, bias_correction=True,
+              weight_decay=0.0, noop_flag=False):
+    """One fused Adam/AdamW step on flat fp32 [N] buffers.
+
+    Semantics match multi_tensor/functional.py::multi_tensor_adam (ref:
+    csrc/multi_tensor_adam.cu): fp32 math, optional bias correction,
+    ``noop_flag`` suppresses the whole update (overflow skip). Returns
+    (new_params, new_m, new_v).
+    """
+    assert params.dtype == jnp.float32, "flat master buffers are fp32"
+    step = jnp.asarray(step, jnp.float32)
+    b1 = jnp.float32(beta1)
+    b2 = jnp.float32(beta2)
+    if bias_correction:
+        bc1 = 1.0 - b1 ** step
+        bc2 = 1.0 - b2 ** step
+    else:
+        bc1 = bc2 = jnp.float32(1.0)
+    scalars = jnp.stack([
+        jnp.asarray(lr, jnp.float32), b1, b2, jnp.float32(eps),
+        bc1, bc2, jnp.float32(weight_decay),
+        jnp.asarray(noop_flag).astype(jnp.float32),
+    ])
+
+    g2, n = _pad_rows(grads.astype(jnp.float32), _BLOCK_ROWS)
+    p2, _ = _pad_rows(params, _BLOCK_ROWS)
+    m2, _ = _pad_rows(exp_avg, _BLOCK_ROWS)
+    v2, _ = _pad_rows(exp_avg_sq, _BLOCK_ROWS)
+    rows = p2.shape[0]
+    grid = rows // _BLOCK_ROWS
+
+    blk = pl.BlockSpec((_BLOCK_ROWS, LANES), lambda i: (i, 0))
+    s_spec = (
+        pl.BlockSpec(memory_space=_SMEM)
+        if _SMEM is not None and not pallas_interpret()
+        else pl.BlockSpec((8,), lambda i: (0,))
+    )
+    out_shape = jax.ShapeDtypeStruct((rows, LANES), jnp.float32)
+    p_n, m_n, v_n = pl.pallas_call(
+        functools.partial(_adam_kernel, mode=mode),
+        grid=(grid,),
+        in_specs=[s_spec, blk, blk, blk, blk],
+        out_specs=(blk, blk, blk),
+        out_shape=(out_shape, out_shape, out_shape),
+        input_output_aliases={2: 0, 3: 1, 4: 2},
+        interpret=pallas_interpret(),
+    )(scalars, g2, p2, m2, v2)
+    return _unpad(p_n, n), _unpad(m_n, n), _unpad(v_n, n)
+
+
+# ---------------------------------------------------------------------------
+# single-pass fused L2 norm (global-norm clip, LAMB trust ratios)
+# ---------------------------------------------------------------------------
+
+def _l2norm_kernel(x_ref, out_ref):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[0, 0] = jnp.float32(0.0)
+
+    x = x_ref[:].astype(jnp.float32)
+    out_ref[0, 0] += jnp.sum(x * x)
+
+
+@jax.jit
+def l2norm_flat(flat) -> jax.Array:
+    """sqrt(sum(x^2)) of a flat buffer in ONE pass with fp32 accumulation
+    (ref: csrc/multi_tensor_l2norm_kernel.cu). Accepts any float dtype."""
+    x2, _ = _pad_rows(flat.astype(jnp.float32), _BLOCK_ROWS)
+    rows = x2.shape[0]
+    grid = rows // _BLOCK_ROWS
+    sq = pl.pallas_call(
+        _l2norm_kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((_BLOCK_ROWS, LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        interpret=pallas_interpret(),
+    )(x2)
+    return jnp.sqrt(sq[0, 0])
+
+
+# ---------------------------------------------------------------------------
+# fused LAMB phase 1 over a flat buffer (moments + raw update)
+# ---------------------------------------------------------------------------
+
+def _lamb_phase1_kernel(s_ref, g_ref, p_ref, m_ref, v_ref,
+                        u_ref, mo_ref, vo_ref):
+    b1 = s_ref[0]
+    b2 = s_ref[1]
+    eps = s_ref[2]
+    bc1 = s_ref[3]
+    bc2 = s_ref[4]
+    wd = s_ref[5]
+    grad_scale = s_ref[6]
+
+    g = g_ref[:] * grad_scale
+    p = p_ref[:]
+    m_n = b1 * m_ref[:] + (1.0 - b1) * g
+    v_n = b2 * v_ref[:] + (1.0 - b2) * g * g
+    u = (m_n / bc1) / (jnp.sqrt(v_n / bc2) + eps) + wd * p
+    u_ref[:] = u
+    mo_ref[:] = m_n
+    vo_ref[:] = v_n
+
+
+@functools.partial(jax.jit, static_argnames=("bias_correction",))
+def lamb_phase1_flat(grads, params, exp_avg, exp_avg_sq, *, beta1, beta2,
+                     eps, step, weight_decay=0.0, grad_scale=1.0,
+                     bias_correction=True):
+    """LAMB phase 1 (ref: csrc/multi_tensor_lamb.cu stage 1): moments + the
+    raw (pre-trust-ratio) update ``u``. Per-tensor trust ratios need
+    segment norms of ``u`` and the params, which the caller computes (jnp
+    segment-sum over the flat id map, or l2norm_flat for single tensors)
+    before the final ``p - lr * ratio * u`` axpy. Returns (u, new_m, new_v).
+    """
+    step = jnp.asarray(step, jnp.float32)
+    b1 = jnp.float32(beta1)
+    b2 = jnp.float32(beta2)
+    if bias_correction:
+        bc1 = 1.0 - b1 ** step
+        bc2 = 1.0 - b2 ** step
+    else:
+        bc1 = bc2 = jnp.float32(1.0)
+    scalars = jnp.stack([
+        b1, b2, jnp.float32(eps), bc1, bc2,
+        jnp.float32(weight_decay), jnp.asarray(grad_scale, jnp.float32),
+    ])
+    g2, n = _pad_rows(grads.astype(jnp.float32), _BLOCK_ROWS)
+    p2, _ = _pad_rows(params, _BLOCK_ROWS)
+    m2, _ = _pad_rows(exp_avg, _BLOCK_ROWS)
+    v2, _ = _pad_rows(exp_avg_sq, _BLOCK_ROWS)
+    rows = p2.shape[0]
+    grid = rows // _BLOCK_ROWS
+
+    blk = pl.BlockSpec((_BLOCK_ROWS, LANES), lambda i: (i, 0))
+    s_spec = (
+        pl.BlockSpec(memory_space=_SMEM)
+        if _SMEM is not None and not pallas_interpret()
+        else pl.BlockSpec((7,), lambda i: (0,))
+    )
+    out_shape = jax.ShapeDtypeStruct((rows, LANES), jnp.float32)
+    u, m_n, v_n = pl.pallas_call(
+        _lamb_phase1_kernel,
+        grid=(grid,),
+        in_specs=[s_spec, blk, blk, blk, blk],
+        out_specs=(blk, blk, blk),
+        out_shape=(out_shape, out_shape, out_shape),
+        input_output_aliases={3: 1, 4: 2},
+        interpret=pallas_interpret(),
+    )(scalars, g2, p2, m2, v2)
+    return _unpad(u, n), _unpad(m_n, n), _unpad(v_n, n)

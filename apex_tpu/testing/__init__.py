@@ -15,6 +15,7 @@ from apex_tpu.testing.standalone_transformer import (  # noqa: F401
     bert_loss,
     gpt_loss,
     param_specs,
+    sp_grad_sync,
     stack_layer_params,
     transformer_forward,
     transformer_init,

@@ -40,8 +40,9 @@ from apex_tpu.transformer.tensor_parallel.layers import (
     vocab_parallel_embedding,
 )
 from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
     gather_from_sequence_parallel_region,
-    scatter_to_sequence_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
 )
 from apex_tpu.transformer.tensor_parallel.random import model_parallel_seed
 
@@ -149,9 +150,14 @@ def _attention(lp, x, cfg: TransformerConfig, dropout_key):
     )                                     # [s, b, 3h/tp]
     s, b = qkv.shape[0], qkv.shape[1]
     n_local = qkv.shape[-1] // (3 * cfg.head_dim)
-    qkv = qkv.reshape(s, b, 3, n_local, cfg.head_dim)
-    # [s, b, 3, nh, d] -> 3 x [b, nh, s, d]
-    q, k, v = (qkv[:, :, i].transpose(1, 2, 0, 3) for i in range(3))
+    # Megatron layout: qkv columns are ordered [heads, (q|k|v), head_dim] so
+    # a contiguous column split hands each TP rank WHOLE heads — the same
+    # function at every tp (ref: attention.py reshapes local qkv to
+    # [s, b, nh_local, 3*hd] then split_tensor_along_last_dim). The
+    # round-1 [3, nh, hd] order silently changed the function with tp.
+    qkv = qkv.reshape(s, b, n_local, 3, cfg.head_dim)
+    # [s, b, nh, 3, d] -> 3 x [b, nh, s, d]
+    q, k, v = (qkv[:, :, :, i].transpose(1, 2, 0, 3) for i in range(3))
     o = flash_attention(q, k, v, causal=cfg.causal)
     o = o.transpose(2, 0, 1, 3).reshape(s, b, n_local * cfg.head_dim)
     o = row_parallel_linear(
@@ -189,13 +195,28 @@ def transformer_forward(params, tokens, cfg: TransformerConfig, *,
     """tokens: [b, s] int32 (shard_map-local batch shard). Returns
     vocab-parallel logits [s, b, v/tp]."""
     ax = cfg.model_axis
-    emb = vocab_parallel_embedding(tokens, params["embedding"], axis=ax)
-    x = (emb + params["pos_embedding"][None, : tokens.shape[1]]).astype(
-        cfg.dtype
-    )
-    x = x.transpose(1, 0, 2)              # [s, b, h] (Megatron layout)
     if cfg.sequence_parallel:
-        x = scatter_to_sequence_parallel_region(x, ax)
+        # Megatron SP entry: the vocab-parallel combine IS the seq scatter —
+        # reduce_scatter of the partial lookups (bwd all_gather keeps the
+        # vocab-shard grads complete) — and each rank adds only ITS slice
+        # of the position table, so pos grads are seq-local and belong to
+        # the sp_grad_sync psum class.
+        emb = vocab_parallel_embedding(
+            tokens, params["embedding"], axis=ax, reduce_output=False
+        )
+        x = emb.transpose(1, 0, 2)        # [s, b, h] partial sums
+        x = reduce_scatter_to_sequence_parallel_region(x, ax)
+        pos = jax.lax.dynamic_slice_in_dim(
+            params["pos_embedding"][: tokens.shape[1]],
+            jax.lax.axis_index(ax) * x.shape[0], x.shape[0], 0,
+        )
+        x = (x + pos[:, None, :]).astype(cfg.dtype)
+    else:
+        emb = vocab_parallel_embedding(tokens, params["embedding"], axis=ax)
+        x = (emb + params["pos_embedding"][None, : tokens.shape[1]]).astype(
+            cfg.dtype
+        )
+        x = x.transpose(1, 0, 2)          # [s, b, h] (Megatron layout)
     # Output dropout follows the reference's RNG discipline: the outputs of
     # row-parallel layers are TP-REPLICATED when SP is off, so their dropout
     # uses the *default* (TP-synced) stream — every rank must apply the same
@@ -226,10 +247,21 @@ def transformer_forward(params, tokens, cfg: TransformerConfig, *,
     else:
         for i, lp in enumerate(params["layers"]):
             x = block(x, lp, i)
+    # Final LN runs on the seq-sharded x under SP (Megatron keeps it inside
+    # the SP region), so its grads are seq-local and sp_grad_sync's psum is
+    # the correct completion.
+    x = layer_norm(x, params["final_ln"]["gamma"], params["final_ln"]["beta"])
+    # Parallel-lm-head entry for the tied-embedding vocab-parallel logits
+    # [s, b, h] @ [h, v/tp]: each rank's dx = dlogits_local @ emb_shard is a
+    # PARTIAL sum, so the entry's backward must reduce it — without that,
+    # every upstream grad is silently partial (round-1 bug caught by finite
+    # differences; the loss-only parity tests missed it). Under SP the
+    # gather's backward reduce_scatter does double duty (Megatron's
+    # sequence_parallel ColumnParallelLinear); otherwise copy_to's psum.
     if cfg.sequence_parallel:
         x = gather_from_sequence_parallel_region(x, ax, True)
-    x = layer_norm(x, params["final_ln"]["gamma"], params["final_ln"]["beta"])
-    # tied-embedding vocab-parallel logits: [s, b, h] @ [h, v/tp]
+    else:
+        x = copy_to_tensor_model_parallel_region(x, ax)
     logits = jnp.matmul(
         x.astype(jnp.float32),
         params["embedding"].astype(jnp.float32).T,

@@ -6,10 +6,11 @@ fwd_bwd_pipelining_without_interleaving.py / _with_interleaving.py.
 
 Reference mechanism: each pipeline rank runs a *different* program — warmup
 forwards, steady 1F1B send/recv pairs, cooldown backwards — with manual
-``torch.autograd.backward`` calls stitching grads across ranks.
+``torch.autograd.backward`` calls stitching grads across ranks. The whole
+point of the 1F1B order is to cap in-flight activations at ~P per stage.
 
 TPU mechanism (this module): one program on every stage. Time advances in
-pipeline clock ticks inside a ``lax.scan``; each tick every stage
+pipeline clock ticks; each tick every stage
 
   1. takes the activation arriving on the stage ring (or injects a fresh
      microbatch at stage 0),
@@ -17,15 +18,24 @@ pipeline clock ticks inside a ``lax.scan``; each tick every stage
      which makes the same loop serve the non-interleaved ``V=1`` and
      interleaved-virtual ``V>1`` schedules),
   3. computes the loss when a microbatch completes its final chunk on the
-     last stage (masked elsewhere),
+     last stage (masked elsewhere), accumulating it into an [M] bucket,
   4. rotates its output to the next stage with ``lax.ppermute``.
 
 The backward schedule is not hand-written at all: differentiating through
 the scan transposes every ``ppermute`` into the reverse rotation, so
 ``jax.value_and_grad`` materializes the cooldown/steady/warmup backward
-phases automatically, with activation rematerialization
-(``jax.checkpoint``) standing in for the reference's
-tensor_parallel/random.py::CheckpointFunction.
+phases automatically.
+
+**Memory contract (the analog of 1F1B's in-flight cap).** Ticks are grouped
+into waves of ``rp = P*V`` ticks and the wave body is ``jax.checkpoint``ed
+inside an outer ``lax.scan``: the forward saves only one ring-buffer
+activation per wave (plus the [M] scalar loss bucket), and the backward
+recomputes one wave at a time, holding at most ``rp`` tick activations
+live — O(P*V), independent of the microbatch count M. With
+``checkpoint_activations=True`` each tick is additionally remat'd (the
+reference's tensor_parallel/random.py::CheckpointFunction), shrinking the
+per-wave backward residency from rp x layer-internals to rp x one
+activation.
 
 Scheduling bookkeeping (derivation used throughout):
 
@@ -37,7 +47,7 @@ Scheduling bookkeeping (derivation used throughout):
   chunk ``k = h // P``, and microbatch ``m = ((t - r)//rp)*P + r``; it is
   live iff ``m < M``. A microbatch finishes (hop ``rp-1``, necessarily on
   stage P-1 with chunk V-1) at tick ``e(m) + rp - 1``; total ticks
-  ``T = ceil(M/P)*rp + P - 1``.
+  ``T = ceil(M/P)*rp + P - 1`` (padded up to a whole number of waves).
 """
 
 from __future__ import annotations
@@ -115,42 +125,80 @@ def run_pipeline(
     rp = P * V
     num_waves = -(-M // P)
     T = num_waves * rp + P - 1
+    num_outer = -(-T // rp)  # waves incl. the padded drain tail
 
-    f = jax.checkpoint(stage_fn) if checkpoint_activations else stage_fn
     s = lax.axis_index(axis)
-    on_last = lax.axis_index(axis) == P - 1
-    # Microbatch m finishes (last chunk, last stage) at tick e(m) + rp - 1.
-    finish = jnp.array(
-        [(m // P) * rp + m % P + rp - 1 for m in range(M)], jnp.int32
-    )
+    on_last = s == P - 1
 
     def run(params, lparams):
-        def tick(buf, t):
-            # Stage-0 injection: wave w, slot r_in within the ring period.
+        def tick(carry, t):
+            buf, losses_acc, finals = carry
+            # Stage-0 injection: wave w_in, slot r_in within the ring period.
             w_in = t // rp
             r_in = t % rp
             m_in = w_in * P + r_in
             inject = (s == 0) & (r_in < P) & (m_in < M)
             x = jnp.where(inject, xs[jnp.minimum(m_in, M - 1)], buf)
-            # Which chunk this stage applies this tick (see module docstring).
+            # Which chunk this stage applies this tick (module docstring).
             r = (t - s) % P
-            k = ((t - r) % rp) // P
-            y = f(_chunk(params, k), x)
+            h = (t - r) % rp
+            k = h // P
+            m = ((t - r) // rp) * P + r
+            y = stage_fn(_chunk(params, k), x)
+            # Loss at the tick where a microbatch completes its final chunk
+            # on the last stage. lax.cond (not a masked unconditional call)
+            # so the heavy vocab head runs ONLY on finishing ticks — in
+            # shard_map each device takes its own branch, and all tp peers
+            # of a stage share the predicate, so loss_fn's model-axis
+            # collectives stay collective-safe.
+            # m >= 0 guards the pre-fill ticks: before its first activation
+            # arrives, the last stage sees garbage slots with NEGATIVE m
+            # (t < s), which also sit at hop rp-1 — without the guard their
+            # losses wrap around (at[-3] => at[M-3]) into real microbatches.
+            is_final = on_last & (h == rp - 1) & (m >= 0) & (m < M)
+            m_idx = jnp.clip(m, 0, M - 1)
+            target = jax.tree.map(lambda a: a[m_idx], ys)
+            l = lax.cond(
+                is_final,
+                lambda y, t: loss_fn(lparams, y, t).astype(jnp.float32),
+                lambda y, t: jnp.float32(0.0),
+                y, target,
+            )
+            losses_acc = losses_acc.at[m_idx].add(l)
+            if finals is not None:
+                cur = lax.dynamic_index_in_dim(finals, m_idx, 0,
+                                               keepdims=False)
+                finals = lax.dynamic_update_index_in_dim(
+                    finals,
+                    jnp.where(is_final, lax.stop_gradient(y), cur),
+                    m_idx, 0,
+                )
             buf_next = send_forward_recv_forward(y, axis=axis, ring=True)
-            return buf_next, y
+            return (buf_next, losses_acc, finals), None
+
+        if checkpoint_activations:
+            # rp x one activation live during a wave's backward
+            tick_fn = jax.checkpoint(tick)
+        else:
+            # rp x layer-internals live — the reference's no-recompute 1F1B
+            tick_fn = tick
+
+        def wave(carry, t_row):
+            carry, _ = lax.scan(tick_fn, carry, t_row)
+            return carry, None
 
         buf0 = jnp.zeros_like(xs[0])
-        _, tick_y = lax.scan(tick, buf0, jnp.arange(T))
-        finals = tick_y[finish]  # [M, ...] valid on the last stage only
-        # Loss once per microbatch, not per tick (the vocab head is heavy).
-        # Double-where: dead stages evaluate loss_fn at a benign point so
-        # non-finite partials at garbage primals can't leak NaN into the
-        # zero-masked cotangents.
-        y_in = jnp.where(on_last, finals, jnp.ones_like(finals))
-        losses_m = jax.vmap(
-            lambda y, t: loss_fn(lparams, y, t).astype(jnp.float32)
-        )(y_in, ys)
-        losses_m = jnp.where(on_last, losses_m, 0.0)
+        losses0 = jnp.zeros((M,), jnp.float32)
+        finals0 = (
+            jnp.zeros((M,) + xs.shape[1:], xs.dtype)
+            if collect_outputs else None
+        )
+        ts = jnp.arange(num_outer * rp).reshape(num_outer, rp)
+        # checkpoint per wave: the fwd saves one ring carry per wave; the
+        # bwd recomputes wave-by-wave — O(P*V) live ticks, not O(T)
+        (buf, losses_m, finals), _ = lax.scan(
+            jax.checkpoint(wave), (buf0, losses0, finals0), ts
+        )
         return losses_m.sum(), (losses_m, finals)
 
     if forward_only:

@@ -125,10 +125,17 @@ def row_parallel_linear(
     return y
 
 
-def vocab_parallel_embedding(ids, table, *, axis: str = MODEL_AXIS):
+def vocab_parallel_embedding(ids, table, *, axis: str = MODEL_AXIS,
+                             reduce_output: bool = True):
     """Embedding lookup over a vocab-split table: local ``table`` is
     [vocab/tp, h]; out-of-range ids contribute zero and the partial
     embeddings are all-reduced.
+
+    ``reduce_output=False`` returns the per-rank PARTIAL embeddings so a
+    sequence-parallel caller can combine with a seq-dim reduce_scatter
+    instead (Megatron SP: the combine IS the scatter; its backward
+    all_gather hands every rank the full-sequence cotangent, keeping the
+    vocab-shard grads complete).
 
     Ref: layers.py::VocabParallelEmbedding.forward (mask input, zero masked
     rows, reduce_from_tensor_model_parallel_region).
@@ -140,6 +147,8 @@ def vocab_parallel_embedding(ids, table, *, axis: str = MODEL_AXIS):
     safe = jnp.clip(local, 0, n_local - 1)
     emb = jnp.take(table, safe, axis=0)
     emb = jnp.where(in_range[..., None], emb, 0)
+    if not reduce_output:
+        return emb
     return reduce_from_tensor_model_parallel_region(emb, axis)
 
 

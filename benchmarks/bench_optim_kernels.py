@@ -1,0 +1,85 @@
+"""Microbenchmark: Pallas flat optimizer/L2-norm kernels vs fused-jit.
+
+Decides VERDICT round-1 item 5 ("deliver the promised Pallas
+optimizer/L2-norm kernels — or measure them away"): runs both
+implementations at ZeRO-shard sizes (BERT-large ~340M params / 8 ranks on
+down) and prints a table; the winner becomes the platform default
+(``DistributedFusedAdam(use_pallas=...)``, ops/_utils.default_use_pallas).
+Record results in BASELINE.md.
+
+Usage:  python benchmarks/bench_optim_kernels.py          (real device)
+        BENCH_CPU=1 python benchmarks/bench_optim_kernels.py   (debug)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+if os.environ.get("BENCH_CPU") == "1":
+    jax.config.update("jax_platforms", "cpu")
+
+
+def timeit(fn, *args, iters=20):
+    out = fn(*args)  # compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    from apex_tpu.multi_tensor import functional as F
+    from apex_tpu.ops import pallas_optim as PK
+
+    dev = jax.devices()[0]
+    print(f"device: {dev} ({dev.device_kind})", file=sys.stderr)
+    sizes = [2**20, 2**24, 42_553_344]  # 1M, 16M, BERT-large/8 fp32
+    if os.environ.get("BENCH_CPU") == "1":
+        sizes = [2**16, 2**18]
+
+    kw = dict(lr=1e-3, beta1=0.9, beta2=0.95, eps=1e-8, step=7,
+              bias_correction=True, weight_decay=0.01)
+
+    print(f"{'n':>12} {'adam jit ms':>12} {'adam pallas ms':>15} "
+          f"{'l2 jit ms':>10} {'l2 pallas ms':>13}")
+    for n in sizes:
+        ks = jax.random.split(jax.random.PRNGKey(0), 4)
+        g = jax.random.normal(ks[0], (n,), jnp.float32) * 0.01
+        p = jax.random.normal(ks[1], (n,), jnp.float32)
+        m = jnp.zeros((n,), jnp.float32)
+        v = jnp.zeros((n,), jnp.float32)
+
+        jit_adam = jax.jit(lambda g, p, m, v: F.multi_tensor_adam(
+            jnp.bool_(False), [[g], [p], [m], [v]],
+            kw["lr"], kw["beta1"], kw["beta2"], kw["eps"], kw["step"],
+            PK.ADAM_MODE_ADAMW, kw["bias_correction"], kw["weight_decay"],
+        )[0])
+        pallas_adam = jax.jit(lambda g, p, m, v: PK.adam_flat(
+            g, p, m, v, mode=PK.ADAM_MODE_ADAMW, **kw)[0])
+        jit_l2 = jax.jit(lambda x: jnp.sqrt(jnp.sum(
+            x.astype(jnp.float32) ** 2)))
+
+        t_aj = timeit(jit_adam, g, p, m, v)
+        t_ap = timeit(pallas_adam, g, p, m, v)
+        t_lj = timeit(jit_l2, g)
+        t_lp = timeit(PK.l2norm_flat, g)
+        print(f"{n:>12} {t_aj*1e3:>12.3f} {t_ap*1e3:>15.3f} "
+              f"{t_lj*1e3:>10.3f} {t_lp*1e3:>13.3f}")
+
+    # HBM roofline context: adam touches 4 reads + 3 writes of n fp32
+    bw = 7 * sizes[-1] * 4
+    print(f"# adam @ n={sizes[-1]}: {bw/1e9:.2f} GB HBM traffic/step",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

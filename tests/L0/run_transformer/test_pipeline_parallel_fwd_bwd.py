@@ -92,7 +92,9 @@ def run_pipelined(schedule, all_chunks, lp, xs, ys, pp, vp, **kw):
     staged4 = jax.tree.map(
         lambda a: a.reshape((pp, vp) + a.shape[1:]), staged
     )
-    losses, grads, lgrads, outs = shard(staged4, lp, xs, ys)
+    # jit is required: the engine's per-wave jax.checkpoint (the O(P*V)
+    # memory contract) can't be evaluated eagerly inside shard_map
+    losses, grads, lgrads, outs = jax.jit(shard)(staged4, lp, xs, ys)
     if grads is not None:
         # [s, V, ...] -> global chunk order [g]
         inv = np.argsort(perm)
@@ -225,3 +227,48 @@ def test_tensor_shapes():
         sequence_parallel_enabled=True,
     ) == (32, 4, 64)
     assert pp_utils.listify_model("m") == ["m"]
+
+
+def test_1f1b_memory_flat_in_microbatches():
+    """The engine's memory contract (ref: the whole point of 1F1B's
+    in-flight cap): compiled temp memory must be ~flat in M, not O(M) — the
+    per-wave jax.checkpoint keeps at most P*V tick activations live during
+    the backward. Round 1 stacked all T tick outputs (O(M) activations)."""
+    pp, hid = 4, 64
+
+    def wide_stage(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"]) + x
+
+    def mse(lp, y, t):
+        return jnp.mean((y @ lp["head"] - t) ** 2)
+
+    def temp_bytes(m):
+        mesh = make_mesh({"stage": pp}, devices=jax.devices("cpu")[:pp])
+        chunks = {
+            "w": 0.3 * jax.random.normal(
+                jax.random.PRNGKey(0), (pp, hid, hid)),
+            "b": jnp.zeros((pp, hid)),
+        }
+        lp = {"head": 0.3 * jax.random.normal(jax.random.PRNGKey(1),
+                                              (hid, 8))}
+        xs = jax.random.normal(jax.random.PRNGKey(2), (m, MB, hid))
+        ys = jax.random.normal(jax.random.PRNGKey(3), (m, MB, 8))
+
+        def body(chunks, lp, xs, ys):
+            chunks = jax.tree.map(lambda a: a[0], chunks)
+            res = forward_backward_pipelining_without_interleaving(
+                wide_stage, mse, chunks, lp, xs, ys, axis="stage")
+            return res.losses.sum()
+
+        sh = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P("stage"), P(), P(), P()), out_specs=P(),
+            check_vma=False,
+        )
+        c = jax.jit(sh).lower(chunks, lp, xs, ys).compile()
+        return c.memory_analysis().temp_size_in_bytes
+
+    small, large = temp_bytes(8), temp_bytes(64)
+    # 8x the microbatches must NOT cost 8x the temp memory; allow 2x slack
+    # for the [M] loss bucket and scheduling bookkeeping
+    assert large < 2 * small + 65536, (small, large)

@@ -56,6 +56,25 @@ def test_focal_loss_value_and_grad(smoothing):
     assert np.all(np.asarray(g_fused)[:, 8:] == 0)
 
 
+def test_focal_loss_int_num_positives_grad():
+    """Differentiating with an INTEGER num_positives_sum (the natural
+    caller type; what the reference kernel takes) must work — round-1
+    advisor finding: the vjp's float32 zero cotangent mismatched an int
+    primal. focal_loss now casts the count to float at entry."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 10))
+    targets = jax.random.randint(jax.random.PRNGKey(1), (16,), -2, 8)
+    nps = jnp.int32(7)
+
+    g = jax.grad(
+        lambda x: focal_loss(x, targets, nps, 8, 0.25, 2.0, 0.0)
+    )(x)
+    g_ref = jax.grad(
+        lambda x: _focal_ref(x, targets, jnp.float32(7), 8, 0.25, 2.0, 0.0)
+    )(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               atol=1e-5, rtol=1e-4)
+
+
 def test_focal_loss_module():
     fl = FocalLoss(num_real_classes=5)
     x = jax.random.normal(jax.random.PRNGKey(2), (16, 8))

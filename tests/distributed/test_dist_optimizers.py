@@ -6,7 +6,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+import functools
+
+shard_map = functools.partial(jax.shard_map, check_vma=False)
 
 from apex_tpu.contrib.optimizers import (
     DistributedFusedAdam,
@@ -49,6 +51,8 @@ def _run_dist(opt_cls, steps=3, **kw):
             params, state = opt.step(params, grads, state)
         return params, state.master, state.step
 
+    # check_vma=False (in the partial above): pallas_call outputs don't
+    # carry vma annotations (same convention as testing.commons.smap)
     fn = shard_map(train, mesh=mesh, in_specs=P(),
                    out_specs=(P(), P("data"), P()))
     return jax.jit(fn)(params)
@@ -186,3 +190,14 @@ def test_dist_lamb_global_scale():
     a = jax.tree.leaves(run(g2, 64.0))[0]
     b = jax.tree.leaves(run(g, 1.0))[0]
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_dist_adam_pallas_kernel_matches_reference():
+    """use_pallas=True routes the shard update through
+    ops/pallas_optim.adam_flat (interpret mode on CPU) — must equal the
+    same fused-jit reference."""
+    out_params, _, _ = _run_dist(DistributedFusedAdam, steps=3,
+                                 grad_averaging=False, use_pallas=True)
+    ref = _adam_ref(_params(), steps=3)
+    for a, b in zip(jax.tree.leaves(out_params), jax.tree.leaves(ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
