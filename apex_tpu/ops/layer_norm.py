@@ -97,6 +97,21 @@ def _ln_fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
     rstd_ref[:] = rstd
 
 
+def _group_sum8(a):
+    """(block_rows, h) -> (8, h) partial: sum 8-row groups via static slices.
+
+    Mosaic requires output block shapes whose sublane dim is a multiple of 8,
+    so the per-block stage-1 partial is kept (8, h) rather than (1, h) (the
+    (1, h) spec failed TPU lowering — BENCH_r02). Static slices only: no
+    reshape across the sublane dim, which Mosaic may not support.
+    """
+    assert a.shape[0] % 8 == 0, a.shape  # trace-time: block rows must be 8-aligned
+    acc = a[0:8, :]
+    for k in range(1, a.shape[0] // 8):
+        acc = acc + a[8 * k:8 * (k + 1), :]
+    return acc
+
+
 def _ln_bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
                    dx_ref, dg_ref, db_ref):
     x = x_ref[:].astype(jnp.float32)
@@ -110,8 +125,8 @@ def _ln_bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
         dx_ref.dtype
     )
     # per-block partial reductions (stage 1 of the two-stage reduction)
-    dg_ref[:] = jnp.sum(dy * xhat, axis=0, keepdims=True)
-    db_ref[:] = jnp.sum(dy, axis=0, keepdims=True)
+    dg_ref[:] = _group_sum8(dy * xhat)
+    db_ref[:] = _group_sum8(dy)
 
 
 def _rms_fwd_kernel(x_ref, g_ref, y_ref, rstd_ref, *, eps):
@@ -130,7 +145,7 @@ def _rms_bwd_kernel(x_ref, g_ref, rstd_ref, dy_ref, dx_ref, dg_ref):
     dxhat = dy * g_ref[:].astype(jnp.float32)
     mean_dxhat_xhat = jnp.mean(dxhat * xhat, axis=1, keepdims=True)
     dx_ref[:] = (rstd * (dxhat - xhat * mean_dxhat_xhat)).astype(dx_ref.dtype)
-    dg_ref[:] = jnp.sum(dy * xhat, axis=0, keepdims=True)
+    dg_ref[:] = _group_sum8(dy * xhat)
 
 
 def _pad_rows(x2, block):
@@ -193,13 +208,13 @@ def _ln_bwd_pallas(x, gamma, mean, rstd, dy):
         ],
         out_specs=[
             pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
-            pl.BlockSpec((1, h), lambda i: (i, 0)),
-            pl.BlockSpec((1, h), lambda i: (i, 0)),
+            pl.BlockSpec((8, h), lambda i: (i, 0)),
+            pl.BlockSpec((8, h), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rp, h), x.dtype),
-            jax.ShapeDtypeStruct((grid, h), jnp.float32),
-            jax.ShapeDtypeStruct((grid, h), jnp.float32),
+            jax.ShapeDtypeStruct((grid * 8, h), jnp.float32),
+            jax.ShapeDtypeStruct((grid * 8, h), jnp.float32),
         ],
         interpret=pallas_interpret(),
     )(x2, g2, mean2, rstd2, dy2)
@@ -253,11 +268,11 @@ def _rms_bwd_pallas(x, gamma, rstd, dy):
         ],
         out_specs=[
             pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
-            pl.BlockSpec((1, h), lambda i: (i, 0)),
+            pl.BlockSpec((8, h), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rp, h), x.dtype),
-            jax.ShapeDtypeStruct((grid, h), jnp.float32),
+            jax.ShapeDtypeStruct((grid * 8, h), jnp.float32),
         ],
         interpret=pallas_interpret(),
     )(x2, gamma.reshape(1, h), rstd2, dy2)

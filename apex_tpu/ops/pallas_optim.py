@@ -150,12 +150,14 @@ def adam_flat(grads, params, exp_avg, exp_avg_sq, *, lr, beta1, beta2, eps,
 # ---------------------------------------------------------------------------
 
 def _l2norm_kernel(x_ref, out_ref):
+    # the (1, 1) accumulator lives in VMEM across the sequential grid; all
+    # stores are (1, 1)-array-shaped — Mosaic rejects *scalar* VMEM stores
     @pl.when(pl.program_id(0) == 0)
     def _init():
-        out_ref[0, 0] = jnp.float32(0.0)
+        out_ref[...] = jnp.zeros((1, 1), jnp.float32)
 
     x = x_ref[:].astype(jnp.float32)
-    out_ref[0, 0] += jnp.sum(x * x)
+    out_ref[...] += jnp.sum(x * x, axis=(0, 1), keepdims=True)
 
 
 @jax.jit
