@@ -46,6 +46,14 @@ _SUBMODULES = (
 )
 
 
+def preflight(kernels=None, verbose=True):
+    """Compile-probe every Pallas kernel family on the current device and
+    pin failures to their jnp fallbacks. See apex_tpu/_preflight.py."""
+    from apex_tpu._preflight import preflight as _preflight
+
+    return _preflight(kernels=kernels, verbose=verbose)
+
+
 def __getattr__(name):
     if name in _SUBMODULES:
         import importlib

@@ -23,6 +23,7 @@ _SUBMODULES = (
     "bottleneck",
     "peer_memory",
     "optimizers",
+    "openfold",
 )
 
 

@@ -1,0 +1,125 @@
+"""OpenFold kernel surface — the analog of apex/contrib/openfold_triton.
+
+Ref: apex/contrib/openfold_triton/* (SURVEY.md §3.10 row `openfold_triton`):
+the reference's one non-CUDA kernel family — Triton LayerNorm fwd/bwd, the
+fused evoformer MHA (additive pair bias + sigmoid gating), and the
+swish/transition epilogues used by OpenFold's Evoformer blocks.
+
+TPU mapping: every piece is backed by an existing apex_tpu kernel or an
+XLA-fused jnp expression —
+- LayerNorm       -> the Pallas LN family (ops/layer_norm.py)
+- fused MHA       -> the Pallas flash kernel (ops/attention.py) with the
+                     pair bias folded into its additive-bias input and the
+                     boolean mask folded to -30000 (finite for bf16, the
+                     reference's own mask fill convention)
+- swish / swiglu  -> jnp expressions XLA fuses into the surrounding matmuls
+- DAP             -> dynamic axial parallelism = shard the row/column axis
+                     of the pair representation over a mesh axis; the
+                     scatter/gather/transpose moves are custom-vjp
+                     collectives like transformer/tensor_parallel/mappings
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization.fused_layer_norm import (  # noqa: F401 — re-export
+    FusedLayerNorm as LayerNorm,
+    fused_layer_norm as layer_norm,
+)
+from apex_tpu.ops.attention import flash_attention
+
+_MASK_FILL = -30000.0  # finite in bf16/fp16; matches the reference softmax fill
+
+
+def swish(x):
+    """SiLU. XLA fuses this into the producing matmul's epilogue."""
+    return x * jax.nn.sigmoid(x)
+
+
+def swiglu_transition(x, w_gate, w_up, w_down):
+    """Gated transition block: (swish(x @ w_gate) * (x @ w_up)) @ w_down.
+    One fused fwd pass under jit; fp32 MXU accumulation."""
+    f32 = functools.partial(jnp.einsum, preferred_element_type=jnp.float32)
+    gate = swish(f32("...h,hf->...f", x, w_gate))
+    up = f32("...h,hf->...f", x, w_up)
+    return f32("...f,fh->...h", (gate * up).astype(x.dtype), w_down).astype(x.dtype)
+
+
+def mha(q, k, v, *, mask=None, bias=None, gate=None, use_pallas=None):
+    """Fused evoformer attention (ref: openfold_triton mha):
+
+        softmax(q·kᵀ/√d + bias + mask_bias) · v, optionally gated by
+        sigmoid(gate) elementwise.
+
+    Shapes: q/k/v ``(*batch, heads, seq, dim)`` (any number of leading batch
+    dims — OpenFold passes [B, N_res] or [B, N_seq] there). ``mask`` is
+    boolean ``(*batch, 1|heads, 1|seq_q, seq_k)`` (True = attend);
+    ``bias`` is the additive pair bias broadcastable to
+    ``(*batch, heads, seq_q, seq_k)``. ``gate`` matches q's shape.
+    """
+    *lead, h, s_q, d = q.shape
+    s_k = k.shape[-2]
+    b = 1
+    for n in lead:
+        b *= n
+
+    def flat(x):
+        return x.reshape((b,) + x.shape[len(lead):])
+
+    add_bias = None
+    if bias is not None:
+        add_bias = jnp.broadcast_to(
+            bias.astype(jnp.float32), tuple(lead) + (h, s_q, s_k)
+        ).reshape(b, h, s_q, s_k)
+    if mask is not None:
+        mask_bias = jnp.where(mask, 0.0, _MASK_FILL).astype(jnp.float32)
+        mask_bias = jnp.broadcast_to(
+            mask_bias, tuple(lead) + (mask.shape[-3], mask.shape[-2], s_k)
+        ).reshape(b, mask.shape[-3], mask.shape[-2], s_k)
+        add_bias = mask_bias if add_bias is None else add_bias + mask_bias
+
+    o = flash_attention(
+        flat(q), flat(k), flat(v), bias=add_bias, causal=False,
+        use_pallas=use_pallas,
+    )
+    o = o.reshape(q.shape)
+    if gate is not None:
+        o = (o.astype(jnp.float32) * jax.nn.sigmoid(gate.astype(jnp.float32))).astype(o.dtype)
+    return o
+
+
+# --------------------------------------------------------------------------
+# DAP — dynamic axial parallelism over a named mesh axis
+# --------------------------------------------------------------------------
+
+def dap_scatter(x, axis: str, dim: int):
+    """Split ``dim`` across the mesh axis (enter DAP). Inside shard_map."""
+    rank = jax.lax.axis_index(axis)
+    n = jax.lax.axis_size(axis)
+    assert x.shape[dim] % n == 0, (x.shape, dim, n)
+    return jax.lax.dynamic_slice_in_dim(
+        x, rank * (x.shape[dim] // n), x.shape[dim] // n, axis=dim
+    )
+
+
+def dap_gather(x, axis: str, dim: int):
+    """All-gather ``dim`` from the mesh axis (leave DAP)."""
+    return jax.lax.all_gather(x, axis, axis=dim, tiled=True)
+
+
+def dap_row_to_col(x, axis: str, row_dim: int, col_dim: int):
+    """Switch the sharded axis of the pair representation from rows to
+    columns (the evoformer's transpose communication): all-to-all over ICI."""
+    return jax.lax.all_to_all(
+        x, axis, split_axis=col_dim, concat_axis=row_dim, tiled=True
+    )
+
+
+def dap_col_to_row(x, axis: str, row_dim: int, col_dim: int):
+    return jax.lax.all_to_all(
+        x, axis, split_axis=row_dim, concat_axis=col_dim, tiled=True
+    )

@@ -119,7 +119,7 @@ class DistributedFusedAdam:
         if use_pallas is None:
             from apex_tpu.ops._utils import default_use_pallas
 
-            use_pallas = default_use_pallas()
+            use_pallas = default_use_pallas("optim_flat")
 
         def do_update(_):
             t = state.step + 1

@@ -1,0 +1,106 @@
+"""Channel-permutation search for 2:4 structured sparsity.
+
+Ref: apex/contrib/sparsity/permutation_lib.py (+ the permutation_search_cuda
+kernels): permuting a weight's INPUT channels before applying the m:n mask
+can keep substantially more magnitude, because the mask operates on fixed
+groups of ``m`` consecutive channels — the search moves "competing" large
+channels into different groups.
+
+TPU design: instead of the reference's CUDA exhaustive/bounded-regression
+search, the search is a jit-compiled stochastic greedy over GROUP PAIRS:
+each sweep randomly pairs the C/m channel groups, and every pair evaluates
+all m*m single-channel exchanges (plus identity) in parallel (vmap), taking
+the best. Each accepted exchange monotonically increases total retained
+magnitude, all shapes are static, and the whole search is one ``lax.scan``
+— no host round trips. This is the same hill-climbing move set as the
+reference's `Exhaustive_Search` channel swaps, vectorized per sweep.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _retained(cols_abs: jax.Array, n: int) -> jax.Array:
+    """cols_abs: (..., rows, m) -> retained magnitude (...,) keeping the
+    top-``n`` of each row's m entries (what an m:n mask preserves)."""
+    top = jnp.sort(cols_abs, axis=-1)[..., -n:]
+    return jnp.sum(top, axis=(-2, -1))
+
+
+def permutation_efficacy(weight: jax.Array, perm: jax.Array, m: int = 4,
+                         n: int = 2) -> jax.Array:
+    """Total |magnitude| an m:n mask keeps after permuting input channels."""
+    w = jnp.abs(weight.reshape(-1, weight.shape[-1]).astype(jnp.float32))
+    wp = w[:, perm]
+    r, c = wp.shape
+    groups = wp.reshape(r, c // m, m).transpose(1, 0, 2)  # (G, rows, m)
+    return jnp.sum(_retained(groups, n))
+
+
+@functools.partial(jax.jit, static_argnames=("m", "n", "sweeps"))
+def search_channel_permutation(weight: jax.Array, *, m: int = 4, n: int = 2,
+                               sweeps: int = 32,
+                               key: jax.Array | None = None) -> jax.Array:
+    """Find a permutation of the input channels (last axis) that increases
+    the magnitude an m:n mask retains. Returns ``perm`` (int32 [C]); apply
+    with ``weight[..., perm]`` (see apply_channel_permutation).
+    """
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    w = jnp.abs(weight.reshape(-1, weight.shape[-1]).astype(jnp.float32))
+    rows, c = w.shape
+    assert c % m == 0, f"channels {c} not a multiple of group size {m}"
+    g = c // m
+    npairs = g // 2
+
+    def sweep(perm, key):
+        # random disjoint group pairing for this sweep
+        order = jax.random.permutation(key, g)
+        pg = perm.reshape(g, m)[order]  # (G, m) channel ids, paired 2k/2k+1
+        a = pg[0::2][:npairs]  # (P, m)
+        b = pg[1::2][:npairs]
+
+        def best_exchange(a_ids, b_ids):
+            # candidates: identity + every single swap (i from a, j from b)
+            ii, jj = jnp.meshgrid(jnp.arange(m), jnp.arange(m), indexing="ij")
+            ii, jj = ii.reshape(-1), jj.reshape(-1)  # (m*m,)
+
+            def cand(i, j):
+                na = a_ids.at[i].set(b_ids[j])
+                nb = b_ids.at[j].set(a_ids[i])
+                return na, nb
+
+            cas, cbs = jax.vmap(cand)(ii, jj)           # (m*m, m)
+            cas = jnp.concatenate([a_ids[None], cas])    # (1+m*m, m)
+            cbs = jnp.concatenate([b_ids[None], cbs])
+            score = (_retained(jnp.abs(w[:, cas]).transpose(1, 0, 2), n)
+                     + _retained(jnp.abs(w[:, cbs]).transpose(1, 0, 2), n))
+            k = jnp.argmax(score)  # identity wins ties (index 0)
+            return cas[k], cbs[k]
+
+        na, nb = jax.vmap(best_exchange)(a, b)
+        pg = pg.at[0::2].set(jnp.concatenate([na, pg[0::2][npairs:]])
+                             if g % 2 else na)
+        pg = pg.at[1::2].set(nb)
+        # undo the pairing shuffle: scatter groups back to their slots
+        out = jnp.zeros_like(pg).at[order].set(pg)
+        return out.reshape(-1), None
+
+    keys = jax.random.split(key, sweeps)
+    perm, _ = jax.lax.scan(sweep, jnp.arange(c, dtype=jnp.int32), keys)
+    return perm
+
+
+def apply_channel_permutation(weight: jax.Array, perm: jax.Array) -> jax.Array:
+    """Permute input channels (last axis). The producing layer upstream must
+    permute its OUTPUT rows with the same perm to keep the network function
+    identical — see invert_permutation for consumers."""
+    return weight[..., perm]
+
+
+def invert_permutation(perm: jax.Array) -> jax.Array:
+    return jnp.zeros_like(perm).at[perm].set(jnp.arange(perm.shape[0], dtype=perm.dtype))

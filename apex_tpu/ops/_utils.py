@@ -23,9 +23,36 @@ def pallas_interpret() -> bool:
     return not on_tpu()
 
 
-def default_use_pallas() -> bool:
+# Per-kernel fallback registry. apex_tpu.preflight() compile-probes each
+# Pallas kernel family on the actual device and disables the ones that fail
+# to lower, so a single broken kernel degrades that one op to its (tested)
+# jnp path instead of killing every train step that transitively uses it
+# (round-2 lesson: one bad LayerNorm block spec zeroed the whole benchmark).
+_DISABLED_KERNELS: set[str] = set()
+
+
+def disable_kernel(name: str) -> None:
+    _DISABLED_KERNELS.add(name)
+
+
+def enable_kernel(name: str) -> None:
+    _DISABLED_KERNELS.discard(name)
+
+
+def kernel_disabled(name: str) -> bool:
+    return name in _DISABLED_KERNELS
+
+
+def disabled_kernels() -> frozenset:
+    return frozenset(_DISABLED_KERNELS)
+
+
+def default_use_pallas(kernel: str | None = None) -> bool:
     """Pallas kernels are the default on TPU; jnp reference elsewhere.
-    Override with APEX_TPU_USE_PALLAS=0/1."""
+    Override with APEX_TPU_USE_PALLAS=0/1. A kernel family that failed its
+    preflight compile-probe is pinned to the jnp path regardless."""
+    if kernel is not None and kernel in _DISABLED_KERNELS:
+        return False
     env = os.environ.get("APEX_TPU_USE_PALLAS")
     if env is not None:
         return env == "1"

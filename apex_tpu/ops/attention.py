@@ -465,7 +465,7 @@ def _flash_core(q, k, v, bias, causal, scale, use_pallas, need_dbias):
 
 
 def _flash_core_fwd(q, k, v, bias, causal, scale, use_pallas, need_dbias):
-    use = default_use_pallas() if use_pallas is None else use_pallas
+    use = default_use_pallas("flash_attention") if use_pallas is None else use_pallas
     if use:
         o, lse = _fwd_pallas(q, k, v, bias, causal, scale)
     else:
@@ -475,7 +475,7 @@ def _flash_core_fwd(q, k, v, bias, causal, scale, use_pallas, need_dbias):
 
 def _flash_core_bwd(causal, scale, use_pallas, need_dbias, res, do):
     q, k, v, bias, o, lse = res
-    use = default_use_pallas() if use_pallas is None else use_pallas
+    use = default_use_pallas("flash_attention") if use_pallas is None else use_pallas
     ds = None
     if use:
         dq, dk, dv = _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do)

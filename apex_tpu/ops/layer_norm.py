@@ -291,7 +291,7 @@ def layer_norm_affine(x, gamma, beta, eps=1e-5, use_pallas=None):
 
 
 def _ln_affine_fwd(x, gamma, beta, eps, use_pallas):
-    use = default_use_pallas() if use_pallas is None else use_pallas
+    use = default_use_pallas("layer_norm") if use_pallas is None else use_pallas
     if use:
         y, mean, rstd = _ln_fwd_pallas(x, gamma, beta, eps)
     else:
@@ -308,7 +308,7 @@ def _ln_affine_fwd_vjp(x, gamma, beta, eps, use_pallas):
 
 def _ln_affine_bwd_vjp(eps, use_pallas, res, dy):
     x, gamma, mean, rstd = res
-    use = default_use_pallas() if use_pallas is None else use_pallas
+    use = default_use_pallas("layer_norm") if use_pallas is None else use_pallas
     if use:
         dx, dgamma, dbeta = _ln_bwd_pallas(x, gamma, mean, rstd, dy)
     else:
@@ -328,7 +328,7 @@ def rms_norm_affine(x, gamma, eps=1e-5, use_pallas=None):
 
 
 def _rms_affine_fwd(x, gamma, eps, use_pallas):
-    use = default_use_pallas() if use_pallas is None else use_pallas
+    use = default_use_pallas("rms_norm") if use_pallas is None else use_pallas
     if use:
         y, rstd = _rms_fwd_pallas(x, gamma, eps)
     else:
@@ -339,7 +339,7 @@ def _rms_affine_fwd(x, gamma, eps, use_pallas):
 
 def _rms_affine_bwd(eps, use_pallas, res, dy):
     x, gamma, rstd = res
-    use = default_use_pallas() if use_pallas is None else use_pallas
+    use = default_use_pallas("rms_norm") if use_pallas is None else use_pallas
     if use:
         dx, dgamma = _rms_bwd_pallas(x, gamma, rstd, dy)
     else:

@@ -30,11 +30,14 @@ def emit(payload: dict) -> None:
 
 
 def _error_payload(msg: str) -> dict:
+    # ok:false + (see __main__) a nonzero exit: a zeroed metric must never
+    # look like a successful measurement to the driver (round-2 advisor item)
     return {
         "metric": _METRIC,
         "value": 0.0,
         "unit": "samples/sec/chip",
         "vs_baseline": 0.0,
+        "ok": False,
         "error": msg,
     }
 
@@ -46,7 +49,7 @@ def _watchdog(seconds: float):
 
     def fire():
         emit(_error_payload(f"watchdog: bench exceeded {seconds:.0f}s"))
-        os._exit(0)
+        os._exit(3)
 
     t = threading.Timer(seconds, fire)
     t.daemon = True
@@ -94,7 +97,7 @@ if __name__ == "__main__" and os.environ.get("BENCH_CPU") != "1":
     if not _probe_backend():
         emit(_error_payload("tpu backend unavailable (init hung or raised "
                             "after retries); no hardware number this run"))
-        sys.exit(0)
+        sys.exit(3)
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
@@ -154,15 +157,49 @@ def _acquire_device(retries: int = 3, backoff_s: float = 10.0):
     raise RuntimeError(f"no device after {retries} attempts: {last}")
 
 
+def _hand_flops(cfg, batch: int) -> float:
+    """fwd+bwd matmul FLOPs: 6 x MACs (fwd 2x, bwd 4x) per token.
+    Validated against compiled.cost_analysis() — see detail.xla_flops."""
+    h, L, s, v = cfg.hidden, cfg.layers, cfg.seq_len, cfg.vocab_size
+    macs_per_token = L * (12 * h * h + 2 * s * h) + h * v
+    return 6.0 * macs_per_token * batch * s
+
+
+def _measure(step, args, iters: int):
+    """(compile_s, sec/step, xla_flops|None). args are donated each call.
+
+    Compiles ONCE via the AOT path and reuses the executable — calling both
+    .lower().compile() and the jit dispatch path would compile twice."""
+    params, state, tokens, labels, loss_mask = args
+    t0 = time.perf_counter()
+    compiled = step.lower(*args).compile()
+    compile_s = time.perf_counter() - t0
+    xla_flops = None
+    try:
+        cost = compiled.cost_analysis()
+        if cost:
+            xla_flops = float(cost.get("flops", 0.0)) or None
+    except Exception as e:  # noqa: BLE001 — cost analysis is best-effort
+        print(f"bench: cost_analysis unavailable: {e}", file=sys.stderr)
+    # warmup (first call pays dispatch setup)
+    params, state = compiled(params, state, tokens, labels, loss_mask)
+    jax.block_until_ready(jax.tree.leaves(params)[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, state = compiled(params, state, tokens, labels, loss_mask)
+    jax.block_until_ready(jax.tree.leaves(params)[0])
+    return compile_s, (time.perf_counter() - t0) / iters, xla_flops
+
+
 def main():
     from jax.sharding import Mesh, PartitionSpec as P
 
+    import apex_tpu
     from apex_tpu import amp
     from apex_tpu.optimizers import fused_lamb
     from apex_tpu.testing import (
         TransformerConfig,
         bert_loss,
-        param_specs,
         stack_layer_params,
         transformer_init,
     )
@@ -171,94 +208,109 @@ def main():
     dev = _acquire_device()
     on_cpu = dev.platform == "cpu"
 
+    # per-kernel compile probe: a kernel family that fails Mosaic lowering is
+    # pinned to its jnp fallback HERE, so the measurement below always runs
+    # (round-2 lesson: one bad block spec must cost a log line, not the bench)
+    kernel_report = apex_tpu.preflight()
+
     if on_cpu:
         cfg = TransformerConfig(
             vocab_size=512, seq_len=128, hidden=128, layers=2, heads=4,
             causal=False, dtype=jnp.bfloat16, scan_layers=True, remat=True,
         )
-        batch = 4
+        batches = [4]
     else:
         # BERT-large: 24 x 1024 x 16 heads, seq 512, vocab 30528 (padded)
         cfg = TransformerConfig(
             vocab_size=30528, seq_len=512, hidden=1024, layers=24, heads=16,
             causal=False, dtype=jnp.bfloat16, scan_layers=True, remat=True,
         )
-        batch = 8
-
-    key = jax.random.PRNGKey(0)
-    params = stack_layer_params(transformer_init(key, cfg))
+        batches = [int(b) for b in os.environ.get(
+            "BENCH_BATCHES", "16,32,64").split(",")]
 
     def model_fn(p, tokens, labels, loss_mask):
         return bert_loss(p, tokens, labels, loss_mask, cfg)
 
-    model_fn, params, opt = amp.initialize(
-        model_fn, params, fused_lamb(1e-3), opt_level="O2", verbosity=0
-    )
-    state = opt.init(params)
-
-    tokens = jax.random.randint(
-        jax.random.PRNGKey(1), (batch, cfg.seq_len), 0, cfg.vocab_size
-    )
-    labels = jax.random.randint(
-        jax.random.PRNGKey(2), (batch, cfg.seq_len), 0, cfg.vocab_size
-    )
-    loss_mask = (
-        jax.random.uniform(jax.random.PRNGKey(3), (batch, cfg.seq_len)) < 0.15
-    )
-
     mesh = Mesh([dev], ("model",))
+    s = cfg.seq_len
+    sweep = []
+    best = None
+    for batch in batches:
+        params = stack_layer_params(transformer_init(jax.random.PRNGKey(0), cfg))
+        amp_fn, params, opt = amp.initialize(
+            model_fn, params, fused_lamb(1e-3), opt_level="O2", verbosity=0
+        )
+        state = opt.init(params)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, s), 0, cfg.vocab_size
+        )
+        labels = jax.random.randint(
+            jax.random.PRNGKey(2), (batch, s), 0, cfg.vocab_size
+        )
+        loss_mask = (
+            jax.random.uniform(jax.random.PRNGKey(3), (batch, s)) < 0.15
+        )
 
-    def step_body(params, state, tokens, labels, loss_mask):
-        def loss_fn(p):
-            loss = model_fn(p, tokens, labels, loss_mask)
-            return amp.scale_loss(loss, state)
+        def step_body(params, state, tokens, labels, loss_mask):
+            def loss_fn(p):
+                loss = amp_fn(p, tokens, labels, loss_mask)
+                return amp.scale_loss(loss, state)
 
-        grads = jax.grad(loss_fn)(params)
-        return opt.apply_gradients(grads, state, params)
+            grads = jax.grad(loss_fn)(params)
+            return opt.apply_gradients(grads, state, params)
 
-    specs = jax.tree.map(lambda _: P(), params)
-    sspec = jax.tree.map(lambda _: P(), state)
-    step = jax.jit(smap(
-        step_body, mesh,
-        (specs, sspec, P(), P(), P()),
-        (specs, sspec),
-    ), donate_argnums=(0, 1))
+        specs = jax.tree.map(lambda _: P(), params)
+        sspec = jax.tree.map(lambda _: P(), state)
+        step = jax.jit(smap(
+            step_body, mesh,
+            (specs, sspec, P(), P(), P()),
+            (specs, sspec),
+        ), donate_argnums=(0, 1))
 
-    # warmup / compile
-    t0 = time.perf_counter()
-    params, state = step(params, state, tokens, labels, loss_mask)
-    jax.block_until_ready(jax.tree.leaves(params)[0])
-    compile_s = time.perf_counter() - t0
+        try:
+            compile_s, dt, xla_flops = _measure(
+                step, (params, state, tokens, labels, loss_mask),
+                iters=5 if on_cpu else 20,
+            )
+        except Exception as e:  # noqa: BLE001 — e.g. OOM at large batch
+            print(f"bench: batch {batch} failed: {e}", file=sys.stderr)
+            sweep.append({"batch": batch, "error": str(e).splitlines()[0][:200]})
+            continue
+        flops = _hand_flops(cfg, batch)
+        mfu = flops / dt / peak_flops(dev)
+        row = {
+            "batch": batch,
+            "samples_per_sec": round(batch / dt, 2),
+            "step_ms": round(dt * 1e3, 2),
+            "mfu": round(mfu, 4),
+            "compile_s": round(compile_s, 1),
+            "hand_flops": flops,
+            "xla_flops": xla_flops,
+        }
+        sweep.append(row)
+        if best is None or row["samples_per_sec"] > best["samples_per_sec"]:
+            best = row
 
-    iters = 5 if on_cpu else 20
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, state = step(params, state, tokens, labels, loss_mask)
-    jax.block_until_ready(jax.tree.leaves(params)[0])
-    dt = (time.perf_counter() - t0) / iters
-
-    samples_per_sec = batch / dt
-    # fwd+bwd matmul FLOPs: 6 x MACs (fwd 2x, bwd 4x) per token
-    h, L, s, v = cfg.hidden, cfg.layers, cfg.seq_len, cfg.vocab_size
-    macs_per_token = L * (12 * h * h + 2 * s * h) + h * v
-    flops = 6 * macs_per_token * batch * s
-    mfu = flops / dt / peak_flops(dev)
+    if best is None:
+        raise RuntimeError(f"all batch sizes failed: {sweep}")
 
     print(
         json.dumps(
             {
                 "metric": _METRIC,
-                "value": round(samples_per_sec, 2),
+                "value": best["samples_per_sec"],
                 "unit": "samples/sec/chip",
-                "vs_baseline": round(mfu / 0.50, 4),
+                "vs_baseline": round(best["mfu"] / 0.50, 4),
+                "ok": True,
                 "detail": {
-                    "mfu": round(mfu, 4),
-                    "step_ms": round(dt * 1e3, 2),
-                    "compile_s": round(compile_s, 1),
-                    "device": str(dev),
-                    "batch": batch,
+                    "mfu": best["mfu"],
+                    "step_ms": best["step_ms"],
+                    "batch": best["batch"],
                     "seq": s,
+                    "device": str(dev),
                     "config": "toy-cpu" if on_cpu else "bert-large",
+                    "sweep": sweep,
+                    "kernels": kernel_report,
                 },
             }
         )
@@ -276,4 +328,4 @@ if __name__ == "__main__":
 
         traceback.print_exc(file=sys.stderr)
         emit(_error_payload(f"{type(e).__name__}: {e}"))
-        sys.exit(0)
+        sys.exit(3)

@@ -1,0 +1,98 @@
+"""Step-level A/B: full BERT-large train step with kernel families toggled
+via the preflight registry, plus remat policy variants. Wall-clock full
+steps only — no async-dispatch micro-timing pitfalls. Decides (with data)
+which Pallas kernels earn their keep in the flagship config and what the
+remat policy should be (round-2 verdict items 4/5/7).
+
+Usage: python benchmarks/bench_step_variants.py [batch] [variants...]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def build_step(batch, remat, cfg_over=None):
+    from apex_tpu import amp
+    from apex_tpu.optimizers import fused_lamb
+    from apex_tpu.testing import (
+        TransformerConfig, bert_loss, stack_layer_params, transformer_init)
+    from apex_tpu.testing.commons import smap
+
+    cfg = TransformerConfig(
+        vocab_size=30528, seq_len=512, hidden=1024, layers=24, heads=16,
+        causal=False, dtype=jnp.bfloat16, scan_layers=True, remat=remat,
+        **(cfg_over or {}))
+    params = stack_layer_params(transformer_init(jax.random.PRNGKey(0), cfg))
+
+    def model_fn(p, tokens, labels, mask):
+        return bert_loss(p, tokens, labels, mask, cfg)
+
+    amp_fn, params, opt = amp.initialize(
+        model_fn, params, fused_lamb(1e-3), opt_level="O2", verbosity=0)
+    state = opt.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, 512), 0, cfg.vocab_size)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (batch, 512), 0, cfg.vocab_size)
+    mask = jax.random.uniform(jax.random.PRNGKey(3), (batch, 512)) < 0.15
+
+    def step_body(params, state, tokens, labels, loss_mask):
+        def loss_fn(p):
+            return amp.scale_loss(amp_fn(p, tokens, labels, loss_mask), state)
+        grads = jax.grad(loss_fn)(params)
+        return opt.apply_gradients(grads, state, params)
+
+    mesh = Mesh([jax.devices()[0]], ("model",))
+    specs = jax.tree.map(lambda _: P(), params)
+    sspec = jax.tree.map(lambda _: P(), state)
+    step = jax.jit(smap(step_body, mesh, (specs, sspec, P(), P(), P()),
+                        (specs, sspec)), donate_argnums=(0, 1))
+    return step, (params, state, tokens, labels, mask)
+
+
+def run(step, args, iters=10):
+    compiled = step.lower(*args).compile()
+    params, state, *rest = args
+    params, state = compiled(params, state, *rest)
+    jax.block_until_ready(jax.tree.leaves(params)[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, state = compiled(params, state, *rest)
+    jax.block_until_ready(jax.tree.leaves(params)[0])
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    from apex_tpu.ops import _utils
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    which = sys.argv[2:] or ["pallas", "no_ln", "no_flash", "no_pallas"]
+    print(f"device={jax.devices()[0]} batch={batch}", flush=True)
+
+    variants = {
+        "pallas": [],
+        "no_ln": ["layer_norm", "rms_norm"],
+        "no_flash": ["flash_attention"],
+        "no_pallas": ["layer_norm", "rms_norm", "flash_attention", "optim_flat"],
+    }
+    for name in which:
+        disable = variants[name]
+        for k in ("layer_norm", "rms_norm", "flash_attention", "optim_flat"):
+            _utils.enable_kernel(k)
+        for k in disable:
+            _utils.disable_kernel(k)
+        try:
+            step, args = build_step(batch, remat=True)
+            ms = run(step, args)
+            print(f"{name:10s} remat=full : {ms:8.1f} ms/step  "
+                  f"{batch/ms*1e3:6.1f} samples/s", flush=True)
+        except Exception as e:
+            print(f"{name:10s} FAILED: {str(e)[:160]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,163 @@
+"""contrib.openfold (evoformer kernel surface) + ASP channel-permutation
+search. Oracles are straight jnp compositions."""
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib import openfold
+from apex_tpu.contrib.sparsity.permutation import (
+    apply_channel_permutation,
+    invert_permutation,
+    permutation_efficacy,
+    search_channel_permutation,
+)
+from apex_tpu.contrib.sparsity.sparse_masklib import create_mask
+
+
+def _mha_oracle(q, k, v, mask=None, bias=None, gate=None):
+    d = q.shape[-1]
+    s = jnp.einsum("...qd,...kd->...qk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / jnp.sqrt(float(d))
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if mask is not None:
+        s = jnp.where(mask, s, -30000.0)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32))
+    if gate is not None:
+        o = o * jax.nn.sigmoid(gate.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
+class TestOpenfoldMHA:
+    def _inputs(self, lead=(2, 3), h=2, s=128, d=32, dtype=jnp.bfloat16):
+        ks = jax.random.split(jax.random.PRNGKey(0), 5)
+        shape = (*lead, h, s, d)
+        q = jax.random.normal(ks[0], shape, dtype)
+        k = jax.random.normal(ks[1], shape, dtype)
+        v = jax.random.normal(ks[2], shape, dtype)
+        bias = jax.random.normal(ks[3], (*lead, h, s, s), jnp.float32)
+        gate = jax.random.normal(ks[4], shape, dtype)
+        return q, k, v, bias, gate
+
+    def test_plain(self):
+        q, k, v, _, _ = self._inputs()
+        got = openfold.mha(q, k, v)
+        want = _mha_oracle(q, k, v)
+        assert jnp.max(jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32))) < 3e-2
+
+    def test_bias_mask_gate(self):
+        q, k, v, bias, gate = self._inputs()
+        mask = jax.random.uniform(jax.random.PRNGKey(7), (2, 3, 1, 1, q.shape[-2])) < 0.9
+        got = openfold.mha(q, k, v, mask=mask, bias=bias, gate=gate)
+        want = _mha_oracle(q, k, v, mask=mask, bias=bias, gate=gate)
+        assert jnp.max(jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32))) < 3e-2
+
+    def test_grads_flow(self):
+        q, k, v, bias, gate = self._inputs(lead=(2,), s=128)
+
+        def loss(q, k, v, gate):
+            return jnp.sum(openfold.mha(q, k, v, bias=bias, gate=gate).astype(jnp.float32) ** 2)
+
+        grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3)))(q, k, v, gate)
+        for g in grads:
+            assert bool(jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+            assert float(jnp.max(jnp.abs(g.astype(jnp.float32)))) > 0
+
+
+def test_swiglu_transition_matches_composition():
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (4, 64, 128), jnp.bfloat16)
+    wg = jax.random.normal(jax.random.PRNGKey(1), (128, 256), jnp.bfloat16) * 0.05
+    wu = jax.random.normal(jax.random.PRNGKey(2), (128, 256), jnp.bfloat16) * 0.05
+    wd = jax.random.normal(jax.random.PRNGKey(3), (256, 128), jnp.bfloat16) * 0.05
+    got = openfold.swiglu_transition(x, wg, wu, wd)
+    x32 = x.astype(jnp.float32)
+    gate = openfold.swish(x32 @ wg.astype(jnp.float32))
+    want = ((gate * (x32 @ wu.astype(jnp.float32))).astype(jnp.bfloat16).astype(jnp.float32)
+            @ wd.astype(jnp.float32))
+    assert jnp.max(jnp.abs(got.astype(jnp.float32) - want)) < 0.25
+
+
+def test_layer_norm_reexport_is_fused_ln():
+    from apex_tpu.normalization.fused_layer_norm import FusedLayerNorm
+
+    assert openfold.LayerNorm is FusedLayerNorm
+
+
+class TestDAP:
+    def test_scatter_gather_roundtrip(self, eight_cpu_devices):
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        mesh = Mesh(eight_cpu_devices[:4], ("dap",))
+        x = jnp.arange(4 * 8 * 6, dtype=jnp.float32).reshape(8, 6, 4).transpose(2, 0, 1)
+
+        def body(x):
+            local = openfold.dap_scatter(x, "dap", 1)
+            return openfold.dap_gather(local, "dap", 1)
+
+        try:  # the gathered output is replicated; the static check can't see it
+            sm = shard_map(body, mesh=mesh, in_specs=P(), out_specs=P(),
+                           check_vma=False)
+        except TypeError:  # older jax spells it check_rep
+            sm = shard_map(body, mesh=mesh, in_specs=P(), out_specs=P(),
+                           check_rep=False)
+        out = sm(x)
+        assert jnp.array_equal(out, x)
+
+    def test_row_col_transpose_roundtrip(self, eight_cpu_devices):
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        mesh = Mesh(eight_cpu_devices[:4], ("dap",))
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 8, 3))
+
+        def body(xr):  # xr: row-sharded (2, 8, 3)
+            xc = openfold.dap_row_to_col(xr, "dap", 0, 1)  # col-sharded (8, 2, 3)
+            return openfold.dap_col_to_row(xc, "dap", 0, 1)
+
+        out = shard_map(
+            body, mesh=mesh, in_specs=P("dap"), out_specs=P("dap")
+        )(x)
+        assert jnp.allclose(out, x)
+
+
+class TestPermutationSearch:
+    def test_monotone_improvement_and_validity(self):
+        key = jax.random.PRNGKey(0)
+        spikes = 1.0 + 5.0 * (jax.random.uniform(jax.random.PRNGKey(1), (64,)) < 0.2)
+        w = jax.random.normal(key, (48, 64)) * spikes
+        ident = jnp.arange(64, dtype=jnp.int32)
+        e0 = float(permutation_efficacy(w, ident))
+        perm = search_channel_permutation(w, sweeps=24)
+        e1 = float(permutation_efficacy(w, perm))
+        assert e1 >= e0
+        assert sorted(map(int, perm)) == list(range(64))
+
+    def test_beats_identity_on_adversarial_layout(self):
+        # all big channels packed into the same groups: any search worth its
+        # name must spread them out
+        r, c = 32, 32
+        w = jnp.ones((r, c)) * 0.01
+        w = w.at[:, :8].set(10.0)  # two full groups of giants
+        e0 = float(permutation_efficacy(w, jnp.arange(c, dtype=jnp.int32)))
+        perm = search_channel_permutation(w, sweeps=16, key=jax.random.PRNGKey(3))
+        e1 = float(permutation_efficacy(w, perm))
+        assert e1 > e0 * 1.2, (e0, e1)
+
+    def test_efficacy_matches_mask_retention(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (16, 16))
+        perm = search_channel_permutation(w, sweeps=8)
+        wp = apply_channel_permutation(w, perm)
+        mask = create_mask(wp, "m4n2_1d")
+        retained = float(jnp.sum(jnp.abs(wp) * mask))
+        assert abs(retained - float(permutation_efficacy(w, perm))) < 1e-3
+
+    def test_invert(self):
+        perm = search_channel_permutation(
+            jax.random.normal(jax.random.PRNGKey(0), (8, 16)), sweeps=4)
+        inv = invert_permutation(perm)
+        assert jnp.array_equal(perm[inv], jnp.arange(16, dtype=perm.dtype))

@@ -14,16 +14,22 @@ happened yet when conftest runs.
 
 import os
 
+# APEX_TPU_HW=1 keeps the ambient (TPU) platform so the tests/tpu tier can
+# compile kernels with Mosaic on the real chip; everything else runs on the
+# hermetic 8-device CPU mesh.
+_HW = os.environ.get("APEX_TPU_HW") == "1"
+
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+if not _HW and "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _HW:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
