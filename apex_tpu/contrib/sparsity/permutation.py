@@ -77,8 +77,9 @@ def search_channel_permutation(weight: jax.Array, *, m: int = 4, n: int = 2,
             cas, cbs = jax.vmap(cand)(ii, jj)           # (m*m, m)
             cas = jnp.concatenate([a_ids[None], cas])    # (1+m*m, m)
             cbs = jnp.concatenate([b_ids[None], cbs])
-            score = (_retained(jnp.abs(w[:, cas]).transpose(1, 0, 2), n)
-                     + _retained(jnp.abs(w[:, cbs]).transpose(1, 0, 2), n))
+            # w is already |weight| (function entry) — no abs here
+            score = (_retained(w[:, cas].transpose(1, 0, 2), n)
+                     + _retained(w[:, cbs].transpose(1, 0, 2), n))
             k = jnp.argmax(score)  # identity wins ties (index 0)
             return cas[k], cbs[k]
 

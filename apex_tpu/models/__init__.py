@@ -1,0 +1,25 @@
+"""apex_tpu.models — reference model definitions for the benchmark configs.
+
+The reference ships its model zoo via examples (apex/examples/imagenet) and
+external DeepLearningExamples; here the models the BASELINE configs need are
+first-class so examples and benches stay thin:
+
+- resnet: functional NHWC ResNet-50 (bottleneck v1.5) with pluggable
+  normalization — local BN, cross-replica SyncBN (psum over a mesh axis),
+  or GroupNorm (the RetinaNet configuration).
+- The transformer family (BERT/GPT with TP/SP/scan/remat) lives in
+  apex_tpu.testing.standalone_transformer and is re-exported here.
+"""
+
+from apex_tpu.models.resnet import (  # noqa: F401
+    resnet50_init,
+    resnet50_apply,
+    resnet_init,
+    resnet_apply,
+)
+from apex_tpu.testing.standalone_transformer import (  # noqa: F401
+    TransformerConfig,
+    bert_loss,
+    gpt_loss,
+    transformer_init,
+)

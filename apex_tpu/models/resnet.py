@@ -1,0 +1,160 @@
+"""Functional NHWC ResNet (bottleneck v1.5) with pluggable normalization.
+
+Ref: apex/examples/imagenet/main_amp.py trains torchvision resnet50 under
+amp+DDP, and apex/parallel converts its BatchNorm to SyncBatchNorm; the
+RetinaNet config swaps BN for GroupNorm (apex/contrib group_norm). This
+module is the TPU-native model those configs exercise:
+
+- NHWC layout (TPU conv native), 3x3 stride-2 in the bottleneck (v1.5).
+- norm="bn" | "syncbn" | "gn": BN keeps running stats in a separate state
+  pytree (functional — no module mutation); syncbn psums batch statistics
+  over a named mesh axis via parallel.sync_batchnorm.sync_batch_stats;
+  gn uses contrib.group_norm (32 groups, the RetinaNet setting).
+- bf16-friendly: params fp32, compute dtype set by the caller's amp policy.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.group_norm import group_norm_nhwc
+from apex_tpu.parallel.sync_batchnorm import sync_batch_stats
+
+_DN = ("NHWC", "HWIO", "NHWC")
+_STAGES50 = (3, 4, 6, 3)
+
+
+def _conv(x, w, stride=1, padding="SAME"):
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), padding, dimension_numbers=_DN
+    )
+
+
+def _he(key, shape, dtype=jnp.float32):
+    fan_in = shape[0] * shape[1] * shape[2]
+    return (jax.random.normal(key, shape) * (2.0 / fan_in) ** 0.5).astype(dtype)
+
+
+def _norm_init(ch):
+    return {"gamma": jnp.ones((ch,), jnp.float32),
+            "beta": jnp.zeros((ch,), jnp.float32)}
+
+
+def _norm_state(ch):
+    return {"mean": jnp.zeros((ch,), jnp.float32),
+            "var": jnp.ones((ch,), jnp.float32)}
+
+
+def _apply_norm(x, p, s, *, norm, training, axis_name, momentum=0.9, eps=1e-5):
+    """Returns (y, new_state). GroupNorm has no state (s passes through)."""
+    if norm == "gn":
+        return group_norm_nhwc(x, p["gamma"], p["beta"], num_groups=32,
+                               eps=eps), s
+    if training:
+        if norm == "syncbn":
+            mean, var = sync_batch_stats(x, axis_name)
+        else:
+            mean, var = sync_batch_stats(x, None)
+        new_s = {
+            "mean": momentum * s["mean"] + (1 - momentum) * mean,
+            "var": momentum * s["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    inv = jax.lax.rsqrt(var + eps) * p["gamma"]
+    y = (x.astype(jnp.float32) - mean) * inv + p["beta"]
+    return y.astype(x.dtype), new_s
+
+
+def _block_init(key, in_ch, mid, out_ch):
+    ks = jax.random.split(key, 4)
+    p = {
+        "conv1": _he(ks[0], (1, 1, in_ch, mid)), "n1": _norm_init(mid),
+        "conv2": _he(ks[1], (3, 3, mid, mid)), "n2": _norm_init(mid),
+        "conv3": _he(ks[2], (1, 1, mid, out_ch)), "n3": _norm_init(out_ch),
+    }
+    s = {"n1": _norm_state(mid), "n2": _norm_state(mid),
+         "n3": _norm_state(out_ch)}
+    if in_ch != out_ch:
+        p["proj"] = _he(ks[3], (1, 1, in_ch, out_ch))
+        p["np"] = _norm_init(out_ch)
+        s["np"] = _norm_state(out_ch)
+    return p, s
+
+
+def _block_apply(p, s, x, *, stride, norm, training, axis_name):
+    ns = {}
+    y = _conv(x, p["conv1"])
+    y, ns["n1"] = _apply_norm(y, p["n1"], s["n1"], norm=norm,
+                              training=training, axis_name=axis_name)
+    y = jax.nn.relu(y)
+    y = _conv(y, p["conv2"], stride=stride)  # v1.5: stride on the 3x3
+    y, ns["n2"] = _apply_norm(y, p["n2"], s["n2"], norm=norm,
+                              training=training, axis_name=axis_name)
+    y = jax.nn.relu(y)
+    y = _conv(y, p["conv3"])
+    y, ns["n3"] = _apply_norm(y, p["n3"], s["n3"], norm=norm,
+                              training=training, axis_name=axis_name)
+    if "proj" in p:
+        sc = _conv(x, p["proj"], stride=stride)
+        sc, ns["np"] = _apply_norm(sc, p["np"], s["np"], norm=norm,
+                                   training=training, axis_name=axis_name)
+    else:
+        sc = x if stride == 1 else x[:, ::stride, ::stride, :]
+    return jax.nn.relu(y + sc), ns
+
+
+def resnet_init(key, *, stages=_STAGES50, width=64, num_classes=1000):
+    """Returns (params, norm_state)."""
+    ks = jax.random.split(key, 2 + sum(stages))
+    params = {"stem": _he(ks[0], (7, 7, 3, width)), "stem_n": _norm_init(width)}
+    state = {"stem_n": _norm_state(width)}
+    in_ch, ki = width, 1
+    for si, blocks in enumerate(stages):
+        mid = width * (2 ** si)
+        out_ch = mid * 4
+        for bi in range(blocks):
+            p, s = _block_init(ks[ki], in_ch, mid, out_ch)
+            params[f"s{si}b{bi}"] = p
+            state[f"s{si}b{bi}"] = s
+            in_ch = out_ch
+            ki += 1
+    params["head"] = (jax.random.normal(ks[ki], (in_ch, num_classes))
+                      * (1.0 / in_ch) ** 0.5).astype(jnp.float32)
+    return params, state
+
+
+def resnet_apply(params, state, x, *, stages=_STAGES50, norm="bn",
+                 training=True, axis_name: Optional[str] = None,
+                 return_features=False):
+    """x: [N, H, W, 3]. Returns (logits, new_state) — or, with
+    return_features, ((c3, c4, c5) pyramid features, new_state)."""
+    ns = {}
+    y = _conv(x, params["stem"], stride=2)
+    y, ns["stem_n"] = _apply_norm(y, params["stem_n"], state["stem_n"],
+                                  norm=norm, training=training,
+                                  axis_name=axis_name)
+    y = jax.nn.relu(y)
+    y = jax.lax.reduce_window(y, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+    feats = []
+    for si, blocks in enumerate(stages):
+        for bi in range(blocks):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            y, ns[f"s{si}b{bi}"] = _block_apply(
+                params[f"s{si}b{bi}"], state[f"s{si}b{bi}"], y, stride=stride,
+                norm=norm, training=training, axis_name=axis_name)
+        feats.append(y)
+    if return_features:
+        return tuple(feats[-3:]), ns
+    y = y.mean(axis=(1, 2)).astype(jnp.float32)
+    return y @ params["head"], ns
+
+
+resnet50_init = functools.partial(resnet_init, stages=_STAGES50)
+resnet50_apply = functools.partial(resnet_apply, stages=_STAGES50)

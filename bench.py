@@ -226,7 +226,7 @@ def main():
             causal=False, dtype=jnp.bfloat16, scan_layers=True, remat=True,
         )
         batches = [int(b) for b in os.environ.get(
-            "BENCH_BATCHES", "16,32,64").split(",")]
+            "BENCH_BATCHES", "32,64,96,128").split(",")]
 
     def model_fn(p, tokens, labels, loss_mask):
         return bert_loss(p, tokens, labels, loss_mask, cfg)

@@ -1,0 +1,118 @@
+"""BASELINE config 4: GPT-2 medium — tensor parallel over a TPU mesh.
+
+Ref: apex/transformer usage in Megatron-style pretraining — TP layers,
+vocab-parallel cross-entropy, MP RNG. The model is the standalone GPT from
+apex_tpu.testing (ColumnParallel QKV/MLP, RowParallel projections, Megatron
+sequence parallelism, scan+remat) on a ``model``-axis mesh.
+
+On CPU: tp=4 toy config over the virtual mesh. On a TPU slice: GPT-2
+medium (24 x 1024, 16 heads) with tp = all local chips.
+
+    python examples/gpt2_tensor_parallel.py [--bench] [--cpu]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--bench", action="store_true")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        jax.config.update("jax_platforms", "cpu")
+
+    from apex_tpu import amp
+    from apex_tpu.optimizers import fused_adam
+    from apex_tpu.testing import (
+        TransformerConfig, gpt_loss, param_specs, sp_grad_sync,
+        transformer_init)
+    from apex_tpu.testing.commons import smap
+
+    devs = jax.devices()
+    on_tpu = devs[0].platform == "tpu"
+    tp = min(4, len(devs)) if not on_tpu else len(devs)
+    mesh = Mesh(np.array(devs[:tp]), ("model",))
+
+    if on_tpu:
+        # GPT-2 medium: 24 x 1024, 16 heads, seq 1024
+        cfg = TransformerConfig(
+            vocab_size=50304, seq_len=1024, hidden=1024, layers=24, heads=16,
+            causal=True, dtype=jnp.bfloat16, scan_layers=True, remat=True,
+            sequence_parallel=tp > 1)
+        batch = args.batch or 16
+    else:
+        cfg = TransformerConfig(
+            vocab_size=512, seq_len=64, hidden=64, layers=2, heads=4,
+            causal=True, dtype=jnp.bfloat16, sequence_parallel=tp > 1)
+        batch = args.batch or 4
+
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+
+    def model_fn(p, tokens):
+        return gpt_loss(p, tokens, cfg)
+
+    model_fn, params, opt = amp.initialize(
+        model_fn, params, fused_adam(1e-4), opt_level="O2", verbosity=0)
+
+    import dataclasses
+    opt_local = dataclasses.replace(opt, master_source=None)
+
+    def step_body(params, tokens):
+        state = opt_local.init(params)
+
+        def loss_fn(p):
+            return amp.scale_loss(model_fn(p, tokens), state)
+
+        grads = jax.grad(loss_fn)(params)
+        grads = sp_grad_sync(grads, cfg)
+        new_params, _ = opt_local.apply_gradients(grads, state, params)
+        return new_params
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.seq_len),
+                                0, cfg.vocab_size)
+    specs = param_specs(cfg)
+    step = jax.jit(smap(step_body, mesh, (specs, P()), specs))
+
+    compiled = step.lower(params, tokens).compile()
+    params = compiled(params, tokens)
+    jax.block_until_ready(jax.tree.leaves(params)[0])
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        params = compiled(params, tokens)
+    jax.block_until_ready(jax.tree.leaves(params)[0])
+    dt = (time.perf_counter() - t0) / args.iters
+    toks = batch * cfg.seq_len / dt
+
+    if args.bench:
+        print(json.dumps({
+            "metric": "gpt2_medium_tp_tokens_per_sec",
+            "value": round(toks, 0), "unit": "tokens/sec",
+            "detail": {"tp": tp, "batch": batch, "seq": cfg.seq_len,
+                       "sp": cfg.sequence_parallel,
+                       "step_ms": round(dt * 1e3, 2),
+                       "device": str(devs[0])}}))
+    else:
+        print(f"gpt2 tp={tp} (SP={'on' if cfg.sequence_parallel else 'off'}): "
+              f"{toks:.0f} tokens/sec ({dt*1e3:.1f} ms/step)")
+
+
+if __name__ == "__main__":
+    main()

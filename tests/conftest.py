@@ -16,11 +16,14 @@ import os
 
 # APEX_TPU_HW=1 keeps the ambient (TPU) platform so the tests/tpu tier can
 # compile kernels with Mosaic on the real chip; everything else runs on the
-# hermetic 8-device CPU mesh.
+# hermetic 8-device CPU mesh. The two modes don't mix in one process (the
+# platform is process-global), so under APEX_TPU_HW=1 every test OUTSIDE
+# tests/tpu is skipped — `APEX_TPU_HW=1 pytest tests/` runs just the
+# hardware tier instead of erroring the mesh suites.
 _HW = os.environ.get("APEX_TPU_HW") == "1"
 
 _flags = os.environ.get("XLA_FLAGS", "")
-if not _HW and "xla_force_host_platform_device_count" not in _flags:
+if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
@@ -32,6 +35,20 @@ if not _HW:
     jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
+
+_TPU_TIER_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tpu")
+
+
+def pytest_collection_modifyitems(config, items):
+    if not _HW:
+        return
+    skip = pytest.mark.skip(
+        reason="APEX_TPU_HW=1 runs the tests/tpu hardware tier only; "
+               "unset it for the CPU-mesh suites"
+    )
+    for item in items:
+        if not str(item.fspath).startswith(_TPU_TIER_DIR):
+            item.add_marker(skip)
 
 
 @pytest.fixture(scope="session")
