@@ -325,7 +325,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref, *rest,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do):
+def _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
     b, sq, d = q.shape
     sk = k.shape[1]
     bq = _block_size(sq)
@@ -335,10 +335,15 @@ def _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do):
     vp = _pad_seq(v, bk, 1)
     dop = _pad_seq(do, bq, 1)
     sqp, skp = qp.shape[1], kp.shape[1]
-    # delta = rowsum(do * o), carried as [b, sq, 1] for 2-D kernel loads
+    # delta = rowsum(do * o), carried as [b, sq, 1] for 2-D kernel loads.
+    # An lse cotangent folds in exactly here: ds = p*(dp - delta + dlse)
+    # because d(lse_i)/d(s_ij) = p_ij — so delta -= dlse and the kernels
+    # need no changes (used by flash_attention_with_lse / ring attention).
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
     )
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)[..., None]
     deltap = _pad_seq(delta, bq, 1)
     # padded q rows: lse would be 0 -> p = exp(0-0)=1 garbage; set lse huge
     lsep = _pad_seq(lse[..., None], bq, 1)
@@ -424,22 +429,25 @@ def _scores(q, k, bias, causal, scale):
     return s
 
 
-def _bwd_pieces(q, k, v, bias, causal, scale, o, lse, do):
+def _bwd_pieces(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
     """Shared unfused backward prologue: probabilities p and score grads ds
     (ds IS the bias gradient pre-reduction). Materializes the [Sq, Sk]
-    score tile — used only on the fallback path and for dbias."""
+    score tile — used only on the fallback path and for dbias. ``dlse``
+    (the lse cotangent) enters as ds += p * dlse, i.e. delta -= dlse."""
     s = _scores(q, k, bias, causal, scale)
     p = jnp.where(s > _VALID_THRESHOLD, jnp.exp(s - lse[..., None]), 0.0)
     do32 = do.astype(jnp.float32)
     dp = jnp.einsum("bqd,bkd->bqk", do32, v.astype(jnp.float32),
                     precision=_HIGHEST)
     delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1, keepdims=True)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)[..., None]
     ds = p * (dp - delta)
     return p, ds, do32
 
 
-def _bwd_ref(q, k, v, bias, causal, scale, o, lse, do):
-    p, ds, do32 = _bwd_pieces(q, k, v, bias, causal, scale, o, lse, do)
+def _bwd_ref(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
+    p, ds, do32 = _bwd_pieces(q, k, v, bias, causal, scale, o, lse, do, dlse)
     dv = jnp.einsum("bqk,bqd->bkd", p, do32, precision=_HIGHEST)
     dq = jnp.einsum("bqk,bkd->bqd", ds, k.astype(jnp.float32),
                     precision=_HIGHEST) * scale
@@ -496,6 +504,69 @@ def _flash_core_bwd(causal, scale, use_pallas, need_dbias, res, do):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_core_lse(q, k, v, bias, causal, scale, use_pallas):
+    """Like _flash_core but returns (o, lse) with lse DIFFERENTIABLE —
+    the building block for ring/context-parallel attention, whose partial-
+    result merge needs per-chunk logsumexps and their exact gradients."""
+    (o, lse), _ = _flash_core_lse_fwd(q, k, v, bias, causal, scale,
+                                      use_pallas)
+    return o, lse
+
+
+def _flash_core_lse_fwd(q, k, v, bias, causal, scale, use_pallas):
+    o, (q, k, v, bias, o, lse) = _flash_core_fwd(
+        q, k, v, bias, causal, scale, use_pallas, need_dbias=False)
+    return (o, lse), (q, k, v, bias, o, lse)
+
+
+def _flash_core_lse_bwd(causal, scale, use_pallas, res, cts):
+    do, dlse = cts
+    q, k, v, bias, o, lse = res
+    use = default_use_pallas("flash_attention") if use_pallas is None else use_pallas
+    if use:
+        dq, dk, dv = _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do,
+                                 dlse)
+    else:
+        dq, dk, dv, _ = _bwd_ref(q, k, v, bias, causal, scale, o, lse, do,
+                                 dlse)
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, dbias
+
+
+_flash_core_lse.defvjp(_flash_core_lse_fwd, _flash_core_lse_bwd)
+
+
+def _flatten_qkv(q, k, v, bias):
+    """Shared prologue: [..., s, d] -> [B, s, d] 3-D views plus the compact
+    bias broadcast ([B, 1, sk] when query-invariant)."""
+    lead = q.shape[:-2]
+    sq, d = q.shape[-2:]
+    sk = k.shape[-2]
+    q3 = q.reshape(-1, sq, d)
+    k3 = k.reshape(-1, sk, d)
+    v3 = v.reshape(-1, sk, d)
+    bias3 = None
+    if bias is not None:
+        bsq = bias.shape[-2] if bias.ndim >= 2 else 1
+        tgt_q = 1 if bsq == 1 else sq
+        bias3 = jnp.broadcast_to(bias, lead + (tgt_q, sk)).reshape(-1, tgt_q, sk)
+    return lead, q3, k3, v3, bias3
+
+
+def flash_attention_with_lse(q, k, v, *, bias=None, causal=False, scale=None,
+                             use_pallas=None):
+    """flash_attention that also returns the per-row logsumexp ([..., sq],
+    fully differentiable). ``bias`` here is mask-like (no dbias). Used by
+    transformer.context_parallel for ring attention."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    lead, q3, k3, v3, bias3 = _flatten_qkv(q, k, v, bias)
+    o, lse = _flash_core_lse(q3, k3, v3, bias3, causal, scale, use_pallas)
+    sq, d = q.shape[-2:]
+    return o.reshape(lead + (sq, d)), lse.reshape(lead + (sq,))
+
+
 def flash_attention(
     q,
     k,
@@ -537,17 +608,7 @@ def flash_attention(
         )
         bias = mbias if bias is None else bias.astype(jnp.float32) + mbias
 
-    q3 = q.reshape(-1, sq, d)
-    k3 = k.reshape(-1, sk, d)
-    v3 = v.reshape(-1, sk, d)
-    bias3 = None
-    if bias is not None:
-        # keep a query-invariant bias compact: [B, 1, sk] not [B, sq, sk]
-        bsq = bias.shape[-2] if bias.ndim >= 2 else 1
-        tgt_q = 1 if bsq == 1 else sq
-        bias3 = jnp.broadcast_to(
-            bias, lead + (tgt_q, sk)
-        ).reshape(-1, tgt_q, sk)
+    lead, q3, k3, v3, bias3 = _flatten_qkv(q, k, v, bias)
 
     if dropout_p > 0.0:
         if dropout_rng is None:

@@ -1,0 +1,142 @@
+"""Context parallelism for long sequences: ring attention + Ulysses all-to-all.
+
+Ref: the reference scales long sequences with Megatron context parallelism
+(ring exchange of KV over NCCL p2p, apex/transformer + TE integration) and
+DeepSpeed-Ulysses-style head/sequence all-to-all. TPU mapping:
+
+- ``ring_attention``: Q/K/V are sequence-sharded over a mesh axis; KV
+  chunks circulate the ring with ``ppermute`` (neighbor DMA on ICI) inside
+  ``lax.scan`` while each hop's flash partials (o_t, lse_t) merge via the
+  online-softmax rule. The merge needs per-chunk logsumexps WITH exact
+  gradients — ops/attention.py::flash_attention_with_lse provides them
+  (the lse cotangent folds into the flash backward's delta term), so the
+  whole ring is reverse-differentiable with plain autodiff: the scan
+  transpose reverses the ring, which is exactly the backward KV pass the
+  reference implements by hand.
+- ``ulysses_attention``: two ``all_to_all``s re-shard [heads, seq_local] ->
+  [heads_local, seq] around a normal full-sequence flash call. Cheaper
+  than the ring when heads >= ring size (one collective pair instead of
+  C-1 hops) but caps the parallelism at the head count.
+
+Both run inside ``shard_map`` over a named axis (e.g. "context"). Causal
+masking uses global positions, so results equal single-device causal
+attention on the gathered sequence.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.ops.attention import flash_attention, flash_attention_with_lse
+
+_NEG = -1e30
+
+
+def _merge(o_a, lse_a, o_b, lse_b):
+    """Online-softmax merge of two normalized partials (fp32)."""
+    m = jnp.maximum(lse_a, lse_b)
+    # guard fully-masked rows (both lse ~ -1e30): shift so exp() is finite
+    m = jnp.maximum(m, _NEG)
+    wa = jnp.exp(lse_a - m)
+    wb = jnp.exp(lse_b - m)
+    denom = wa + wb
+    o = (o_a * wa[..., None] + o_b * wb[..., None]) / denom[..., None]
+    return o, m + jnp.log(denom)
+
+
+def ring_attention(q, k, v, axis: str, *, causal: bool = False,
+                   scale: float | None = None, use_pallas: bool | None = None):
+    """Exact attention over a sequence sharded along ``axis``.
+
+    q, k, v: [..., s_local, d] — the LOCAL sequence chunk (global sequence
+    = concatenation over ring ranks in axis order). Must be called inside
+    ``shard_map``. Returns the local chunk of the attention output.
+
+    Causal masking is positional per hop: the diagonal chunk masks
+    in-kernel, below-diagonal chunks run unmasked, above-diagonal chunks
+    skip the flash call entirely (lax.switch on the chunk index). The KV
+    rotation is C-1 ``ppermute`` neighbor hops (the local chunk is
+    processed before any communication), overlapped with compute by XLA's
+    latency-hiding scheduler.
+    """
+    c = lax.axis_size(axis)
+    r = lax.axis_index(axis)
+    perm = [(i, (i + 1) % c) for i in range(c)]
+
+    def attend(k_t, v_t, src):
+        """(o_t, lse_t) for the KV chunk with global index ``src``. Causal
+        masking is positional per chunk: the diagonal chunk uses the
+        in-kernel causal mask (no bias materialization), chunks entirely
+        below the diagonal are unmasked, chunks above contribute nothing
+        (no flash call at all)."""
+        if not causal:
+            return flash_attention_with_lse(
+                q, k_t, v_t, causal=False, scale=scale, use_pallas=use_pallas)
+
+        def diag(_):
+            return flash_attention_with_lse(
+                q, k_t, v_t, causal=True, scale=scale, use_pallas=use_pallas)
+
+        def below(_):
+            return flash_attention_with_lse(
+                q, k_t, v_t, causal=False, scale=scale, use_pallas=use_pallas)
+
+        def above(_):  # fully masked — skip the compute entirely
+            return (jnp.zeros(q.shape, q.dtype),
+                    jnp.full(q.shape[:-1], _NEG, jnp.float32))
+
+        idx = jnp.where(src == r, 0, jnp.where(src < r, 1, 2))
+        return lax.switch(idx, [diag, below, above], None)
+
+    # hop 0 is the LOCAL (diagonal) chunk — no communication
+    o0, lse0 = attend(k, v, r)
+    o0 = o0.astype(jnp.float32)
+
+    def hop(carry, t):
+        k_t, v_t, o_acc, lse_acc = carry
+        # rotate FIRST: c-1 ppermutes total, none wasted
+        k_n = lax.ppermute(k_t, axis, perm)
+        v_n = lax.ppermute(v_t, axis, perm)
+        src = (r - t) % c  # global KV chunk index after t rotations
+        o_t, lse_t = attend(k_n, v_n, src)
+        o_m, lse_m = _merge(o_acc, lse_acc, o_t.astype(jnp.float32), lse_t)
+        return (k_n, v_n, o_m, lse_m), None
+
+    if c > 1:
+        (_, _, o, _), _ = lax.scan(
+            hop, (k, v, o0, lse0), jnp.arange(1, c)
+        )
+    else:
+        o = o0
+    return o.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis: str, *, causal: bool = False,
+                      scale: float | None = None,
+                      use_pallas: bool | None = None):
+    """All-to-all context parallelism (DeepSpeed-Ulysses style).
+
+    q, k, v: [b, h, s_local, d] inside ``shard_map`` with the sequence
+    sharded over ``axis``; h must be divisible by the axis size. Re-shards
+    to [b, h_local, s_global, d], runs normal (flash) attention, and
+    re-shards back. Exact for causal and bidirectional.
+    """
+    c = lax.axis_size(axis)
+    assert q.shape[1] % c == 0, (
+        f"heads {q.shape[1]} not divisible by context axis size {c}")
+
+    def to_seq(x):  # [b, h, s_loc, d] -> [b, h/c, s_glob, d]
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def to_heads(x):  # [b, h/c, s_glob, d] -> [b, h, s_loc, d]
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    o = flash_attention(
+        to_seq(q), to_seq(k), to_seq(v), causal=causal, scale=scale,
+        use_pallas=use_pallas,
+    )
+    return to_heads(o)

@@ -75,31 +75,46 @@ def main():
     import dataclasses
     opt_local = dataclasses.replace(opt, master_source=None)
 
-    def step_body(params, tokens):
+    # Optimizer state (fp32 masters + Adam moments) is built from the LOCAL
+    # param shards, so it must live INSIDE shard_map. Running the whole
+    # measured loop as one lax.scan keeps the state threaded step to step
+    # (moments/scaler accumulate) without shipping its sharded pytree
+    # across the shard_map boundary.
+    def run_body(params, token_batches):
         state = opt_local.init(params)
 
-        def loss_fn(p):
-            return amp.scale_loss(model_fn(p, tokens), state)
+        def one_step(carry, tokens):
+            params, state = carry
 
-        grads = jax.grad(loss_fn)(params)
-        grads = sp_grad_sync(grads, cfg)
-        new_params, _ = opt_local.apply_gradients(grads, state, params)
-        return new_params
+            def loss_fn(p):
+                loss = model_fn(p, tokens)
+                return amp.scale_loss(loss, state), loss
 
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.seq_len),
-                                0, cfg.vocab_size)
+            grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+            grads = sp_grad_sync(grads, cfg)
+            new_params, new_state = opt_local.apply_gradients(
+                grads, state, params)
+            return (new_params, new_state), loss
+
+        (params, state), losses = jax.lax.scan(
+            one_step, (params, state), token_batches)
+        return params, losses
+
+    token_batches = jax.random.randint(
+        jax.random.PRNGKey(1), (args.iters, batch, cfg.seq_len), 0,
+        cfg.vocab_size)
     specs = param_specs(cfg)
-    step = jax.jit(smap(step_body, mesh, (specs, P()), specs))
+    run = jax.jit(smap(run_body, mesh, (specs, P()), (specs, P())))
 
-    compiled = step.lower(params, tokens).compile()
-    params = compiled(params, tokens)
-    jax.block_until_ready(jax.tree.leaves(params)[0])
+    compiled = run.lower(params, token_batches).compile()
+    p1, losses = compiled(params, token_batches)  # warmup
+    jax.block_until_ready(losses)
     t0 = time.perf_counter()
-    for _ in range(args.iters):
-        params = compiled(params, tokens)
-    jax.block_until_ready(jax.tree.leaves(params)[0])
+    p2, losses = compiled(params, token_batches)
+    jax.block_until_ready(losses)
     dt = (time.perf_counter() - t0) / args.iters
     toks = batch * cfg.seq_len / dt
+    del p1, p2
 
     if args.bench:
         print(json.dumps({

@@ -60,10 +60,12 @@ def main():
     @jax.jit
     def step(params, state, xb, yb):
         def loss_fn(p):
-            return amp.scale_loss(model_fn(p, xb, yb), state)
-        grads = jax.grad(loss_fn)(params)
+            loss = model_fn(p, xb, yb)
+            return amp.scale_loss(loss, state), loss
+
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
         new_p, new_s = opt.apply_gradients(grads, state, params)
-        return new_p, new_s, model_fn(params, xb, yb)
+        return new_p, new_s, loss
 
     losses = []
     t0 = time.perf_counter()

@@ -118,9 +118,12 @@ def main():
         cl = focal_loss(cls_o.reshape(-1, NUM_CLASSES), cls_t.reshape(-1),
                         npos, num_real_classes=NUM_CLASSES)
         pos = (cls_t.reshape(-1) >= 0)[..., None]
-        bl = jnp.sum(jnp.where(
-            pos, jnp.abs(box_o.reshape(-1, 4).astype(jnp.float32)
-                         - box_t.reshape(-1, 4)), 0.0)) / npos
+        diff = jnp.abs(box_o.reshape(-1, 4).astype(jnp.float32)
+                       - box_t.reshape(-1, 4))
+        beta = 1.0 / 9.0  # smooth-L1 (Huber) knee, the RetinaNet setting
+        huber = jnp.where(diff < beta, 0.5 * diff * diff / beta,
+                          diff - 0.5 * beta)
+        bl = jnp.sum(jnp.where(pos, huber, 0.0)) / npos
         return cl + 0.5 * bl
 
     model_fn, params, opt = amp.initialize(

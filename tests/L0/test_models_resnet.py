@@ -36,9 +36,16 @@ def test_resnet_feature_pyramid():
 def test_eval_mode_uses_running_stats():
     p, s = resnet_init(jax.random.PRNGKey(0), stages=TINY, num_classes=3)
     x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16, 3))
-    logits1, ns = resnet_apply(p, s, x, stages=TINY, norm="bn", training=False)
+    logits_init, ns = resnet_apply(p, s, x, stages=TINY, norm="bn",
+                                   training=False)
     # eval must not touch state
     jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b), ns, s)
+    # eval must READ the running stats: after a training step updates them,
+    # eval logits with the new state must differ from eval with the old
+    _, trained = resnet_apply(p, s, x, stages=TINY, norm="bn", training=True)
+    logits_after, _ = resnet_apply(p, trained, x, stages=TINY, norm="bn",
+                                   training=False)
+    assert not np.allclose(np.asarray(logits_init), np.asarray(logits_after))
 
 
 def test_syncbn_matches_full_batch_bn(eight_cpu_devices):
