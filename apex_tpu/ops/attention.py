@@ -30,6 +30,7 @@ Semantics notes:
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -219,7 +220,97 @@ def _fwd_pallas(q, k, v, bias, causal, scale):
 
 # ---------------------------------------------------------------------------
 # Pallas backward
+#
+# Two strategies:
+#   fused (default): ONE kernel, grid over KV blocks; per step it walks the
+#     q blocks once, producing dk/dv for its KV block and accumulating dq
+#     into an output block revisited across the sequential grid. The score
+#     and dp matmuls are computed once per (q, kv) block pair — 5 matmuls
+#     vs the split path's 7 (which recomputes s and dp in both kernels).
+#   split (APEX_TPU_FLASH_SPLIT_BWD=1): the classic dq-kernel + dkv-kernel
+#     pair; kept as the fallback/debug variant.
 # ---------------------------------------------------------------------------
+
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref, *rest,
+                      causal, offset, scale, block_q, sq):
+    if len(rest) == 4:
+        bias_ref, dq_ref, dk_ref, dv_ref = rest
+    else:
+        bias_ref, (dq_ref, dk_ref, dv_ref) = None, rest
+    kb = k_ref[0].astype(jnp.float32)                 # [bk, d]
+    vb = v_ref[0].astype(jnp.float32)
+    bk, d = kb.shape
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():  # dq accumulates across the sequential KV grid
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    nq = sq // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(i * block_q, block_q)].astype(jnp.float32)
+        do = do_ref[0, pl.dslice(i * block_q, block_q)].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(i * block_q, block_q)]      # [bq, 1]
+        delta = delta_ref[0, pl.dslice(i * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if bias_ref is not None:
+            if bias_ref.shape[1] == 1:                # query-broadcast bias
+                s = s + bias_ref[0].astype(jnp.float32)
+            else:
+                s = s + bias_ref[0, pl.dslice(i * block_q, block_q)].astype(
+                    jnp.float32
+                )
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0
+            )
+            cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+            s = jnp.where(cols <= rows + offset, s, _NEG_INF)
+        p = jnp.where(s > _VALID_THRESHOLD, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # scale folded into ds: dq and dk are both linear in ds
+        ds = p * (dp - delta) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dq_i = jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        cur = dq_ref[0, pl.dslice(i * block_q, block_q)]
+        dq_ref[0, pl.dslice(i * block_q, block_q)] = cur + dq_i.astype(
+            dq_ref.dtype
+        )
+        return dk, dv
+
+    if causal:
+        # q blocks strictly above this KV block's diagonal see nothing
+        i0 = jnp.clip((ki * bk - offset) // block_q, 0, nq)
+        dk, dv = jax.lax.fori_loop(
+            i0, nq, body,
+            (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)),
+        )
+    else:
+        dk, dv = jax.lax.fori_loop(
+            0, nq, body,
+            (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)),
+        )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref, *rest,
                    causal, offset, scale, block_k, sk):
@@ -325,7 +416,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref, *rest,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
+def _bwd_prologue(q, k, v, bias, o, lse, do, dlse):
+    """Shared backward setup for both Pallas strategies: pad the operands,
+    fold the (optional) lse cotangent into delta (ds = p*(dp - delta + dlse)
+    because d(lse_i)/d(s_ij) = p_ij), neutralize padded q rows with an
+    lse = 1e30 sentinel (p underflows to exactly 0), and synthesize the
+    padded-K-column mask bias."""
     b, sq, d = q.shape
     sk = k.shape[1]
     bq = _block_size(sq)
@@ -335,22 +431,76 @@ def _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
     vp = _pad_seq(v, bk, 1)
     dop = _pad_seq(do, bq, 1)
     sqp, skp = qp.shape[1], kp.shape[1]
-    # delta = rowsum(do * o), carried as [b, sq, 1] for 2-D kernel loads.
-    # An lse cotangent folds in exactly here: ds = p*(dp - delta + dlse)
-    # because d(lse_i)/d(s_ij) = p_ij — so delta -= dlse and the kernels
-    # need no changes (used by flash_attention_with_lse / ring attention).
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
     )
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)[..., None]
     deltap = _pad_seq(delta, bq, 1)
-    # padded q rows: lse would be 0 -> p = exp(0-0)=1 garbage; set lse huge
     lsep = _pad_seq(lse[..., None], bq, 1)
     if sqp != sq:
         pad_rows = jnp.arange(sqp) >= sq
         lsep = jnp.where(pad_rows[None, :, None], 1e30, lsep)
     bias_p, broadcast_q = _prep_bias(bias, b, sq, sk, bq, bk, sqp, skp)
+    return (qp, kp, vp, dop, lsep, deltap, bias_p, broadcast_q,
+            (b, sq, sk, d, bq, bk, sqp, skp))
+
+
+def _bwd_fused_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
+    (qp, kp, vp, dop, lsep, deltap, bias_p, broadcast_q, dims) = \
+        _bwd_prologue(q, k, v, bias, o, lse, do, dlse)
+    b, sq, sk, d, bq, bk, sqp, skp = dims
+
+    common = [qp, kp, vp, lsep, dop, deltap]
+    specs = [
+        pl.BlockSpec((1, sqp, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, sqp, 1), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, sqp, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, sqp, 1), lambda i, j: (i, 0, 0)),
+    ]
+    if bias_p is not None:
+        common.append(bias_p)
+        if broadcast_q:
+            specs.append(pl.BlockSpec((1, 1, bk), lambda i, j: (i, 0, j)))
+        else:
+            specs.append(pl.BlockSpec((1, sqp, bk), lambda i, j: (i, 0, j)))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_fused_kernel, causal=causal, offset=sk - sq, scale=scale,
+            block_q=bq, sq=sqp,
+        ),
+        grid=(b, skp // bk),
+        in_specs=specs,
+        out_specs=[
+            # dq is revisited (accumulated) across the sequential KV grid;
+            # fp32 so the accumulation doesn't round in bf16
+            pl.BlockSpec((1, sqp, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sqp, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, skp, d), k.dtype),
+            jax.ShapeDtypeStruct((b, skp, d), v.dtype),
+        ],
+        interpret=pallas_interpret(),
+    )(*common)
+    return (dq[:, :sq].astype(q.dtype), dk[:, :sk], dv[:, :sk])
+
+
+def _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
+    if os.environ.get("APEX_TPU_FLASH_SPLIT_BWD") != "1":
+        return _bwd_fused_pallas(q, k, v, bias, causal, scale, o, lse, do,
+                                 dlse)
+    return _bwd_split_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse)
+
+
+def _bwd_split_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
+    (qp, kp, vp, dop, lsep, deltap, bias_p, broadcast_q, dims) = \
+        _bwd_prologue(q, k, v, bias, o, lse, do, dlse)
+    b, sq, sk, d, bq, bk, sqp, skp = dims
 
     common = [qp, kp, vp, lsep, dop, deltap]
     if bias_p is not None:
@@ -524,13 +674,21 @@ def _flash_core_lse_bwd(causal, scale, use_pallas, res, cts):
     do, dlse = cts
     q, k, v, bias, o, lse = res
     use = default_use_pallas("flash_attention") if use_pallas is None else use_pallas
+    ds = None
     if use:
         dq, dk, dv = _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do,
                                  dlse)
     else:
-        dq, dk, dv, _ = _bwd_ref(q, k, v, bias, causal, scale, o, lse, do,
-                                 dlse)
-    dbias = None if bias is None else jnp.zeros_like(bias)
+        dq, dk, dv, ds = _bwd_ref(q, k, v, bias, causal, scale, o, lse, do,
+                                  dlse)
+    dbias = None
+    if bias is not None:
+        # real bias gradients (incl. the dlse contribution via _bwd_pieces)
+        # so learned biases (ALiBi, relative-position) train correctly here
+        if ds is None:  # pallas path: one unfused pass just for dbias
+            _, ds, _ = _bwd_pieces(q, k, v, bias, causal, scale, o, lse, do,
+                                   dlse)
+        dbias = _dbias_from_ds(ds, bias)
     return dq, dk, dv, dbias
 
 
@@ -557,7 +715,8 @@ def _flatten_qkv(q, k, v, bias):
 def flash_attention_with_lse(q, k, v, *, bias=None, causal=False, scale=None,
                              use_pallas=None):
     """flash_attention that also returns the per-row logsumexp ([..., sq],
-    fully differentiable). ``bias`` here is mask-like (no dbias). Used by
+    fully differentiable). ``bias`` is additive [..., sq|1, sk] and carries
+    real gradients (incl. the lse contribution). Used by
     transformer.context_parallel for ring attention."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -595,9 +754,7 @@ def flash_attention(
     """
     if q.ndim < 3:
         raise ValueError("flash_attention expects [..., seq, head_dim]")
-    lead = q.shape[:-2]
     sq, d = q.shape[-2:]
-    sk = k.shape[-2]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
 

@@ -163,6 +163,28 @@ def test_l2norm_flat_compiled(dtype):
     assert abs(nrm - ref) / ref < 1e-5
 
 
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_flash_lse_gradients_compiled(dtype):
+    """flash_attention_with_lse's dlse fold (ring attention's primitive)
+    must be exact through the COMPILED Pallas backward."""
+    from apex_tpu.ops.attention import flash_attention_with_lse
+
+    b, h, s, d = 1, 4, 512, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, s, d), dtype)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, h, s, d), dtype)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, h, s, d), dtype)
+    w = jax.random.normal(jax.random.PRNGKey(3), (b, h, s), jnp.float32)
+
+    def f(q, k, v, use):
+        o, lse = flash_attention_with_lse(q, k, v, use_pallas=use)
+        return jnp.vdot(lse, w) + jnp.sum(o.astype(jnp.float32) ** 2)
+
+    gp = jax.jit(jax.grad(lambda q, k, v: f(q, k, v, True), argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(lambda q, k, v: f(q, k, v, False), argnums=(0, 1, 2)))(q, k, v)
+    for a, c in zip(gp, gr):
+        assert _md(a, c) < 0.05
+
+
 def test_preflight_all_green():
     """On hardware every family must pass its probe; this is the regression
     gate for 'a kernel that lowers today keeps lowering tomorrow'."""
