@@ -654,23 +654,24 @@ def _flash_core_bwd(causal, scale, use_pallas, need_dbias, res, do):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_core_lse(q, k, v, bias, causal, scale, use_pallas):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_core_lse(q, k, v, bias, causal, scale, use_pallas, need_dbias):
     """Like _flash_core but returns (o, lse) with lse DIFFERENTIABLE —
     the building block for ring/context-parallel attention, whose partial-
     result merge needs per-chunk logsumexps and their exact gradients."""
     (o, lse), _ = _flash_core_lse_fwd(q, k, v, bias, causal, scale,
-                                      use_pallas)
+                                      use_pallas, need_dbias)
     return o, lse
 
 
-def _flash_core_lse_fwd(q, k, v, bias, causal, scale, use_pallas):
+def _flash_core_lse_fwd(q, k, v, bias, causal, scale, use_pallas,
+                        need_dbias):
     o, (q, k, v, bias, o, lse) = _flash_core_fwd(
         q, k, v, bias, causal, scale, use_pallas, need_dbias=False)
     return (o, lse), (q, k, v, bias, o, lse)
 
 
-def _flash_core_lse_bwd(causal, scale, use_pallas, res, cts):
+def _flash_core_lse_bwd(causal, scale, use_pallas, need_dbias, res, cts):
     do, dlse = cts
     q, k, v, bias, o, lse = res
     use = default_use_pallas("flash_attention") if use_pallas is None else use_pallas
@@ -683,12 +684,16 @@ def _flash_core_lse_bwd(causal, scale, use_pallas, res, cts):
                                   dlse)
     dbias = None
     if bias is not None:
-        # real bias gradients (incl. the dlse contribution via _bwd_pieces)
-        # so learned biases (ALiBi, relative-position) train correctly here
-        if ds is None:  # pallas path: one unfused pass just for dbias
-            _, ds, _ = _bwd_pieces(q, k, v, bias, causal, scale, o, lse, do,
-                                   dlse)
-        dbias = _dbias_from_ds(ds, bias)
+        if need_dbias:
+            # real bias gradients (incl. the dlse contribution via
+            # _bwd_pieces) so learned biases (ALiBi, relative-position)
+            # train correctly here
+            if ds is None:  # pallas path: one unfused pass just for dbias
+                _, ds, _ = _bwd_pieces(q, k, v, bias, causal, scale, o, lse,
+                                       do, dlse)
+            dbias = _dbias_from_ds(ds, bias)
+        else:  # mask-like bias: no O(sq*sk) materialization in backward
+            dbias = jnp.zeros_like(bias)
     return dq, dk, dv, dbias
 
 
@@ -712,16 +717,25 @@ def _flatten_qkv(q, k, v, bias):
     return lead, q3, k3, v3, bias3
 
 
-def flash_attention_with_lse(q, k, v, *, bias=None, causal=False, scale=None,
-                             use_pallas=None):
+def flash_attention_with_lse(q, k, v, *, bias=None, mask=None, causal=False,
+                             scale=None, use_pallas=None):
     """flash_attention that also returns the per-row logsumexp ([..., sq],
     fully differentiable). ``bias`` is additive [..., sq|1, sk] and carries
-    real gradients (incl. the lse contribution). Used by
+    real gradients (incl. the lse contribution); ``mask`` (True = MASKED,
+    the reference convention) folds to additive -inf WITHOUT a dense
+    backward pass — use it, not bias, for padding masks. Used by
     transformer.context_parallel for ring attention."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    need_dbias = bias is not None
+    if mask is not None:
+        mbias = jnp.where(jnp.asarray(mask, bool), _NEG_INF, 0.0).astype(
+            jnp.float32
+        )
+        bias = mbias if bias is None else bias.astype(jnp.float32) + mbias
     lead, q3, k3, v3, bias3 = _flatten_qkv(q, k, v, bias)
-    o, lse = _flash_core_lse(q3, k3, v3, bias3, causal, scale, use_pallas)
+    o, lse = _flash_core_lse(q3, k3, v3, bias3, causal, scale, use_pallas,
+                             need_dbias)
     sq, d = q.shape[-2:]
     return o.reshape(lead + (sq, d)), lse.reshape(lead + (sq,))
 

@@ -60,10 +60,36 @@ class TransformerConfig:
     dropout_p: float = 0.0
     dtype: object = jnp.float32
     model_axis: str = "model"
+    context_axis: object = None    # name of a mesh axis sharding the
+                                   # SEQUENCE across chips (ring-attention
+                                   # context parallelism). tokens/labels are
+                                   # then the LOCAL s/cp chunk; params are
+                                   # replicated over the axis, so grads need
+                                   # a pmean over it (like a data axis).
+                                   # Mutually exclusive with
+                                   # sequence_parallel; dropout must be 0.
     remat: bool = False            # activation checkpointing per block
+    remat_policy: str = "full"     # "full" = save only block boundaries;
+                                   # "dots" = also save matmul outputs
+                                   # (jax dots_with_no_batch_dims_saveable:
+                                   # ~no recompute of MXU work in backward,
+                                   # more activation memory) — only read
+                                   # when remat=True
     scan_layers: bool = False      # lax.scan over stacked layer params
                                    # (compile time O(1) in depth; pass
                                    # params through stack_layer_params)
+
+    def __post_init__(self):
+        assert self.remat_policy in ("full", "dots", "none"), (
+            f"unknown remat_policy {self.remat_policy!r}"
+        )
+        if self.context_axis is not None:
+            assert not self.sequence_parallel, (
+                "context_axis and sequence_parallel both shard the sequence"
+            )
+            assert self.dropout_p == 0.0, (
+                "context parallelism does not thread per-chunk dropout keys"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -158,7 +184,12 @@ def _attention(lp, x, cfg: TransformerConfig, dropout_key):
     qkv = qkv.reshape(s, b, n_local, 3, cfg.head_dim)
     # [s, b, nh, 3, d] -> 3 x [b, nh, s, d]
     q, k, v = (qkv[:, :, :, i].transpose(1, 2, 0, 3) for i in range(3))
-    o = flash_attention(q, k, v, causal=cfg.causal)
+    if cfg.context_axis is not None:
+        from apex_tpu.transformer.context_parallel import ring_attention
+
+        o = ring_attention(q, k, v, cfg.context_axis, causal=cfg.causal)
+    else:
+        o = flash_attention(q, k, v, causal=cfg.causal)
     o = o.transpose(2, 0, 1, 3).reshape(s, b, n_local * cfg.head_dim)
     o = row_parallel_linear(
         o, lp["proj"]["kernel"], lp["proj"]["bias"], axis=ax,
@@ -213,9 +244,18 @@ def transformer_forward(params, tokens, cfg: TransformerConfig, *,
         x = (x + pos[:, None, :]).astype(cfg.dtype)
     else:
         emb = vocab_parallel_embedding(tokens, params["embedding"], axis=ax)
-        x = (emb + params["pos_embedding"][None, : tokens.shape[1]]).astype(
-            cfg.dtype
-        )
+        if cfg.context_axis is not None:
+            # tokens are the LOCAL seq chunk: positions are globally offset
+            s_local = tokens.shape[1]
+            pos = jax.lax.dynamic_slice_in_dim(
+                params["pos_embedding"],
+                jax.lax.axis_index(cfg.context_axis) * s_local, s_local, 0,
+            )
+            x = (emb + pos[None]).astype(cfg.dtype)
+        else:
+            x = (emb + params["pos_embedding"][None, : tokens.shape[1]]).astype(
+                cfg.dtype
+            )
         x = x.transpose(1, 0, 2)          # [s, b, h] (Megatron layout)
     # Output dropout follows the reference's RNG discipline: the outputs of
     # row-parallel layers are TP-REPLICATED when SP is off, so their dropout
@@ -238,7 +278,13 @@ def transformer_forward(params, tokens, cfg: TransformerConfig, *,
         return x
 
     if cfg.remat:
-        block = jax.checkpoint(block)
+        if cfg.remat_policy == "dots":
+            block = jax.checkpoint(
+                block,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        else:
+            block = jax.checkpoint(block)
     if cfg.scan_layers:
         x, _ = jax.lax.scan(
             lambda carry, li: (block(carry, li[0], li[1]), None),
@@ -272,8 +318,33 @@ def transformer_forward(params, tokens, cfg: TransformerConfig, *,
 
 def gpt_loss(params, tokens, cfg: TransformerConfig, *, seed: int = 1234):
     """Next-token LM loss, mean over (s-1)*b tokens (shard_map-local; mean
-    over the data axis is the caller's psum)."""
+    over the data axis is the caller's psum).
+
+    Under context parallelism the target of a chunk's LAST token is the
+    FIRST token of the next rank's chunk — fetched with one tiny ppermute —
+    and the global final position is excluded; sum and count psum over the
+    context axis so the mean matches the unsharded loss exactly."""
     logits = transformer_forward(params, tokens, cfg, seed=seed)
+    if cfg.context_axis is not None:
+        axc = cfg.context_axis
+        c = jax.lax.axis_size(axc)
+        r = jax.lax.axis_index(axc)
+        s_local, b = tokens.shape[1], tokens.shape[0]
+        nxt = jax.lax.ppermute(
+            tokens[:, :1], axc, [((i + 1) % c, i) for i in range(c)]
+        )                                            # next chunk's first token
+        targets = jnp.concatenate([tokens[:, 1:], nxt], axis=1).transpose(1, 0)
+        losses = vocab_parallel_cross_entropy(
+            logits, targets, axis=cfg.model_axis
+        )                                            # [s_local, b]
+        valid = jnp.where(
+            r == c - 1,
+            jnp.arange(s_local) < s_local - 1,
+            jnp.ones((s_local,), bool),
+        ).astype(jnp.float32)
+        total = jax.lax.psum((losses * valid[:, None]).sum(), axc)
+        count = jax.lax.psum(valid.sum() * b, axc)
+        return total / count
     targets = tokens[:, 1:].transpose(1, 0)          # [s-1, b]
     losses = vocab_parallel_cross_entropy(
         logits[:-1], targets, axis=cfg.model_axis

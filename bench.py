@@ -221,9 +221,13 @@ def main():
         batches = [4]
     else:
         # BERT-large: 24 x 1024 x 16 heads, seq 512, vocab 30528 (padded)
+        # default stays on the measured-good config; flip after
+        # bench_step_variants.py proves a better remat policy on hardware
+        remat_mode = os.environ.get("BENCH_REMAT", "full")
         cfg = TransformerConfig(
             vocab_size=30528, seq_len=512, hidden=1024, layers=24, heads=16,
-            causal=False, dtype=jnp.bfloat16, scan_layers=True, remat=True,
+            causal=False, dtype=jnp.bfloat16, scan_layers=True,
+            remat=remat_mode != "none", remat_policy=remat_mode,
         )
         batches = [int(b) for b in os.environ.get(
             "BENCH_BATCHES", "32,64,96,128").split(",")]

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def build_step(batch, remat, cfg_over=None):
+def build_step(batch, remat, remat_policy="full", cfg_over=None):
     from apex_tpu import amp
     from apex_tpu.optimizers import fused_lamb
     from apex_tpu.testing import (
@@ -27,7 +27,7 @@ def build_step(batch, remat, cfg_over=None):
     cfg = TransformerConfig(
         vocab_size=30528, seq_len=512, hidden=1024, layers=24, heads=16,
         causal=False, dtype=jnp.bfloat16, scan_layers=True, remat=remat,
-        **(cfg_over or {}))
+        remat_policy=remat_policy, **(cfg_over or {}))
     params = stack_layer_params(transformer_init(jax.random.PRNGKey(0), cfg))
 
     def model_fn(p, tokens, labels, mask):
@@ -73,25 +73,36 @@ def main():
     which = sys.argv[2:] or ["pallas", "no_ln", "no_flash", "no_pallas"]
     print(f"device={jax.devices()[0]} batch={batch}", flush=True)
 
+    # (kernel families to disable, remat mode)
     variants = {
-        "pallas": [],
-        "no_ln": ["layer_norm", "rms_norm"],
-        "no_flash": ["flash_attention"],
-        "no_pallas": ["layer_norm", "rms_norm", "flash_attention", "optim_flat"],
+        "pallas": ([], "full"),
+        "pallas_dots": ([], "dots"),
+        "pallas_noremat": ([], "none"),
+        "no_ln": (["layer_norm", "rms_norm"], "full"),
+        "no_flash": (["flash_attention"], "full"),
+        "no_flash_dots": (["flash_attention"], "dots"),
+        "no_pallas": (["layer_norm", "rms_norm", "flash_attention",
+                       "optim_flat"], "full"),
+        "split_bwd": ([], "full"),  # + APEX_TPU_FLASH_SPLIT_BWD=1 env
     }
     for name in which:
-        disable = variants[name]
+        disable, remat_mode = variants[name]
         for k in ("layer_norm", "rms_norm", "flash_attention", "optim_flat"):
             _utils.enable_kernel(k)
         for k in disable:
             _utils.disable_kernel(k)
+        import os as _os
+        _os.environ.pop("APEX_TPU_FLASH_SPLIT_BWD", None)
+        if name == "split_bwd":
+            _os.environ["APEX_TPU_FLASH_SPLIT_BWD"] = "1"
         try:
-            step, args = build_step(batch, remat=True)
+            step, args = build_step(batch, remat=remat_mode != "none",
+                                    remat_policy=remat_mode)
             ms = run(step, args)
-            print(f"{name:10s} remat=full : {ms:8.1f} ms/step  "
+            print(f"{name:14s} remat={remat_mode:5s}: {ms:8.1f} ms/step  "
                   f"{batch/ms*1e3:6.1f} samples/s", flush=True)
         except Exception as e:
-            print(f"{name:10s} FAILED: {str(e)[:160]}", flush=True)
+            print(f"{name:14s} FAILED: {str(e)[:160]}", flush=True)
 
 
 if __name__ == "__main__":

@@ -179,3 +179,56 @@ def test_key_mask_stays_compact_no_dense_bias():
     finally:
         A._fwd_pallas = orig
     assert captured["bias_shape"] == (4, 1, 256), captured
+
+
+def test_split_bwd_fallback_matches_fused(monkeypatch):
+    """APEX_TPU_FLASH_SPLIT_BWD=1 selects the two-kernel backward; it must
+    stay numerically identical to the fused default. NOTE: the flag is
+    read at trace time — it has no effect on already-jitted functions."""
+    monkeypatch.setenv("APEX_TPU_USE_PALLAS", "1")
+    monkeypatch.setenv("APEX_TPU_PALLAS_INTERPRET", "1")
+    q, k, v = _make_qkv(1, 2, 128, 128, 32, jnp.float32)
+    do = jax.random.normal(jax.random.PRNGKey(9), q.shape, q.dtype)
+
+    def loss(q, k, v):
+        return jnp.vdot(flash_attention(q, k, v, causal=True,
+                                        use_pallas=True), do)
+
+    monkeypatch.delenv("APEX_TPU_FLASH_SPLIT_BWD", raising=False)
+    g_fused = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setenv("APEX_TPU_FLASH_SPLIT_BWD", "1")
+    g_split = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fused, g_split):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_with_lse_mask_stays_compact_in_backward():
+    """A padding mask passed as ``mask`` to flash_attention_with_lse must
+    not trigger the dense dbias pass (need_dbias stays False)."""
+    from apex_tpu.ops import attention as A
+    from apex_tpu.ops.attention import flash_attention_with_lse
+
+    called = {"pieces": 0}
+    orig = A._bwd_pieces
+
+    def spy(*args, **kw):
+        called["pieces"] += 1
+        return orig(*args, **kw)
+
+    A._bwd_pieces = spy
+    try:
+        q, k, v = _make_qkv(1, 2, 64, 64, 32, jnp.float32)
+        mask = jnp.zeros((1, 2, 1, 64), bool).at[..., 50:].set(True)
+        do = jax.random.normal(jax.random.PRNGKey(9), q.shape, q.dtype)
+
+        def loss(q, k, v):
+            o, lse = flash_attention_with_lse(q, k, v, mask=mask,
+                                              use_pallas=True)
+            return jnp.vdot(o, do) + jnp.sum(lse)
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        jax.block_until_ready(g[0])
+    finally:
+        A._bwd_pieces = orig
+    assert called["pieces"] == 0, called

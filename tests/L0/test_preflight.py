@@ -1,0 +1,75 @@
+"""apex_tpu.preflight: probe reports, fallback pinning, registry hygiene."""
+
+import jax
+import jax.numpy as jnp
+
+import apex_tpu
+from apex_tpu._preflight import PROBES
+from apex_tpu.ops import _utils
+
+
+def setup_function(_):
+    for k in list(_utils.disabled_kernels()):
+        _utils.enable_kernel(k)
+
+
+def test_all_families_green_on_this_platform():
+    report = apex_tpu.preflight(verbose=False)
+    assert set(report) == set(PROBES)
+    for name, r in report.items():
+        assert r["ok"], (name, r)
+        assert r["error"] is None
+        assert r["ms"] > 0
+
+
+def test_failure_pins_fallback_and_op_still_works():
+    orig = PROBES["rms_norm"]
+
+    def bad():
+        raise ValueError("simulated Mosaic lowering failure")
+
+    PROBES["rms_norm"] = bad
+    try:
+        r = apex_tpu.preflight(kernels=["rms_norm"], verbose=False)
+        assert r["rms_norm"]["ok"] is False
+        assert "simulated" in r["rms_norm"]["error"]
+        assert _utils.kernel_disabled("rms_norm")
+        assert _utils.default_use_pallas("rms_norm") is False
+        # the op transparently takes the jnp path
+        from apex_tpu.ops.layer_norm import rms_norm_affine
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 64, 128), jnp.bfloat16)
+        y = jax.jit(lambda x: rms_norm_affine(x, jnp.ones((128,))))(x)
+        assert bool(jnp.all(jnp.isfinite(y.astype(jnp.float32))))
+    finally:
+        PROBES["rms_norm"] = orig
+        _utils.enable_kernel("rms_norm")
+
+
+def test_reprobe_after_fix_reenables():
+    _utils.disable_kernel("layer_norm")
+    r = apex_tpu.preflight(kernels=["layer_norm"], verbose=False)
+    assert r["layer_norm"]["ok"]
+    assert not _utils.kernel_disabled("layer_norm")
+
+
+def test_unknown_family_reported_not_raised():
+    r = apex_tpu.preflight(kernels=["layernorm"], verbose=False)
+    assert r["layernorm"]["ok"] is False
+    assert "unknown" in r["layernorm"]["error"]
+    assert not _utils.kernel_disabled("layernorm")
+
+
+def test_explicit_use_pallas_overrides_registry():
+    _utils.disable_kernel("layer_norm")
+    try:
+        from apex_tpu.ops.layer_norm import layer_norm_affine
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 64, 128), jnp.float32)
+        g = jnp.ones((128,), jnp.float32)
+        b = jnp.zeros((128,), jnp.float32)
+        y_forced = layer_norm_affine(x, g, b, 1e-5, True)   # force kernel
+        y_fallback = layer_norm_affine(x, g, b, 1e-5, None)  # registry: jnp
+        assert float(jnp.max(jnp.abs(y_forced - y_fallback))) < 1e-5
+    finally:
+        _utils.enable_kernel("layer_norm")
