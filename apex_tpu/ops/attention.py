@@ -700,6 +700,18 @@ def _flash_core_lse_bwd(causal, scale, use_pallas, need_dbias, res, cts):
 _flash_core_lse.defvjp(_flash_core_lse_fwd, _flash_core_lse_bwd)
 
 
+def _fold_mask(bias, mask):
+    """Fold a boolean mask (True = MASKED, the reference convention) into
+    the additive bias; only a caller-supplied bias wants gradients."""
+    need_dbias = bias is not None
+    if mask is not None:
+        mbias = jnp.where(jnp.asarray(mask, bool), _NEG_INF, 0.0).astype(
+            jnp.float32
+        )
+        bias = mbias if bias is None else bias.astype(jnp.float32) + mbias
+    return bias, need_dbias
+
+
 def _flatten_qkv(q, k, v, bias):
     """Shared prologue: [..., s, d] -> [B, s, d] 3-D views plus the compact
     bias broadcast ([B, 1, sk] when query-invariant)."""
@@ -727,12 +739,7 @@ def flash_attention_with_lse(q, k, v, *, bias=None, mask=None, causal=False,
     transformer.context_parallel for ring attention."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    need_dbias = bias is not None
-    if mask is not None:
-        mbias = jnp.where(jnp.asarray(mask, bool), _NEG_INF, 0.0).astype(
-            jnp.float32
-        )
-        bias = mbias if bias is None else bias.astype(jnp.float32) + mbias
+    bias, need_dbias = _fold_mask(bias, mask)
     lead, q3, k3, v3, bias3 = _flatten_qkv(q, k, v, bias)
     o, lse = _flash_core_lse(q3, k3, v3, bias3, causal, scale, use_pallas,
                              need_dbias)
@@ -772,13 +779,7 @@ def flash_attention(
     if scale is None:
         scale = 1.0 / (d ** 0.5)
 
-    need_dbias = bias is not None
-    if mask is not None:
-        mbias = jnp.where(jnp.asarray(mask, bool), _NEG_INF, 0.0).astype(
-            jnp.float32
-        )
-        bias = mbias if bias is None else bias.astype(jnp.float32) + mbias
-
+    bias, need_dbias = _fold_mask(bias, mask)
     lead, q3, k3, v3, bias3 = _flatten_qkv(q, k, v, bias)
 
     if dropout_p > 0.0:

@@ -20,6 +20,7 @@ from apex_tpu.optimizers.stateful import (  # noqa: F401
     FusedAdagrad,
     FusedAdam,
     FusedLAMB,
+    FusedMixedPrecisionLamb,
     FusedNovoGrad,
     FusedSGD,
 )

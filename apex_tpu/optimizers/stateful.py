@@ -18,6 +18,9 @@ import optax
 from apex_tpu.optimizers.fused_adagrad import fused_adagrad
 from apex_tpu.optimizers.fused_adam import fused_adam
 from apex_tpu.optimizers.fused_lamb import fused_lamb
+from apex_tpu.optimizers.fused_mixed_precision_lamb import (
+    fused_mixed_precision_lamb as _fused_mixed_precision_lamb,
+)
 from apex_tpu.optimizers.fused_novograd import fused_novograd
 from apex_tpu.optimizers.fused_sgd import fused_sgd
 
@@ -99,4 +102,9 @@ FusedNovoGrad = _make_class(
 FusedAdagrad = _make_class(
     "FusedAdagrad", fused_adagrad,
     "Stateful Adagrad (ref: apex/optimizers/fused_adagrad.py::FusedAdagrad).",
+)
+FusedMixedPrecisionLamb = _make_class(
+    "FusedMixedPrecisionLamb", _fused_mixed_precision_lamb,
+    "Stateful mixed-precision LAMB (ref: apex/optimizers/"
+    "fused_mixed_precision_lamb.py::FusedMixedPrecisionLamb).",
 )

@@ -3,6 +3,8 @@
 
 from apex_tpu.parallel import collectives, mesh  # noqa: F401
 from apex_tpu.parallel.ddp import DistributedDataParallel  # noqa: F401
+# the reference exposes LARC under apex.parallel as well as its module
+from apex_tpu.optimizers.larc import LARC  # noqa: F401
 from apex_tpu.parallel.sync_batchnorm import sync_batch_stats  # noqa: F401
 
 try:  # flax-only pieces; DDP/collectives/mesh stay importable without flax

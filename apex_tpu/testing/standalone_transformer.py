@@ -277,7 +277,7 @@ def transformer_forward(params, tokens, cfg: TransformerConfig, *,
         )
         return x
 
-    if cfg.remat:
+    if cfg.remat and cfg.remat_policy != "none":
         if cfg.remat_policy == "dots":
             block = jax.checkpoint(
                 block,

@@ -20,6 +20,8 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
 )
 from apex_tpu.transformer.pipeline_parallel import p2p_communication
 from apex_tpu.transformer.pipeline_parallel.utils import (
+    build_model,
+    local_chunk_indices,
     setup_microbatch_calculator,
     get_num_microbatches,
     get_micro_batch_size,
@@ -29,6 +31,8 @@ from apex_tpu.transformer.pipeline_parallel.utils import (
 )
 
 __all__ = [
+    "build_model",
+    "local_chunk_indices",
     "get_forward_backward_func",
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",

@@ -110,3 +110,43 @@ def get_tensor_shapes(
     if sequence_parallel_enabled:
         seq_length = divide(seq_length, tensor_model_parallel_size)
     return (seq_length, micro_batch_size, hidden_size)
+
+
+def local_chunk_indices(stage: int, pipeline_size: int,
+                        virtual_size: int = 1) -> List[int]:
+    """Global layer-chunk ids owned by ``stage``, in local slot order —
+    the interleaved assignment (global chunk g -> stage g % pp, slot
+    g // pp) the reference's build_model uses for virtual pipelining."""
+    return [slot * pipeline_size + stage for slot in range(virtual_size)]
+
+
+def build_model(chunk_init_fn, key, pipeline_size: int,
+                virtual_size: int = 1):
+    """SPMD analog of ref pipeline_parallel/schedules::build_model.
+
+    The reference builds each rank's model chunks on that rank.  Under SPMD
+    one process builds the GLOBAL chunk stack arranged [pp, V, ...] so that
+    sharding dim 0 with ``P("stage")`` hands every stage exactly its
+    interleaved local chunks (drop the leading dim inside shard_map; drop
+    both for V == 1 with the non-interleaved schedule).
+
+    ``chunk_init_fn(key, global_chunk_idx) -> params pytree`` is the
+    model_provider; chunk g ends up at [g % pp, g // pp].
+    """
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    n = pipeline_size * virtual_size
+    keys = _jax.random.split(key, n)
+    chunks = [chunk_init_fn(keys[g], g) for g in range(n)]
+    stacked = _jax.tree.map(lambda *xs: _jnp.stack(xs), *chunks)
+    perm = _jnp.array(
+        [g for s in range(pipeline_size)
+         for g in local_chunk_indices(s, pipeline_size, virtual_size)]
+    )
+    return _jax.tree.map(
+        lambda a: a[perm].reshape(
+            (pipeline_size, virtual_size) + a.shape[1:]
+        ),
+        stacked,
+    )

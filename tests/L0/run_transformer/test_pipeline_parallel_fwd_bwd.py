@@ -272,3 +272,34 @@ def test_1f1b_memory_flat_in_microbatches():
     # 8x the microbatches must NOT cost 8x the temp memory; allow 2x slack
     # for the [M] loss bucket and scheduling bookkeeping
     assert large < 2 * small + 65536, (small, large)
+
+
+def test_build_model_layout_feeds_interleaved_schedule():
+    """build_model's [pp, V, ...] layout sharded on dim 0 must reproduce the
+    no-pipelining reference through the interleaved schedule."""
+    from apex_tpu.transformer.pipeline_parallel import build_model
+
+    pp, vp, m = 2, 2, 4
+    chunks, lp = make_params(jax.random.PRNGKey(0), pp * vp)
+    xs, ys = make_batch(jax.random.PRNGKey(1), m)
+    ref = reference_run(chunks, lp, xs, ys)
+
+    # build per-chunk params from the SAME global chunk values
+    staged = build_model(
+        lambda k, g: jax.tree.map(lambda a: a[g], chunks),
+        jax.random.PRNGKey(2), pp, vp)
+    mesh = make_mesh({"stage": pp}, devices=jax.devices("cpu")[:pp])
+
+    def body(chunks4, lp, xs, ys):
+        local = jax.tree.map(lambda a: a[0], chunks4)  # [V, ...]
+        res = forward_backward_pipelining_with_interleaving(
+            stage_fn, loss_fn, local, lp, xs, ys, axis="stage")
+        return res.losses
+
+    losses = jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P("stage"), P(), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    ))(staged, lp, xs, ys)
+    np.testing.assert_allclose(losses, ref.losses, rtol=1e-5, atol=1e-6)
