@@ -1,0 +1,68 @@
+"""Long-context single-chip bench: flash kernel vs unfused attention as
+sequence length grows.
+
+The flash kernel's reason to exist on TPU is O(s) memory (never
+materializing the [s, s] score matrix) — this measures where the unfused
+path falls over and what the kernel sustains at 4k-32k tokens on one chip
+(fwd+bwd, bf16, BERT-large head geometry). Record results in BASELINE.md.
+
+Usage:  python benchmarks/bench_long_context.py [seqs...]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+if os.environ.get("BENCH_CPU") == "1":
+    jax.config.update("jax_platforms", "cpu")
+
+
+def timeit(fn, *args, iters=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    from apex_tpu.ops.attention import flash_attention
+
+    seqs = [int(s) for s in sys.argv[1:]] or [2048, 4096, 8192, 16384, 32768]
+    h, d = 16, 64  # BERT/GPT-large head geometry
+    print(f"device: {jax.devices()[0]}  (b*h={h}, d={d}, bf16, fwd+bwd)",
+          flush=True)
+    for s in seqs:
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, h, s, d), jnp.bfloat16)
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, h, s, d), jnp.bfloat16)
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, h, s, d), jnp.bfloat16)
+        do = jax.random.normal(jax.random.PRNGKey(3), (1, h, s, d), jnp.bfloat16)
+        # fwd = 2 matmuls = 4*s^2*d FLOPs per head (2 FLOPs/MAC included);
+        # bwd counted as 2x fwd; causal halves the visible area
+        fl = 0.5 * 4 * h * s * s * d * 3
+        for use, name in ((True, "flash "), (False, "unfused")):
+            def g(q, k, v, use=use):
+                def loss(q, k, v):
+                    o = flash_attention(q, k, v, causal=True, use_pallas=use)
+                    return jnp.vdot(o.astype(jnp.float32),
+                                    do.astype(jnp.float32))
+                return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+            try:
+                sec = timeit(jax.jit(g), q, k, v)
+                print(f"s={s:6d} {name}: {sec*1e3:9.2f} ms  "
+                      f"{fl/sec/1e12:6.2f} TFLOP/s", flush=True)
+            except Exception as e:
+                msg = (str(e).splitlines() or [type(e).__name__])[0][:100]
+                print(f"s={s:6d} {name}: FAILED ({msg})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

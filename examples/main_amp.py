@@ -55,8 +55,13 @@ def main():
     ap.add_argument("--lr", default=0.05, type=float)
     ap.add_argument("--ddp", action="store_true",
                     help="data-parallel over all visible devices (SyncBN)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU backend BEFORE touching devices (the "
+                         "remote-TPU plugin can hang at init)")
     args = ap.parse_args()
 
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
     n = len(jax.devices()) if args.ddp else 1
     mesh = Mesh(jax.devices()[:n], ("data",))
     print(f"opt_level={args.opt_level} ddp={args.ddp} devices={n}")
