@@ -74,6 +74,23 @@ def _probe_rms_norm() -> None:
 
 
 def _probe_flash_attention() -> None:
+    import os
+
+    # pin the RESIDENT kernels: an inherited APEX_TPU_FLASH_STREAM=1 would
+    # route this probe through the streaming kernels, and their failure
+    # must not pin off the (independent) short-seq family
+    old = os.environ.get("APEX_TPU_FLASH_STREAM")
+    os.environ["APEX_TPU_FLASH_STREAM"] = "0"
+    try:
+        _probe_flash_attention_resident()
+    finally:
+        if old is None:
+            os.environ.pop("APEX_TPU_FLASH_STREAM", None)
+        else:
+            os.environ["APEX_TPU_FLASH_STREAM"] = old
+
+
+def _probe_flash_attention_resident() -> None:
     from apex_tpu.ops.attention import flash_attention
 
     q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 256, 64), jnp.bfloat16)
@@ -125,11 +142,29 @@ def _probe_optim_flat() -> None:
     assert abs(float(nrm) - float(ref)) / float(ref) < 1e-5, "l2norm mismatch"
 
 
+def _probe_flash_attention_stream() -> None:
+    """The long-sequence streaming kernels (3-D grid + VMEM scratch).
+    Probed at small shapes with the selection forced; on failure only the
+    streaming path is pinned off — short-seq flash keeps its kernels."""
+    import os
+
+    old = os.environ.get("APEX_TPU_FLASH_STREAM")
+    os.environ["APEX_TPU_FLASH_STREAM"] = "1"
+    try:
+        _probe_flash_attention_resident()
+    finally:
+        if old is None:
+            os.environ.pop("APEX_TPU_FLASH_STREAM", None)
+        else:
+            os.environ["APEX_TPU_FLASH_STREAM"] = old
+
+
 # family name (as consulted by default_use_pallas) -> probe
 PROBES: Dict[str, Callable[[], None]] = {
     "layer_norm": _probe_layer_norm,
     "rms_norm": _probe_rms_norm,
     "flash_attention": _probe_flash_attention,
+    "flash_attention_stream": _probe_flash_attention_stream,
     "optim_flat": _probe_optim_flat,
 }
 

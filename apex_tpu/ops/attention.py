@@ -139,6 +139,336 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, offset, scale, block_k, sk):
     lse_ref[0] = m_i + jnp.log(l_safe)                # [bq, 1]
 
 
+# ---------------------------------------------------------------------------
+# Streaming kernels for LONG sequences.
+#
+# The short-seq kernels above keep whole K/V (fwd, dq) or whole Q (dkv, fused
+# bwd) resident in VMEM and loop over blocks with fori_loop — fastest when it
+# fits, but VMEM (~16 MB) caps seq around ~16k at d=64. The streaming
+# variants put the inner loop ON THE GRID (minor-most axis) with online
+# accumulators in VMEM scratch, so per-step residency is O(block) and any
+# sequence length streams from HBM. Selected automatically above
+# _STREAM_SEQ; causal blocks with no visible entries skip their compute via
+# pl.when (their DMA still runs — acceptable 2x bandwidth on causal).
+# ---------------------------------------------------------------------------
+
+_STREAM_SEQ = 8192  # switch point: max(sq, sk) strictly greater -> streaming
+
+try:
+    from jax.experimental.pallas import tpu as _pltpu
+except Exception:  # pragma: no cover
+    _pltpu = None
+
+
+def _bias_spec_stream(broadcast_q, bq, bk, kv_major: bool):
+    """Bias BlockSpec for the streaming grids. kv_major selects the
+    (b, ki, qi) grid ordering (dkv kernel) vs (b, qi, ki)."""
+    if kv_major:
+        if broadcast_q:
+            return pl.BlockSpec((1, 1, bk), lambda i, ki, qi: (i, 0, ki))
+        return pl.BlockSpec((1, bq, bk), lambda i, ki, qi: (i, qi, ki))
+    if broadcast_q:
+        return pl.BlockSpec((1, 1, bk), lambda i, qi, ki: (i, 0, ki))
+    return pl.BlockSpec((1, bq, bk), lambda i, qi, ki: (i, qi, ki))
+
+
+def _causal_visible(qi, ki, bq, bk, offset):
+    """Does q-block qi see any column of k-block ki? min_col <= max_row+off."""
+    return ki * bk <= qi * bq + bq - 1 + offset
+
+
+def _block_mask(qi, ki, bq, bk, offset, s):
+    rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(cols <= rows + offset, s, _NEG_INF)
+
+
+def _fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, causal, offset, scale, nk):
+    # rest is (bias?, o_ref, lse_ref, acc, m, l) — scratch refs last
+    if len(rest) == 6:
+        bias_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        bias_ref = None
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    bq, d = acc_ref.shape
+    bk = k_ref.shape[1]
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
+        if causal:
+            s = _block_mask(qi, ki, bq, bk, offset, s)
+        m_i, l_i = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(s > _VALID_THRESHOLD, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_i - m_new)
+        l_ref[...] = l_i * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[...] = m_new
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        @pl.when(_causal_visible(qi, ki, bq, bk, offset))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _emit():
+        l_safe = jnp.where(l_ref[...] == 0.0, 1.0, l_ref[...])
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(l_safe)
+
+
+def _fwd_stream_pallas(q, k, v, bias, causal, scale):
+    b, sq, d = q.shape
+    sk = k.shape[1]
+    bq = _block_size(sq)
+    bk = _block_size(sk)
+    qp = _pad_seq(q, bq, 1)
+    kp = _pad_seq(k, bk, 1)
+    vp = _pad_seq(v, bk, 1)
+    sqp, skp = qp.shape[1], kp.shape[1]
+    bias_p, broadcast_q = _prep_bias(bias, b, sq, sk, bq, bk, sqp, skp)
+    nq, nk = sqp // bq, skp // bk
+
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, qi, ki: (i, qi, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, qi, ki: (i, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, qi, ki: (i, ki, 0)),
+    ]
+    args = [qp, kp, vp]
+    if bias_p is not None:
+        in_specs.append(_bias_spec_stream(broadcast_q, bq, bk, kv_major=False))
+        args.append(bias_p)
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_stream_kernel, causal=causal, offset=sk - sq, scale=scale,
+            nk=nk,
+        ),
+        grid=(b, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, qi, ki: (i, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda i, qi, ki: (i, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sqp, d), q.dtype),
+            jax.ShapeDtypeStruct((b, sqp, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            _pltpu.VMEM((bq, d), jnp.float32),
+            _pltpu.VMEM((bq, 1), jnp.float32),
+            _pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=pallas_interpret(),
+    )(*args)
+    return o[:, :sq], lse[:, :sq, 0]
+
+
+def _bwd_dq_stream_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref,
+                          *rest, causal, offset, scale, nk):
+    if len(rest) == 3:
+        bias_ref, dq_ref, acc_ref = rest
+    else:
+        bias_ref = None
+        dq_ref, acc_ref = rest
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    bq, d = acc_ref.shape
+    bk = k_ref.shape[1]
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
+        if causal:
+            s = _block_mask(qi, ki, bq, bk, offset, s)
+        p = jnp.where(s > _VALID_THRESHOLD, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        acc_ref[...] += jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        @pl.when(_causal_visible(qi, ki, bq, bk, offset))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _emit():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_stream_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref,
+                           *rest, causal, offset, scale, nq):
+    if len(rest) == 4:
+        bias_ref, dk_ref, dv_ref, acc2_ref = rest
+    else:
+        bias_ref = None
+        dk_ref, dv_ref, acc2_ref = rest
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        acc2_ref[...] = jnp.zeros_like(acc2_ref)
+
+    bk = k_ref.shape[1]
+    d = k_ref.shape[2]
+    bq = q_ref.shape[1]
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
+        if causal:
+            s = _block_mask(qi, ki, bq, bk, offset, s)
+        p = jnp.where(s > _VALID_THRESHOLD, jnp.exp(s - lse), 0.0)
+        dv_new = jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dk_new = jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc2_ref[0] += dk_new
+        acc2_ref[1] += dv_new
+
+    if causal:
+        @pl.when(_causal_visible(qi, ki, bq, bk, offset))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == nq - 1)
+    def _emit():
+        dk_ref[0] = acc2_ref[0].astype(dk_ref.dtype)
+        dv_ref[0] = acc2_ref[1].astype(dv_ref.dtype)
+
+
+def _bwd_stream_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
+    (qp, kp, vp, dop, lsep, deltap, bias_p, broadcast_q, dims) = \
+        _bwd_prologue(q, k, v, bias, o, lse, do, dlse)
+    b, sq, sk, d, bq, bk, sqp, skp = dims
+    nq, nk = sqp // bq, skp // bk
+
+    common = [qp, kp, vp, lsep, dop, deltap]
+
+    dq_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, qi, ki: (i, qi, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, qi, ki: (i, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, qi, ki: (i, ki, 0)),
+        pl.BlockSpec((1, bq, 1), lambda i, qi, ki: (i, qi, 0)),
+        pl.BlockSpec((1, bq, d), lambda i, qi, ki: (i, qi, 0)),
+        pl.BlockSpec((1, bq, 1), lambda i, qi, ki: (i, qi, 0)),
+    ]
+    dq_args = list(common)
+    if bias_p is not None:
+        dq_specs.append(_bias_spec_stream(broadcast_q, bq, bk, kv_major=False))
+        dq_args.append(bias_p)
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_stream_kernel, causal=causal, offset=sk - sq,
+            scale=scale, nk=nk,
+        ),
+        grid=(b, nq, nk),
+        in_specs=dq_specs,
+        out_specs=[pl.BlockSpec((1, bq, d), lambda i, qi, ki: (i, qi, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b, sqp, d), q.dtype)],
+        scratch_shapes=[_pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=pallas_interpret(),
+    )(*dq_args)[0]
+
+    dkv_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, ki, qi: (i, qi, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, ki, qi: (i, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, ki, qi: (i, ki, 0)),
+        pl.BlockSpec((1, bq, 1), lambda i, ki, qi: (i, qi, 0)),
+        pl.BlockSpec((1, bq, d), lambda i, ki, qi: (i, qi, 0)),
+        pl.BlockSpec((1, bq, 1), lambda i, ki, qi: (i, qi, 0)),
+    ]
+    dkv_args = list(common)
+    if bias_p is not None:
+        dkv_specs.append(_bias_spec_stream(broadcast_q, bq, bk, kv_major=True))
+        dkv_args.append(bias_p)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_stream_kernel, causal=causal, offset=sk - sq,
+            scale=scale, nq=nq,
+        ),
+        grid=(b, nk, nq),
+        in_specs=dkv_specs,
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda i, ki, qi: (i, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, ki, qi: (i, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, skp, d), k.dtype),
+            jax.ShapeDtypeStruct((b, skp, d), v.dtype),
+        ],
+        scratch_shapes=[_pltpu.VMEM((2, bk, d), jnp.float32)],
+        interpret=pallas_interpret(),
+    )(*dkv_args)
+    return dq[:, :sq], dk[:, :sk], dv[:, :sk]
+
+
 def _pad_seq(x, block, axis):
     s = x.shape[axis]
     pad = (-s) % block
@@ -176,7 +506,24 @@ def _bias_spec(broadcast_q, bq, skp):
     return pl.BlockSpec((1, bq, skp), lambda i, j: (i, j, 0))
 
 
+def _use_streaming(sq: int, sk: int) -> bool:
+    from apex_tpu.ops._utils import kernel_disabled
+
+    if _pltpu is None:  # no TPU pallas backend: scratch_shapes unavailable
+        return False
+    if kernel_disabled("flash_attention_stream"):
+        # preflight found the streaming kernels unlowerable: stay on the
+        # resident-KV kernels (fine to ~8-16k; beyond that VMEM will say so)
+        return False
+    env = os.environ.get("APEX_TPU_FLASH_STREAM")
+    if env is not None:
+        return env == "1"
+    return max(sq, sk) > _STREAM_SEQ
+
+
 def _fwd_pallas(q, k, v, bias, causal, scale):
+    if _use_streaming(q.shape[1], k.shape[1]):
+        return _fwd_stream_pallas(q, k, v, bias, causal, scale)
     b, sq, d = q.shape
     sk = k.shape[1]
     bq = _block_size(sq)
@@ -491,6 +838,9 @@ def _bwd_fused_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
 
 
 def _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
+    if _use_streaming(q.shape[1], k.shape[1]):
+        return _bwd_stream_pallas(q, k, v, bias, causal, scale, o, lse, do,
+                                  dlse)
     if os.environ.get("APEX_TPU_FLASH_SPLIT_BWD") != "1":
         return _bwd_fused_pallas(q, k, v, bias, causal, scale, o, lse, do,
                                  dlse)
@@ -606,6 +956,19 @@ def _bwd_ref(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), ds
 
 
+def _check_dbias_seq(q, k):
+    """Learned-bias gradients need the unfused [Sq, Sk] ds pass — fine at
+    resident lengths, but it would defeat the streaming kernels' O(block)
+    memory at long seq. Fail loudly instead of OOMing HBM."""
+    if max(q.shape[1], k.shape[1]) > _STREAM_SEQ:
+        raise NotImplementedError(
+            f"bias gradients at streaming sequence lengths (sq={q.shape[1]}, "
+            f"sk={k.shape[1]} > {_STREAM_SEQ}) would materialize the full "
+            "score matrix; pass a non-learned bias as `mask` (no gradient), "
+            "or stop_gradient the bias"
+        )
+
+
 def _dbias_from_ds(ds, bias):
     if bias.shape[1] == 1:
         ds = jnp.sum(ds, axis=1, keepdims=True)
@@ -643,6 +1006,7 @@ def _flash_core_bwd(causal, scale, use_pallas, need_dbias, res, do):
     if bias is not None:
         if need_dbias:
             if ds is None:  # pallas path: one unfused pass just for dbias
+                _check_dbias_seq(q, k)
                 _, ds, _ = _bwd_pieces(q, k, v, bias, causal, scale, o, lse,
                                        do)
             dbias = _dbias_from_ds(ds, bias)
@@ -689,6 +1053,7 @@ def _flash_core_lse_bwd(causal, scale, use_pallas, need_dbias, res, cts):
             # _bwd_pieces) so learned biases (ALiBi, relative-position)
             # train correctly here
             if ds is None:  # pallas path: one unfused pass just for dbias
+                _check_dbias_seq(q, k)
                 _, ds, _ = _bwd_pieces(q, k, v, bias, causal, scale, o, lse,
                                        do, dlse)
             dbias = _dbias_from_ds(ds, bias)

@@ -232,3 +232,43 @@ def test_with_lse_mask_stays_compact_in_backward():
     finally:
         A._bwd_pieces = orig
     assert called["pieces"] == 0, called
+
+
+def test_streaming_kernels_match_oracle(monkeypatch):
+    """The long-sequence streaming kernels (3-D grid + scratch accumulators)
+    must match the oracle exactly — forced on at small shapes."""
+    monkeypatch.setenv("APEX_TPU_USE_PALLAS", "1")
+    monkeypatch.setenv("APEX_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("APEX_TPU_FLASH_STREAM", "1")
+    q, k, v = _make_qkv(1, 2, 200, 264, 32, jnp.float32)
+    do = jax.random.normal(jax.random.PRNGKey(9), q.shape, q.dtype)
+
+    def f(q, k, v, use):
+        return jnp.vdot(flash_attention(q, k, v, causal=True,
+                                        use_pallas=use), do)
+
+    y_s = flash_attention(q, k, v, causal=True, use_pallas=True)
+    y_r = flash_attention(q, k, v, causal=True, use_pallas=False)
+    np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_r),
+                               rtol=1e-5, atol=1e-5)
+    g_s = jax.grad(lambda q, k, v: f(q, k, v, True), argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(lambda q, k, v: f(q, k, v, False), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_s, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_stream_fallback_when_disabled(monkeypatch):
+    """A disabled flash_attention_stream family routes long-seq calls back
+    to the resident-KV kernels instead of erroring."""
+    from apex_tpu.ops import _utils
+    from apex_tpu.ops.attention import _use_streaming
+
+    monkeypatch.setenv("APEX_TPU_FLASH_STREAM", "1")
+    assert _use_streaming(512, 512) is True
+    _utils.disable_kernel("flash_attention_stream")
+    try:
+        assert _use_streaming(512, 512) is False
+        assert _use_streaming(100_000, 100_000) is False
+    finally:
+        _utils.enable_kernel("flash_attention_stream")

@@ -185,6 +185,29 @@ def test_flash_lse_gradients_compiled(dtype):
         assert _md(a, c) < 0.05
 
 
+@pytest.mark.parametrize("dtype", [jnp.bfloat16])
+def test_flash_streaming_compiled(dtype, monkeypatch):
+    """The long-sequence streaming kernels compiled by Mosaic: parity at a
+    seq length the resident-KV kernels also handle, so the oracle is cheap."""
+    from apex_tpu.ops.attention import flash_attention
+
+    monkeypatch.setenv("APEX_TPU_FLASH_STREAM", "1")
+    b, h, s, d = 1, 4, 1024, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, s, d), dtype)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, h, s, d), dtype)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, h, s, d), dtype)
+    do = jax.random.normal(jax.random.PRNGKey(3), (b, h, s, d), dtype)
+
+    def f(q, k, v, use):
+        y = flash_attention(q, k, v, causal=True, use_pallas=use)
+        return jnp.vdot(y.astype(jnp.float32), do.astype(jnp.float32))
+
+    gp = jax.jit(jax.grad(lambda q, k, v: f(q, k, v, True), argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(lambda q, k, v: f(q, k, v, False), argnums=(0, 1, 2)))(q, k, v)
+    for a, c in zip(gp, gr):
+        assert _md(a, c) < 0.05
+
+
 def test_preflight_all_green():
     """On hardware every family must pass its probe; this is the regression
     gate for 'a kernel that lowers today keeps lowering tomorrow'."""
