@@ -73,21 +73,30 @@ def _probe_rms_norm() -> None:
         assert _maxdiff(a, c) < 0.1, "rms_norm grad mismatch vs oracle"
 
 
-def _probe_flash_attention() -> None:
+import contextlib
+
+
+@contextlib.contextmanager
+def _pinned_env(name: str, value: str):
     import os
 
+    old = os.environ.get(name)
+    os.environ[name] = value
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = old
+
+
+def _probe_flash_attention() -> None:
     # pin the RESIDENT kernels: an inherited APEX_TPU_FLASH_STREAM=1 would
     # route this probe through the streaming kernels, and their failure
     # must not pin off the (independent) short-seq family
-    old = os.environ.get("APEX_TPU_FLASH_STREAM")
-    os.environ["APEX_TPU_FLASH_STREAM"] = "0"
-    try:
+    with _pinned_env("APEX_TPU_FLASH_STREAM", "0"):
         _probe_flash_attention_resident()
-    finally:
-        if old is None:
-            os.environ.pop("APEX_TPU_FLASH_STREAM", None)
-        else:
-            os.environ["APEX_TPU_FLASH_STREAM"] = old
 
 
 def _probe_flash_attention_resident() -> None:
@@ -144,19 +153,45 @@ def _probe_optim_flat() -> None:
 
 def _probe_flash_attention_stream() -> None:
     """The long-sequence streaming kernels (3-D grid + VMEM scratch).
-    Probed at small shapes with the selection forced; on failure only the
-    streaming path is pinned off — short-seq flash keeps its kernels."""
-    import os
 
-    old = os.environ.get("APEX_TPU_FLASH_STREAM")
-    os.environ["APEX_TPU_FLASH_STREAM"] = "1"
-    try:
-        _probe_flash_attention_resident()
-    finally:
-        if old is None:
-            os.environ.pop("APEX_TPU_FLASH_STREAM", None)
-        else:
-            os.environ["APEX_TPU_FLASH_STREAM"] = old
+    Probed at shapes with MULTIPLE blocks per grid axis (nq, nk >= 2), so
+    the streaming-specific machinery — cross-step scratch accumulation,
+    online-softmax rescale across revisits, causal block skip, revisited
+    output copy-out, and the broadcast-bias (mask) spec branch — actually
+    lowers and is value-checked. On failure only the streaming path is
+    pinned off; short-seq flash keeps its kernels."""
+    from apex_tpu.ops.attention import flash_attention
+
+    with _pinned_env("APEX_TPU_FLASH_STREAM", "1"):
+        for (sq, sk), causal, masked in (
+            ((512, 512), True, False),   # causal, 2x2 blocks, skip branch
+            ((384, 640), False, True),   # ragged cross-attn + mask branch
+        ):
+            q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, sq, 64),
+                                  jnp.bfloat16)
+            k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, sk, 64),
+                                  jnp.bfloat16)
+            v = jax.random.normal(jax.random.PRNGKey(2), (1, 2, sk, 64),
+                                  jnp.bfloat16)
+            do = jax.random.normal(jax.random.PRNGKey(3), q.shape, q.dtype)
+            mask = (
+                jnp.zeros((1, 1, 1, sk), bool).at[..., sk - 40:].set(True)
+                if masked else None
+            )
+
+            def f(q, k, v, use, causal=causal, mask=mask, do=do):
+                y = flash_attention(q, k, v, mask=mask, causal=causal,
+                                    use_pallas=use)
+                return jnp.vdot(y.astype(jnp.float32),
+                                do.astype(jnp.float32))
+
+            gp = jax.jit(jax.grad(
+                lambda q, k, v: f(q, k, v, True), argnums=(0, 1, 2)))(q, k, v)
+            gr = jax.jit(jax.grad(
+                lambda q, k, v: f(q, k, v, False), argnums=(0, 1, 2)))(q, k, v)
+            for a, c in zip(gp, gr):
+                assert _maxdiff(a, c) < 0.1, \
+                    "flash_attention_stream grad mismatch vs oracle"
 
 
 # family name (as consulted by default_use_pallas) -> probe

@@ -960,7 +960,11 @@ def _check_dbias_seq(q, k):
     """Learned-bias gradients need the unfused [Sq, Sk] ds pass — fine at
     resident lengths, but it would defeat the streaming kernels' O(block)
     memory at long seq. Fail loudly instead of OOMing HBM."""
-    if max(q.shape[1], k.shape[1]) > _STREAM_SEQ:
+    # only a problem when (a) the streaming path is actually selected AND
+    # (b) the length is genuinely long — a forced-resident run at long seq
+    # or a small-seq forced-streaming probe both keep their gradients
+    if _use_streaming(q.shape[1], k.shape[1]) and \
+            max(q.shape[1], k.shape[1]) > _STREAM_SEQ:
         raise NotImplementedError(
             f"bias gradients at streaming sequence lengths (sq={q.shape[1]}, "
             f"sk={k.shape[1]} > {_STREAM_SEQ}) would materialize the full "

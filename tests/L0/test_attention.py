@@ -234,21 +234,34 @@ def test_with_lse_mask_stays_compact_in_backward():
     assert called["pieces"] == 0, called
 
 
-def test_streaming_kernels_match_oracle(monkeypatch):
+@pytest.mark.parametrize(
+    "sq,sk,causal,masked",
+    [
+        (200, 264, True, False),   # ragged, causal (positive offset)
+        (264, 200, False, False),  # sq > sk cross-attention
+        (200, 264, False, True),   # broadcast-q mask spec branch
+    ],
+)
+def test_streaming_kernels_match_oracle(monkeypatch, sq, sk, causal, masked):
     """The long-sequence streaming kernels (3-D grid + scratch accumulators)
-    must match the oracle exactly — forced on at small shapes."""
+    must match the oracle exactly — forced on at small shapes, covering the
+    causal skip, the sq>sk offset, and the broadcast-bias (mask) branch."""
     monkeypatch.setenv("APEX_TPU_USE_PALLAS", "1")
     monkeypatch.setenv("APEX_TPU_PALLAS_INTERPRET", "1")
     monkeypatch.setenv("APEX_TPU_FLASH_STREAM", "1")
-    q, k, v = _make_qkv(1, 2, 200, 264, 32, jnp.float32)
+    q, k, v = _make_qkv(1, 2, sq, sk, 32, jnp.float32)
     do = jax.random.normal(jax.random.PRNGKey(9), q.shape, q.dtype)
+    mask = (
+        jnp.zeros((1, 1, 1, sk), bool).at[..., sk - 30:].set(True)
+        if masked else None
+    )
 
     def f(q, k, v, use):
-        return jnp.vdot(flash_attention(q, k, v, causal=True,
+        return jnp.vdot(flash_attention(q, k, v, mask=mask, causal=causal,
                                         use_pallas=use), do)
 
-    y_s = flash_attention(q, k, v, causal=True, use_pallas=True)
-    y_r = flash_attention(q, k, v, causal=True, use_pallas=False)
+    y_s = flash_attention(q, k, v, mask=mask, causal=causal, use_pallas=True)
+    y_r = flash_attention(q, k, v, mask=mask, causal=causal, use_pallas=False)
     np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_r),
                                rtol=1e-5, atol=1e-5)
     g_s = jax.grad(lambda q, k, v: f(q, k, v, True), argnums=(0, 1, 2))(q, k, v)
