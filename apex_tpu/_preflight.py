@@ -22,6 +22,8 @@ the persistent compilation cache makes reruns cheap.
 
 from __future__ import annotations
 
+import contextlib
+import os
 import time
 import traceback
 from typing import Callable, Dict, Optional
@@ -73,13 +75,8 @@ def _probe_rms_norm() -> None:
         assert _maxdiff(a, c) < 0.1, "rms_norm grad mismatch vs oracle"
 
 
-import contextlib
-
-
 @contextlib.contextmanager
 def _pinned_env(name: str, value: str):
-    import os
-
     old = os.environ.get(name)
     os.environ[name] = value
     try:

@@ -960,17 +960,23 @@ def _check_dbias_seq(q, k):
     """Learned-bias gradients need the unfused [Sq, Sk] ds pass — fine at
     resident lengths, but it would defeat the streaming kernels' O(block)
     memory at long seq. Fail loudly instead of OOMing HBM."""
-    # only a problem when (a) the streaming path is actually selected AND
-    # (b) the length is genuinely long — a forced-resident run at long seq
-    # or a small-seq forced-streaming probe both keep their gradients
-    if _use_streaming(q.shape[1], k.shape[1]) and \
-            max(q.shape[1], k.shape[1]) > _STREAM_SEQ:
-        raise NotImplementedError(
-            f"bias gradients at streaming sequence lengths (sq={q.shape[1]}, "
-            f"sk={k.shape[1]} > {_STREAM_SEQ}) would materialize the full "
-            "score matrix; pass a non-learned bias as `mask` (no gradient), "
-            "or stop_gradient the bias"
-        )
+    # Only a problem at genuinely long lengths. A small-seq forced-streaming
+    # probe keeps its gradients; an EXPLICIT forced-resident run
+    # (APEX_TPU_FLASH_STREAM=0) at long seq is the user's own memory call.
+    # But preflight auto-disabling the streaming family must NOT silently
+    # reopen the O(sq*sk) pass — that run still fails loudly here rather
+    # than as an opaque HBM OOM.
+    if max(q.shape[1], k.shape[1]) <= _STREAM_SEQ:
+        return
+    if os.environ.get("APEX_TPU_FLASH_STREAM") == "0":
+        return
+    raise NotImplementedError(
+        f"bias gradients at streaming sequence lengths (sq={q.shape[1]}, "
+        f"sk={k.shape[1]} > {_STREAM_SEQ}) would materialize the full "
+        "score matrix; pass a non-learned bias as `mask` (no gradient), "
+        "stop_gradient the bias, or force the resident kernels with "
+        "APEX_TPU_FLASH_STREAM=0 if you accept the memory cost"
+    )
 
 
 def _dbias_from_ds(ds, bias):

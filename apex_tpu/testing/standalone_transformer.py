@@ -74,7 +74,12 @@ class TransformerConfig:
                                    # (jax dots_with_no_batch_dims_saveable:
                                    # ~no recompute of MXU work in backward,
                                    # more activation memory) — only read
-                                   # when remat=True
+                                   # when remat=True. Measured on v5e
+                                   # (BASELINE.md): "dots" needs ~1.15
+                                   # GB/layer at BERT-large b>=32 and
+                                   # fails to compile on a single 16 GB
+                                   # chip; it is the right policy only
+                                   # once state is ZeRO/TP-sharded.
     scan_layers: bool = False      # lax.scan over stacked layer params
                                    # (compile time O(1) in depth; pass
                                    # params through stack_layer_params)
@@ -308,11 +313,15 @@ def transformer_forward(params, tokens, cfg: TransformerConfig, *,
         x = gather_from_sequence_parallel_region(x, ax, True)
     else:
         x = copy_to_tensor_model_parallel_region(x, ax)
-    logits = jnp.matmul(
-        x.astype(jnp.float32),
-        params["embedding"].astype(jnp.float32).T,
-        preferred_element_type=jnp.float32,
-    )
+    # Vocab logits stay in the compute dtype (Megatron computes
+    # parallel_lm_logits in half precision; vocab_parallel_cross_entropy
+    # upcasts to fp32 per-tile). The MXU accumulates bf16 x bf16 in fp32
+    # regardless of the output dtype, so only the stored logits lose
+    # mantissa — and forcing fp32 INPUTS here costs a 3-pass MXU matmul on
+    # the h x vocab product (~9% of model MACs at BERT-large) plus a 2x
+    # larger [s, b, v] intermediate. Measured on v5e via
+    # benchmarks/bench_step_variants.py (see BASELINE.md).
+    logits = jnp.matmul(x.astype(cfg.dtype), params["embedding"].astype(cfg.dtype).T)
     return logits
 
 

@@ -4,7 +4,6 @@ the unpipelined model's loss and gradients — the integration analog of the
 toy-stage schedule-parity tests (SURVEY §4.4)."""
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp
@@ -77,8 +76,7 @@ def test_gpt_blocks_through_pipeline_match_unpipelined(eight_cpu_devices):
     x_full = _embed(params, tokens, cfg)               # [s, B, h]
     xs = x_full.transpose(1, 0, 2).reshape(B, 1, S, H).transpose(0, 2, 1, 3)
     # -> [m=B, s, mb=1, h]
-    targets = jnp.roll(tokens, -1, axis=1).transpose(1, 0)  # [s, B]
-    ys = targets.T.reshape(B, S, 1)                    # [m, s, mb]
+    ys = jnp.roll(tokens, -1, axis=1).reshape(B, S, 1)  # [m, s, mb]
 
     # oracle: run the same stages sequentially (no pipelining)
     def ref_loss_and_grads(staged, lp, xs, ys):

@@ -285,3 +285,29 @@ def test_stream_fallback_when_disabled(monkeypatch):
         assert _use_streaming(100_000, 100_000) is False
     finally:
         _utils.enable_kernel("flash_attention_stream")
+
+
+def test_dbias_guard_raises_even_when_stream_disabled(monkeypatch):
+    """Preflight auto-disabling the streaming family must NOT silently
+    reopen the O(sq*sk) dbias pass at long seq — only the explicit
+    APEX_TPU_FLASH_STREAM=0 user override may (review finding, round 3)."""
+    import pytest as _pytest
+
+    from apex_tpu.ops import _utils
+    from apex_tpu.ops.attention import _STREAM_SEQ, _check_dbias_seq
+
+    short = jnp.zeros((1, 512, 64))
+    long = jnp.zeros((1, _STREAM_SEQ * 2, 64))
+    monkeypatch.delenv("APEX_TPU_FLASH_STREAM", raising=False)
+
+    _check_dbias_seq(short, short)                    # resident length: fine
+    with _pytest.raises(NotImplementedError):
+        _check_dbias_seq(long, long)
+    _utils.disable_kernel("flash_attention_stream")   # preflight pinned off
+    try:
+        with _pytest.raises(NotImplementedError):
+            _check_dbias_seq(long, long)              # still loud
+    finally:
+        _utils.enable_kernel("flash_attention_stream")
+    monkeypatch.setenv("APEX_TPU_FLASH_STREAM", "0")  # explicit user call
+    _check_dbias_seq(long, long)
