@@ -27,17 +27,32 @@ class FlatMeta(NamedTuple):
     dtypes: tuple
     sizes: tuple
     padded_total: int
-    num_tensors: int
+    num_tensors: int      # total per-tensor segments (stacked leaves count L)
+    sub_counts: tuple     # per leaf: 1, or L for a lax.scan-stacked [L, ...]
 
 
-def flat_meta(params, n_shards: int) -> FlatMeta:
-    leaves, treedef = jax.tree.flatten(params)
+def flat_meta(params, n_shards: int,
+              stacked_key: str | None = "layers") -> FlatMeta:
+    """``stacked_key``: dict key marking scan-stacked [L, ...] collections
+    (``testing.stack_layer_params``). Each such leaf contributes L segment
+    ids — one per layer slice — so per-tensor bookkeeping (LAMB trust
+    ratios) keeps the reference's per-layer-tensor granularity."""
+    from apex_tpu.utils.pytree import is_stacked_path
+
+    paths, treedef = jax.tree_util.tree_flatten_with_path(params)
+    leaves = [l for _, l in paths]
     shapes = tuple(l.shape for l in leaves)
     dtypes = tuple(l.dtype for l in leaves)
     sizes = tuple(int(l.size) for l in leaves)
+    sub_counts = tuple(
+        int(l.shape[0])
+        if l.ndim > 0 and is_stacked_path(path, stacked_key) else 1
+        for (path, _), l in zip(paths, leaves)
+    )
     total = sum(sizes)
     padded_total = -(-total // n_shards) * n_shards
-    return FlatMeta(treedef, shapes, dtypes, sizes, padded_total, len(leaves))
+    return FlatMeta(treedef, shapes, dtypes, sizes, padded_total,
+                    sum(sub_counts), sub_counts)
 
 
 def flatten_fp32(tree, meta: FlatMeta):
@@ -61,9 +76,20 @@ def unflatten(flat, meta: FlatMeta):
 
 
 def tensor_ids(meta: FlatMeta):
-    """int32 [padded_total]: which tensor each flat element belongs to
-    (padding gets id num_tensors — an extra dead segment)."""
-    ids = [jnp.full((s,), i, jnp.int32) for i, s in enumerate(meta.sizes)]
+    """int32 [padded_total]: which per-tensor segment each flat element
+    belongs to. A stacked [L, ...] leaf spans L consecutive ids (its flat
+    layout is layer-major, so each layer slice is contiguous); padding gets
+    id num_tensors — an extra dead segment."""
+    ids = []
+    nxt = 0
+    for size, subs in zip(meta.sizes, meta.sub_counts):
+        if subs == 1:
+            ids.append(jnp.full((size,), nxt, jnp.int32))
+        else:
+            per = size // subs
+            ids.append(jnp.repeat(
+                jnp.arange(nxt, nxt + subs, dtype=jnp.int32), per))
+        nxt += subs
     pad = meta.padded_total - sum(meta.sizes)
     if pad:
         ids.append(jnp.full((pad,), meta.num_tensors, jnp.int32))

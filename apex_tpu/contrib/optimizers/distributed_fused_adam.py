@@ -72,9 +72,15 @@ class DistributedFusedAdam:
         self._meta: Optional[FlatMeta] = None
 
     # -- metadata ----------------------------------------------------------
-    def prepare(self, params, n_shards: int) -> FlatMeta:
-        """Host-side: compute the flat layout (call once, outside jit)."""
-        self._meta = flat_meta(params, n_shards)
+    def prepare(self, params, n_shards: int,
+                stacked_key: str | None = "layers") -> FlatMeta:
+        """Host-side: compute the flat layout (call once, outside jit).
+        ``stacked_key``: dict key marking lax.scan-stacked [L, ...]
+        collections (``testing.stack_layer_params``); their layer slices
+        get separate per-tensor segments. Adam itself has no per-tensor
+        statistics, but the segment ids feed diagnostics and keep the
+        layout identical to DistributedFusedLAMB's. ``None`` disables."""
+        self._meta = flat_meta(params, n_shards, stacked_key=stacked_key)
         return self._meta
 
     # -- inside shard_map --------------------------------------------------

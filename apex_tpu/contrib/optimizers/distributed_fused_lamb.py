@@ -65,8 +65,13 @@ class DistributedFusedLAMB:
         self.axis_name = axis_name
         self._meta: Optional[FlatMeta] = None
 
-    def prepare(self, params, n_shards: int) -> FlatMeta:
-        self._meta = flat_meta(params, n_shards)
+    def prepare(self, params, n_shards: int,
+                stacked_key: str | None = "layers") -> FlatMeta:
+        """``stacked_key``: dict key marking lax.scan-stacked [L, ...]
+        collections (``testing.stack_layer_params``); their layer slices get
+        separate per-tensor segments (LAMB trust ratios per layer, matching
+        the reference's per-tensor chunk metadata). ``None`` disables."""
+        self._meta = flat_meta(params, n_shards, stacked_key=stacked_key)
         return self._meta
 
     def init_shard(self, params) -> DistLAMBState:

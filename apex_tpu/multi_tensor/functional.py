@@ -254,6 +254,7 @@ def multi_tensor_lamb(
     global_grad_norm,
     max_grad_norm,
     use_nvlamb=False,
+    stacked=None,
 ):
     """Fused LAMB (both phases + per-tensor trust ratios in one call).
 
@@ -262,6 +263,13 @@ def multi_tensor_lamb(
     ``global_grad_norm``/``max_grad_norm``. Phase 2: per-tensor trust ratio
     ``phi(||w||)/||update||`` scales the learning rate. NVLAMB variant applies
     the trust ratio to weight-decay-free tensors too.
+
+    ``stacked``: optional per-tensor bools. A True entry marks a tensor
+    whose leading axis stacks what the reference allocates as SEPARATE
+    per-layer tensors (apex_tpu's ``lax.scan``-over-layers layout,
+    ``testing.stack_layer_params``). Its trust ratios are computed per
+    leading-axis slice — one norm over all L layers would be a different
+    optimizer from the reference's per-tensor LAMB.
     """
     grads, params, ms, vs = tensor_lists
     lr, b1, b2, eps = _f32(lr), _f32(beta1), _f32(beta2), _f32(eps)
@@ -277,8 +285,10 @@ def multi_tensor_lamb(
         clip = jnp.float32(1.0)
 
     skip = noop_flag
+    if stacked is None:
+        stacked = [False] * len(grads)
     new_p, new_m, new_v = [], [], []
-    for g, p, m, v in zip(grads, params, ms, vs):
+    for g, p, m, v, stk in zip(grads, params, ms, vs, stacked):
         g32 = _f32(g) / clip
         p32, m32, v32 = _f32(p), _f32(m), _f32(v)
         if mode == 0:  # L2 mode: wd folded into gradient
@@ -288,8 +298,11 @@ def multi_tensor_lamb(
         update = (m_n / bc1) / (jnp.sqrt(v_n / bc2) + eps)
         if mode == 1:  # AdamW-style decoupled decay joins the update
             update = update + weight_decay * p32
-        w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
-        u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
+        # stacked [L, ...] leaf: one norm PER LAYER SLICE (broadcasts back
+        # over the slice); plain leaf: one scalar norm for the whole tensor
+        axes = tuple(range(1, p32.ndim)) if stk else None
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p32), axis=axes, keepdims=stk))
+        u_norm = jnp.sqrt(jnp.sum(jnp.square(update), axis=axes, keepdims=stk))
         if weight_decay != 0.0 or use_nvlamb:
             ratio = jnp.where(
                 (w_norm > 0) & (u_norm > 0), w_norm / u_norm, jnp.float32(1.0)

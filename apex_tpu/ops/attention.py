@@ -968,7 +968,10 @@ def _check_dbias_seq(q, k):
     # than as an opaque HBM OOM.
     if max(q.shape[1], k.shape[1]) <= _STREAM_SEQ:
         return
-    if os.environ.get("APEX_TPU_FLASH_STREAM") == "0":
+    env = os.environ.get("APEX_TPU_FLASH_STREAM")
+    if env is not None and env != "1":
+        # same parse as _use_streaming: any non-"1" value forces the
+        # resident kernels, so the user already opted into resident memory
         return
     raise NotImplementedError(
         f"bias gradients at streaming sequence lengths (sq={q.shape[1]}, "

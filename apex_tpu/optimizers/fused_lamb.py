@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import optax
 
 from apex_tpu.multi_tensor.functional import multi_tensor_l2norm, multi_tensor_lamb
+from apex_tpu.utils.pytree import is_stacked_path
 
 
 class FusedLAMBState(NamedTuple):
@@ -36,7 +37,13 @@ def fused_lamb(
     grad_averaging: bool = True,
     max_grad_norm: float = 1.0,
     use_nvlamb: bool = False,
+    stacked_key: str | None = "layers",
 ) -> optax.GradientTransformation:
+    """``stacked_key``: dict key marking lax.scan-stacked [L, ...] parameter
+    collections (the ``testing.stack_layer_params`` convention). Leaves under
+    it get PER-LAYER trust ratios, matching the reference's per-tensor LAMB
+    semantics where each layer's weight is its own tensor; ``None`` disables
+    the detection (whole-leaf norms everywhere)."""
     mode = 1 if adam_w_mode else 0
 
     def init_fn(params):
@@ -53,7 +60,11 @@ def fused_lamb(
         step = state.step + 1
         lr = learning_rate(step) if callable(learning_rate) else learning_rate
 
-        leaves_g, treedef = jax.tree.flatten(grads)
+        paths_g, treedef = jax.tree_util.tree_flatten_with_path(grads)
+        leaves_g = [leaf for _, leaf in paths_g]
+        stacked = [
+            is_stacked_path(path, stacked_key) for path, _ in paths_g
+        ] if stacked_key is not None else None
         leaves_p = treedef.flatten_up_to(params)
         leaves_m = treedef.flatten_up_to(state.exp_avg)
         leaves_v = treedef.flatten_up_to(state.exp_avg_sq)
@@ -66,6 +77,7 @@ def fused_lamb(
             [leaves_g, leaves_p, leaves_m, leaves_v],
             lr, b1, b2, eps, step, bias_correction, weight_decay,
             grad_averaging, mode, global_grad_norm, max_grad_norm, use_nvlamb,
+            stacked=stacked,
         )
         updates = [
             (np_.astype(jnp.float32) - jnp.asarray(p).astype(jnp.float32)).astype(

@@ -111,3 +111,23 @@ def tree_zeros_like(tree, dtype=None):
 def tree_size(tree) -> int:
     """Total number of elements across all leaves (python int, static)."""
     return sum(int(jnp.size(x)) for x in jax.tree.leaves(tree))
+
+
+def is_stacked_path(path, stacked_key) -> bool:
+    """True iff ``path`` (a jax key path) reaches a leaf stored DIRECTLY
+    under dict key ``stacked_key`` — the ``testing.stack_layer_params``
+    convention where a [L, ...] array stacks what the reference allocates
+    as L separate per-layer tensors. A SequenceKey AFTER the marker means
+    the UNSTACKED layout (``params["layers"][i][...]`` — a list of
+    per-layer dicts), whose leaves are ordinary tensors; treating those as
+    stacked would silently turn per-tensor optimizer statistics (LAMB
+    trust ratios) into per-row ones."""
+    if stacked_key is None:
+        return False
+    for i, k in enumerate(path):
+        if isinstance(k, jax.tree_util.DictKey) and k.key == stacked_key:
+            return not any(
+                isinstance(rest, jax.tree_util.SequenceKey)
+                for rest in path[i + 1:]
+            )
+    return False

@@ -311,3 +311,18 @@ def test_dbias_guard_raises_even_when_stream_disabled(monkeypatch):
         _utils.enable_kernel("flash_attention_stream")
     monkeypatch.setenv("APEX_TPU_FLASH_STREAM", "0")  # explicit user call
     _check_dbias_seq(long, long)
+
+
+def test_dbias_guard_honors_any_forced_resident_value(monkeypatch):
+    """_use_streaming treats any env value other than "1" as forced
+    resident; the guard must use the same parse (a user who set
+    APEX_TPU_FLASH_STREAM=off already owns the memory cost)."""
+    from apex_tpu.ops.attention import _STREAM_SEQ, _check_dbias_seq
+
+    long = jnp.zeros((1, _STREAM_SEQ * 2, 64))
+    monkeypatch.setenv("APEX_TPU_FLASH_STREAM", "off")
+    _check_dbias_seq(long, long)
+    monkeypatch.setenv("APEX_TPU_FLASH_STREAM", "1")
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError):
+        _check_dbias_seq(long, long)

@@ -95,3 +95,88 @@ def test_novograd_and_adagrad_step():
         updates, state = tx.update(grads, state, params)
         p = optax.apply_updates(params, updates)
         assert float(p["w"][0]) < 1.0
+
+
+def test_lamb_stacked_layers_match_per_layer_tensors():
+    """A lax.scan-stacked [L, ...] collection under "layers" must train
+    identically to the same network stored as L separate per-layer tensors —
+    i.e. trust ratios are per layer slice, the reference's per-tensor
+    semantics (csrc/multi_tensor_lamb.cu), not one norm over the stack."""
+    L = 3
+    key = jax.random.PRNGKey(0)
+    ws = jax.random.normal(key, (L, 4, 4)) * jnp.arange(1, L + 1)[:, None, None]
+    bs = jax.random.normal(jax.random.fold_in(key, 1), (L, 4)) * 0.1
+    gw = jax.random.normal(jax.random.fold_in(key, 2), (L, 4, 4))
+    gb = jax.random.normal(jax.random.fold_in(key, 3), (L, 4))
+
+    stacked_p = {"layers": {"w": ws, "b": bs}, "emb": jnp.ones((4, 4))}
+    stacked_g = {"layers": {"w": gw, "b": gb}, "emb": jnp.full((4, 4), 0.2)}
+    flat_p = {f"l{i}": {"w": ws[i], "b": bs[i]} for i in range(L)}
+    flat_p["emb"] = jnp.ones((4, 4))
+    flat_g = {f"l{i}": {"w": gw[i], "b": gb[i]} for i in range(L)}
+    flat_g["emb"] = jnp.full((4, 4), 0.2)
+
+    # max_grad_norm=None so the (identical) global clip can't mask a
+    # per-tensor trust-ratio difference
+    def run(p, g, **kw):
+        tx = fused_lamb(1e-2, weight_decay=0.01, max_grad_norm=None, **kw)
+        s = tx.init(p)
+        for _ in range(3):
+            u, s = tx.update(g, s, p)
+            p = optax.apply_updates(p, u)
+        return p
+
+    got = run(stacked_p, stacked_g)
+    want = run(flat_p, flat_g)
+    for i in range(L):
+        np.testing.assert_allclose(
+            np.asarray(got["layers"]["w"][i]), np.asarray(want[f"l{i}"]["w"]),
+            rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(
+            np.asarray(got["layers"]["b"][i]), np.asarray(want[f"l{i}"]["b"]),
+            rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(got["emb"]), np.asarray(want["emb"]),
+                               rtol=1e-6, atol=1e-7)
+
+    # stacked_key=None restores whole-leaf norms: must NOT match per-layer
+    legacy = run(stacked_p, stacked_g, stacked_key=None)
+    assert not np.allclose(np.asarray(legacy["layers"]["w"][0]),
+                           np.asarray(want["l0"]["w"]), rtol=1e-6)
+
+
+def test_lamb_unstacked_layers_list_not_misdetected():
+    """The UNSTACKED transformer layout keeps per-layer dicts in a LIST
+    under "layers" (params["layers"][i]["w"]); those leaves are ordinary
+    tensors and must get whole-tensor trust ratios — not per-row ones
+    (path detection requires the [L, ...] array DIRECTLY under the key)."""
+    k = jax.random.PRNGKey(0)
+    layers = [{"w": jax.random.normal(jax.random.fold_in(k, i), (4, 4))}
+              for i in range(2)]
+    params = {"layers": layers}
+    grads = {"layers": [{"w": jax.random.normal(
+        jax.random.fold_in(k, 10 + i), (4, 4)) * 0.1} for i in range(2)]}
+
+    def run(**kw):
+        tx = fused_lamb(1e-2, weight_decay=0.01, max_grad_norm=None, **kw)
+        s = tx.init(params)
+        u, _ = tx.update(grads, s, params)
+        return u
+
+    got = run()                       # default stacked_key="layers"
+    want = run(stacked_key=None)      # whole-leaf norms, provably per-tensor
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-7, atol=1e-8)
+
+
+def test_flat_meta_unstacked_layers_list_single_segments():
+    from apex_tpu.contrib.optimizers._sharding import flat_meta
+
+    layers = [{"w": jnp.ones((4, 4))} for _ in range(2)]
+    meta = flat_meta({"layers": layers}, 4)
+    assert meta.sub_counts == (1, 1)
+    assert meta.num_tensors == 2
+
+    meta2 = flat_meta({"layers": {"w": jnp.ones((3, 4, 4))}}, 4)
+    assert meta2.sub_counts == (3,)
+    assert meta2.num_tensors == 3
