@@ -206,12 +206,18 @@ def multi_tensor_novograd(
     grad_averaging,
     moment_mode,
     norm_type,
+    stacked=None,
 ):
     """Fused NovoGrad: per-TENSOR second moment (a scalar per tensor).
 
     Ref: csrc/multi_tensor_novograd.cu; norms list is [per-tensor v scalars].
     tensor_lists = [grads, params, exp_avgs]; plus ``norms`` vector argument is
     carried in exp_avg_sq per-tensor scalars, here returned as a vector.
+
+    ``stacked``: per-tensor bools; a True entry marks a lax.scan-stacked
+    [L, ...] tensor whose slices are the reference's per-layer tensors —
+    its second moment is a [L] vector (one scalar per layer slice), kept
+    broadcastable against the slice.
     """
     grads, params, ms, v_scalars = tensor_lists
     lr, b1, b2, eps = _f32(lr), _f32(beta1), _f32(beta2), _f32(eps)
@@ -220,10 +226,15 @@ def multi_tensor_novograd(
     bc2 = 1.0 - b2 ** step if bias_correction else jnp.float32(1.0)
     g_coef = (1.0 - b1) if grad_averaging else jnp.float32(1.0)
     skip = noop_flag
+    if stacked is None:
+        stacked = [False] * len(grads)
     new_p, new_m, new_v = [], [], []
-    for g, p, m, v in zip(grads, params, ms, v_scalars):
+    for g, p, m, v, stk in zip(grads, params, ms, v_scalars, stacked):
         g32, p32, m32, v32 = _f32(g), _f32(p), _f32(m), _f32(v)
-        gnorm2 = jnp.sum(jnp.square(g32))
+        axes = tuple(range(1, g32.ndim)) if stk else None
+        gnorm2 = jnp.sum(jnp.square(g32), axis=axes, keepdims=stk)
+        if stk:
+            v32 = v32.reshape(gnorm2.shape)
         v_n = jnp.where(
             jnp.bool_(step <= 1.0) if moment_mode == 0 else jnp.bool_(False),
             gnorm2,
@@ -235,7 +246,7 @@ def multi_tensor_novograd(
         p_n = p32 - lr * (m_n / bc1)
         new_p.append(jnp.where(skip, p32, p_n).astype(p.dtype))
         new_m.append(jnp.where(skip, m32, m_n).astype(m.dtype))
-        new_v.append(jnp.where(skip, v32, v_n))
+        new_v.append(jnp.where(skip, v32, v_n).reshape(jnp.shape(v)))
     return new_p, new_m, new_v, noop_flag
 
 

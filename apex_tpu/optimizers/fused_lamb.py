@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import optax
 
 from apex_tpu.multi_tensor.functional import multi_tensor_l2norm, multi_tensor_lamb
-from apex_tpu.utils.pytree import is_stacked_path
+from apex_tpu.utils.pytree import stacked_flags
 
 
 class FusedLAMBState(NamedTuple):
@@ -60,11 +60,8 @@ def fused_lamb(
         step = state.step + 1
         lr = learning_rate(step) if callable(learning_rate) else learning_rate
 
-        paths_g, treedef = jax.tree_util.tree_flatten_with_path(grads)
-        leaves_g = [leaf for _, leaf in paths_g]
-        stacked = [
-            is_stacked_path(path, stacked_key) for path, _ in paths_g
-        ] if stacked_key is not None else None
+        leaves_g, treedef = jax.tree.flatten(grads)
+        stacked = stacked_flags(grads, stacked_key)
         leaves_p = treedef.flatten_up_to(params)
         leaves_m = treedef.flatten_up_to(state.exp_avg)
         leaves_v = treedef.flatten_up_to(state.exp_avg_sq)

@@ -10,12 +10,13 @@ import jax.numpy as jnp
 import optax
 
 from apex_tpu.multi_tensor.functional import multi_tensor_novograd
+from apex_tpu.utils.pytree import stacked_flags
 
 
 class FusedNovoGradState(NamedTuple):
     step: jnp.ndarray
     exp_avg: optax.Params
-    exp_avg_sq: optax.Params  # one scalar per leaf
+    exp_avg_sq: optax.Params  # scalar per leaf; [L] per stacked [L, ...] leaf
 
 
 def fused_novograd(
@@ -27,14 +28,26 @@ def fused_novograd(
     bias_correction: bool = True,
     grad_averaging: bool = True,
     moment_mode: int = 0,
+    stacked_key: str | None = "layers",
 ) -> optax.GradientTransformation:
+    """``stacked_key``: dict key marking lax.scan-stacked [L, ...] parameter
+    collections (``testing.stack_layer_params``). NovoGrad's second moment
+    is per TENSOR (one scalar); a stacked leaf gets a [L] vector — one
+    scalar per layer slice, the reference's granularity. ``None`` disables."""
+
     def init_fn(params):
+        flags = stacked_flags(params, stacked_key)
+        leaves, treedef = jax.tree.flatten(params)
+        vs = [
+            jnp.zeros((l.shape[0],), jnp.float32) if stk else jnp.float32(0.0)
+            for l, stk in zip(leaves, flags)
+        ]
         return FusedNovoGradState(
             step=jnp.int32(0),
             exp_avg=jax.tree.map(
                 lambda p: jnp.zeros_like(p, dtype=jnp.float32), params
             ),
-            exp_avg_sq=jax.tree.map(lambda p: jnp.float32(0.0), params),
+            exp_avg_sq=jax.tree.unflatten(treedef, vs),
         )
 
     def update_fn(grads, state, params=None):
@@ -43,6 +56,7 @@ def fused_novograd(
         step = state.step + 1
         lr = learning_rate(step) if callable(learning_rate) else learning_rate
 
+        stacked = stacked_flags(grads, stacked_key)
         leaves_g, treedef = jax.tree.flatten(grads)
         leaves_p = treedef.flatten_up_to(params)
         leaves_m = treedef.flatten_up_to(state.exp_avg)
@@ -52,7 +66,7 @@ def fused_novograd(
             jnp.bool_(False),
             [leaves_g, leaves_p, leaves_m, leaves_v],
             lr, b1, b2, eps, step, bias_correction, weight_decay,
-            grad_averaging, moment_mode, 2,
+            grad_averaging, moment_mode, 2, stacked=stacked,
         )
         updates = [
             (np_.astype(jnp.float32) - jnp.asarray(p).astype(jnp.float32)).astype(

@@ -13,6 +13,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from apex_tpu.utils.pytree import is_stacked_path
+
 
 def larc(
     learning_rate: float,
@@ -20,9 +22,15 @@ def larc(
     clip: bool = True,
     eps: float = 1e-8,
     weight_decay: float = 0.0,
+    stacked_key: str | None = "layers",
 ) -> optax.GradientTransformation:
     """Gradient pre-scaler implementing LARC; chain with any optimizer:
-    ``optax.chain(larc(lr), fused_sgd(lr, momentum=0.9))``."""
+    ``optax.chain(larc(lr), fused_sgd(lr, momentum=0.9))``.
+
+    ``stacked_key``: dict key marking lax.scan-stacked [L, ...] parameter
+    collections (``testing.stack_layer_params``); their adaptive rates are
+    computed per layer slice — the reference's per-parameter granularity.
+    ``None`` disables the detection."""
 
     def init_fn(params):
         del params
@@ -32,11 +40,13 @@ def larc(
         if params is None:
             raise ValueError("larc requires params")
 
-        def scale_one(g, p):
+        def scale_one(path, g, p):
+            stk = is_stacked_path(path, stacked_key)
+            axes = tuple(range(1, jnp.ndim(p))) if stk else None
             g32 = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
-            pn = jnp.sqrt(jnp.sum(p32 * p32))
-            gn = jnp.sqrt(jnp.sum(g32 * g32))
+            pn = jnp.sqrt(jnp.sum(p32 * p32, axis=axes, keepdims=stk))
+            gn = jnp.sqrt(jnp.sum(g32 * g32, axis=axes, keepdims=stk))
             adaptive_lr = (
                 trust_coefficient * pn / (gn + pn * weight_decay + eps)
             )
@@ -53,7 +63,7 @@ def larc(
             g_wd = g32 + weight_decay * p32 if weight_decay else g32
             return (g_wd * factor).astype(g.dtype)
 
-        return jax.tree.map(scale_one, grads, params), state
+        return jax.tree_util.tree_map_with_path(scale_one, grads, params), state
 
     return optax.GradientTransformation(init_fn, update_fn)
 

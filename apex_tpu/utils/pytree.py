@@ -131,3 +131,14 @@ def is_stacked_path(path, stacked_key) -> bool:
                 for rest in path[i + 1:]
             )
     return False
+
+
+def stacked_flags(tree, stacked_key):
+    """Per-leaf stacked booleans for ``tree`` in ``jax.tree.flatten`` order
+    (paths and plain flatten agree on ordering). 0-d leaves are never
+    stacked — there is no leading layer axis to slice."""
+    paths, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [
+        jnp.ndim(leaf) > 0 and is_stacked_path(path, stacked_key)
+        for path, leaf in paths
+    ]

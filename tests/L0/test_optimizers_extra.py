@@ -180,3 +180,67 @@ def test_flat_meta_unstacked_layers_list_single_segments():
     meta2 = flat_meta({"layers": {"w": jnp.ones((3, 4, 4))}}, 4)
     assert meta2.sub_counts == (3,)
     assert meta2.num_tensors == 3
+
+
+def test_novograd_stacked_layers_match_per_layer_tensors():
+    """NovoGrad's per-tensor scalar second moment becomes a [L] vector for
+    scan-stacked collections — each slice must update exactly like the
+    same layer stored as its own tensor (ref: multi_tensor_novograd.cu)."""
+    L = 3
+    k = jax.random.PRNGKey(0)
+    ws = jax.random.normal(k, (L, 4, 4)) * jnp.arange(1, L + 1)[:, None, None]
+    gw = jax.random.normal(jax.random.fold_in(k, 1), (L, 4, 4)) * 0.1
+
+    def run(params, grads):
+        tx = fused_novograd(1e-2, weight_decay=0.01)
+        s = tx.init(params)
+        for _ in range(3):
+            u, s = tx.update(grads, s, params)
+            params = optax.apply_updates(params, u)
+        return params, s
+
+    got, s_got = run({"layers": {"w": ws}}, {"layers": {"w": gw}})
+    want, _ = run({f"l{i}": ws[i] for i in range(L)},
+                  {f"l{i}": gw[i] for i in range(L)})
+    assert s_got.exp_avg_sq["layers"]["w"].shape == (L,)
+    for i in range(L):
+        np.testing.assert_allclose(np.asarray(got["layers"]["w"][i]),
+                                   np.asarray(want[f"l{i}"]),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_larc_stacked_layers_match_per_layer_tensors():
+    """LARC adaptive rates per layer slice for stacked collections (ref:
+    apex/parallel/LARC.py computes one rate per parameter tensor)."""
+    from apex_tpu.optimizers import larc
+
+    L = 3
+    k = jax.random.PRNGKey(0)
+    ws = jax.random.normal(k, (L, 4, 4)) * jnp.arange(1, L + 1)[:, None, None]
+    gw = jax.random.normal(jax.random.fold_in(k, 1), (L, 4, 4)) * 0.1
+
+    def run(params, grads):
+        tx = larc(1e-2, weight_decay=0.01)
+        u, _ = tx.update(grads, tx.init(params), params)
+        return u
+
+    got = run({"layers": {"w": ws}}, {"layers": {"w": gw}})
+    want = run({f"l{i}": ws[i] for i in range(L)},
+               {f"l{i}": gw[i] for i in range(L)})
+    for i in range(L):
+        np.testing.assert_allclose(np.asarray(got["layers"]["w"][i]),
+                                   np.asarray(want[f"l{i}"]),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_novograd_scalar_leaf_under_stacked_key():
+    """A 0-d leaf stored directly under "layers" has no layer axis to
+    slice — it gets an ordinary scalar second moment, not a crash."""
+    tx = fused_novograd(1e-2)
+    p = {"layers": {"w": jnp.zeros((3, 4, 4)), "scale": jnp.float32(1.0)}}
+    s = tx.init(p)
+    assert s.exp_avg_sq["layers"]["w"].shape == (3,)
+    assert s.exp_avg_sq["layers"]["scale"].shape == ()
+    g = jax.tree.map(jnp.ones_like, p)
+    u, s = tx.update(g, s, p)
+    assert all(jnp.all(jnp.isfinite(x)) for x in jax.tree.leaves(u))
