@@ -45,7 +45,17 @@ _HIGHEST = jax.lax.Precision.HIGHEST
 
 def _block_size(s: int) -> int:
     """Block sizes must be multiples of 128 so every dynamic slice is
-    provably lane-aligned for Mosaic."""
+    provably lane-aligned for Mosaic. ``APEX_TPU_FLASH_BLOCK`` overrides
+    the default (tuning knob for benchmarks/bench_step_variants.py); the
+    value is clamped to the padded sequence so tiny probes stay valid."""
+    env = os.environ.get("APEX_TPU_FLASH_BLOCK")
+    if env:
+        b = int(env)
+        if b <= 0 or b % 128:
+            raise ValueError(
+                f"APEX_TPU_FLASH_BLOCK={b} must be a positive multiple of 128"
+            )
+        return min(b, max(128, -(-s // 128) * 128))
     return 128 if s <= 128 else 256
 
 

@@ -80,6 +80,14 @@ class TransformerConfig:
                                    # fails to compile on a single 16 GB
                                    # chip; it is the right policy only
                                    # once state is ZeRO/TP-sharded.
+    fp32_logits: bool = False      # force fp32 INPUTS to the lm-head
+                                   # matmul (3-pass MXU product + 2x
+                                   # logits memory). Default follows
+                                   # Megatron: logits in the compute
+                                   # dtype, fp32 accumulation in the MXU,
+                                   # cross-entropy upcasts per tile. Kept
+                                   # as a flag so the decision stays
+                                   # A/B-measurable (bench_step_variants).
     scan_layers: bool = False      # lax.scan over stacked layer params
                                    # (compile time O(1) in depth; pass
                                    # params through stack_layer_params)
@@ -321,7 +329,12 @@ def transformer_forward(params, tokens, cfg: TransformerConfig, *,
     # the h x vocab product (~9% of model MACs at BERT-large) plus a 2x
     # larger [s, b, v] intermediate. Measured on v5e via
     # benchmarks/bench_step_variants.py (see BASELINE.md).
-    logits = jnp.matmul(x.astype(cfg.dtype), params["embedding"].astype(cfg.dtype).T)
+    ldt = jnp.float32 if cfg.fp32_logits else cfg.dtype
+    logits = jnp.matmul(
+        x.astype(ldt),
+        params["embedding"].astype(ldt).T,
+        preferred_element_type=jnp.float32 if cfg.fp32_logits else None,
+    )
     return logits
 
 

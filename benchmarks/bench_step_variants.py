@@ -84,6 +84,9 @@ def main():
         "no_pallas": (["layer_norm", "rms_norm", "flash_attention",
                        "optim_flat"], "full"),
         "split_bwd": ([], "full"),  # + APEX_TPU_FLASH_SPLIT_BWD=1 env
+        "fp32_logits": ([], "full"),   # pre-round-3 lm-head (fp32 inputs)
+        "flash_b128": ([], "full"),    # + APEX_TPU_FLASH_BLOCK=128
+        "flash_b512": ([], "full"),    # + APEX_TPU_FLASH_BLOCK=512
     }
     for name in which:
         disable, remat_mode = variants[name]
@@ -93,11 +96,16 @@ def main():
             _utils.disable_kernel(k)
         import os as _os
         _os.environ.pop("APEX_TPU_FLASH_SPLIT_BWD", None)
+        _os.environ.pop("APEX_TPU_FLASH_BLOCK", None)
         if name == "split_bwd":
             _os.environ["APEX_TPU_FLASH_SPLIT_BWD"] = "1"
+        if name.startswith("flash_b"):
+            _os.environ["APEX_TPU_FLASH_BLOCK"] = name[len("flash_b"):]
+        cfg_over = {"fp32_logits": True} if name == "fp32_logits" else None
         try:
             step, args = build_step(batch, remat=remat_mode != "none",
-                                    remat_policy=remat_mode)
+                                    remat_policy=remat_mode,
+                                    cfg_over=cfg_over)
             ms = run(step, args)
             print(f"{name:14s} remat={remat_mode:5s}: {ms:8.1f} ms/step  "
                   f"{batch/ms*1e3:6.1f} samples/s", flush=True)

@@ -326,3 +326,25 @@ def test_dbias_guard_honors_any_forced_resident_value(monkeypatch):
     import pytest as _pytest
     with _pytest.raises(NotImplementedError):
         _check_dbias_seq(long, long)
+
+
+def test_flash_block_size_override_parity(monkeypatch):
+    """APEX_TPU_FLASH_BLOCK (bench tuning knob) must not change numerics —
+    fwd and grads match the default blocking."""
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 256, 64))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 256, 64))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 2, 256, 64))
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, use_pallas=True) ** 2)
+
+    monkeypatch.delenv("APEX_TPU_FLASH_BLOCK", raising=False)
+    ref = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setenv("APEX_TPU_FLASH_BLOCK", "128")
+    got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(got, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+    monkeypatch.setenv("APEX_TPU_FLASH_BLOCK", "100")
+    with __import__("pytest").raises(ValueError):
+        flash_attention(q, k, v, use_pallas=True)
