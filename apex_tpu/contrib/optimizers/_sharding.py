@@ -145,3 +145,28 @@ def per_tensor_sq_norms(x_shard, ids_shard, num_tensors: int,
         jnp.square(x_shard), ids_shard, num_segments=num_tensors + 1
     )
     return lax.psum(local, axis_name)[:num_tensors]
+
+
+def finite_all(x, axis_name):
+    """True iff every element of the sharded buffer is finite on every rank
+    (per-element, the reference's multi_tensor chunk inf/nan flags). A
+    naive ``isfinite(psum(sum(x)))`` also trips on a sum OVERFLOW of
+    large-but-finite loss-scaled grads — a spurious step-skip."""
+    return lax.pmin(jnp.all(jnp.isfinite(x)).astype(jnp.int32), axis_name) > 0
+
+
+def clip_by_global_norm(x, max_norm, axis_name=None, scale=1.0, eps=1e-6):
+    """``x * min(1, max_norm / (||x||/scale + eps))``; the square-sum runs
+    over ``axis_name`` too when given (post-allreduce clip). Returns
+    ``(clipped, norm_ok)`` — ``norm_ok`` False means the norm computation
+    itself overflowed to inf on huge-but-finite grads; the clip is then a
+    no-op and the caller must fold ``norm_ok`` into its step-skip (the
+    loss-scaler overflow semantics) instead of letting factor=0 silently
+    zero the gradient."""
+    sq = jnp.sum(jnp.square(x))
+    if axis_name is not None:
+        sq = lax.psum(sq, axis_name)
+    norm = jnp.sqrt(sq) / scale
+    ok = jnp.isfinite(norm)
+    factor = jnp.minimum(1.0, max_norm / (norm + eps))
+    return x * jnp.where(ok, factor, 1.0), ok

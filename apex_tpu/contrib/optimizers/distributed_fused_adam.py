@@ -27,6 +27,8 @@ from jax import lax
 from apex_tpu.contrib.optimizers._sharding import (
     FlatMeta,
     all_gather_flat,
+    clip_by_global_norm,
+    finite_all,
     flat_meta,
     flatten_fp32,
     my_shard,
@@ -108,18 +110,18 @@ class DistributedFusedAdam:
         gshard = gshard / scale
 
         # fused global-norm clip (ref: multi_tensor_l2norm + allreduce)
+        norm_ok = jnp.bool_(True)
         if self.max_grad_norm is not None:
-            sq = lax.psum(jnp.sum(jnp.square(gshard)), ax)
-            gnorm = jnp.sqrt(sq)
-            gshard = gshard * jnp.minimum(
-                1.0, self.max_grad_norm / (gnorm + 1e-6)
+            gshard, norm_ok = clip_by_global_norm(
+                gshard, self.max_grad_norm, ax
             )
 
         if not self.adam_w_mode and self.weight_decay:
             # L2 mode: decay folds into the gradient before the moments
             gshard = gshard + self.weight_decay * state.master
 
-        finite = jnp.isfinite(lax.psum(jnp.sum(gshard), ax))
+        # a non-finite grad element OR a norm overflow skips the step
+        finite = finite_all(gshard, ax) & norm_ok
 
         use_pallas = self.use_pallas
         if use_pallas is None:

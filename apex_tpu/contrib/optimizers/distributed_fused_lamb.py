@@ -25,6 +25,8 @@ from jax import lax
 from apex_tpu.contrib.optimizers._sharding import (
     FlatMeta,
     all_gather_flat,
+    clip_by_global_norm,
+    finite_all,
     flat_meta,
     flatten_fp32,
     my_shard,
@@ -100,24 +102,27 @@ class DistributedFusedLAMB:
         nt = meta.num_tensors
 
         flat_g = flatten_fp32(grads, meta)
+        norm_ok = jnp.bool_(True)
         if not self.clip_after_ar and self.max_grad_norm is not None:
             # pre-allreduce clip (reference's fallback mode). The local
             # grads are still loss-scaled, so the norm is measured in
             # UNSCALED units to keep the threshold comparable to the
-            # post-AR path.
-            lnorm = jnp.sqrt(jnp.sum(jnp.square(flat_g))) / state.global_scale
-            flat_g = flat_g * jnp.minimum(
-                1.0, self.max_grad_norm / (lnorm + 1e-6)
+            # post-AR path; local norm_ok may differ per rank — pmin'd
+            # into the skip below.
+            flat_g, norm_ok = clip_by_global_norm(
+                flat_g, self.max_grad_norm, scale=state.global_scale
             )
         gshard = reduce_scatter_flat(flat_g, ax, mean=self.grad_averaging)
         gshard = gshard / state.global_scale
         if self.clip_after_ar and self.max_grad_norm is not None:
-            gnorm = jnp.sqrt(lax.psum(jnp.sum(jnp.square(gshard)), ax))
-            gshard = gshard * jnp.minimum(
-                1.0, self.max_grad_norm / (gnorm + 1e-6)
+            gshard, norm_ok = clip_by_global_norm(
+                gshard, self.max_grad_norm, ax
             )
 
-        finite = jnp.isfinite(lax.psum(jnp.sum(gshard), ax))
+        # a non-finite grad element OR a norm overflow skips the step
+        finite = finite_all(gshard, ax) & (
+            lax.pmin(norm_ok.astype(jnp.int32), ax) > 0
+        )
 
         def do_update(_):
             t = state.step + 1

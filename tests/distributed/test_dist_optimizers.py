@@ -240,3 +240,54 @@ def test_dist_lamb_stacked_layers_per_layer_trust_ratios():
                                    rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(got["emb"]), np.asarray(want["emb"]),
                                rtol=1e-5, atol=1e-6)
+
+
+def _skip_semantics(opt_cls, **kw):
+    """(steps_after_huge, steps_after_inf) for one huge-but-finite grad
+    step followed by one inf grad step."""
+    mesh = _mesh()
+    params = _params()
+    opt = opt_cls(learning_rate=1e-2, axis_name="data", **kw)
+    opt.prepare(params, N)
+    # 4e37 per element: the 4-rank psum stays finite (1.6e38 < fp32 max)
+    # but a naive sum over the ~30-element shard would overflow to inf
+    huge = jax.tree.map(lambda p: jnp.full(p.shape, 4e37, jnp.float32),
+                        params)
+    inf_g = jax.tree.map(
+        lambda p: jnp.full(p.shape, jnp.inf, jnp.float32), params)
+
+    def train(params):
+        state = opt.init_shard(params)
+        _, state = opt.step(params, huge, state)
+        step_after_huge = state.step
+        _, state = opt.step(params, inf_g, state)
+        return step_after_huge, state.step
+
+    s1, s2 = jax.jit(shard_map(train, mesh=mesh, in_specs=P(),
+                               out_specs=(P(), P())))(params)
+    return int(s1), int(s2)
+
+
+def test_dist_optimizers_huge_finite_grads_not_skipped():
+    """Per-element finiteness check (ref: multi_tensor chunk flags): grads
+    large enough to OVERFLOW a naive fp32 sum-reduction are still finite
+    per element and must not trigger the non-finite step-skip."""
+    for cls in (DistributedFusedAdam, DistributedFusedLAMB):
+        s1, s2 = _skip_semantics(cls, max_grad_norm=None,
+                                 **({"grad_averaging": False}
+                                    if cls is DistributedFusedLAMB else {}))
+        assert s1 == 1, f"{cls.__name__}: huge finite grads wrongly skipped"
+        assert s2 == 1, f"{cls.__name__}: inf grads not skipped"
+
+
+def test_dist_optimizers_clip_norm_overflow_skips_not_zeroes():
+    """With max_grad_norm set, huge-but-finite grads overflow the global
+    sq-norm to inf; the old factor = max/(inf+eps) = 0 silently applied a
+    ZERO-gradient step. Overflow must instead behave like the loss
+    scaler's non-finite path: skip the step."""
+    for cls in (DistributedFusedAdam, DistributedFusedLAMB):
+        s1, s2 = _skip_semantics(cls, max_grad_norm=1.0,
+                                 **({"grad_averaging": False}
+                                    if cls is DistributedFusedLAMB else {}))
+        assert s1 == 0, f"{cls.__name__}: norm-overflow step was applied"
+        assert s2 == 0, f"{cls.__name__}: inf grads not skipped"
