@@ -42,14 +42,35 @@ def _error_payload(msg: str) -> dict:
     }
 
 
+# Best completed measurement so far — the watchdog and the per-batch
+# timeout path both fall back to this, so a hang mid-sweep (e.g. the
+# remote-compile service stalls, observed 2026-07-30) costs the remaining
+# batches, never the whole round's number.
+_SO_FAR = {"best": None, "sweep": [], "kernels": None}
+
+
+def _partial_payload(note: str):
+    best = _SO_FAR["best"]
+    if best is None:
+        return _error_payload(note)
+    return _success_payload(best, _SO_FAR["sweep"], _SO_FAR["kernels"],
+                            note=note)
+
+
+def _emit_partial_and_exit(note: str):
+    payload = _partial_payload(note)
+    emit(payload)
+    os._exit(0 if payload.get("ok") else 3)
+
+
 def _watchdog(seconds: float):
     """TPU backend init in this container can HANG (not raise) — round 1
     lost its only hardware run to a bare traceback, and a hang would lose
-    it to rc=124. Guarantee ONE JSON line, whatever happens."""
+    it to rc=124. Guarantee ONE JSON line, whatever happens — and if part
+    of the sweep already measured, report THAT instead of an error."""
 
     def fire():
-        emit(_error_payload(f"watchdog: bench exceeded {seconds:.0f}s"))
-        os._exit(3)
+        _emit_partial_and_exit(f"watchdog: bench exceeded {seconds:.0f}s")
 
     t = threading.Timer(seconds, fire)
     t.daemon = True
@@ -191,6 +212,54 @@ def _measure(step, args, iters: int):
     return compile_s, (time.perf_counter() - t0) / iters, xla_flops
 
 
+def _success_payload(best, sweep, kernels, note=None):
+    payload = {
+        "metric": _METRIC,
+        "value": best["samples_per_sec"],
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(best["mfu"] / 0.50, 4),
+        "ok": True,
+        "detail": {
+            "mfu": best["mfu"],
+            "step_ms": best["step_ms"],
+            "batch": best["batch"],
+            "seq": best.get("seq"),
+            "device": best.get("device"),
+            "config": best.get("config"),
+            "sweep": sweep,
+            "kernels": kernels,
+        },
+    }
+    if note:
+        payload["detail"]["note"] = note
+    return payload
+
+
+def _measure_with_timeout(step, args, iters, timeout_s):
+    """Run _measure in a worker thread with a deadline. A hung remote
+    compile cannot be interrupted from Python, so on timeout the caller
+    must stop the sweep (the worker still holds the device client) and
+    emit what it has; the daemon thread dies with the process."""
+    box = {}
+
+    def work():
+        try:
+            box["result"] = _measure(step, args, iters)
+        except BaseException as e:  # noqa: BLE001 — must never lose the round
+            box["error"] = e
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return None, "hung"
+    if "error" in box:
+        return None, box["error"]
+    if "result" not in box:
+        return None, RuntimeError("measure worker died without result")
+    return box["result"], None
+
+
 def main():
     from jax.sharding import Mesh, PartitionSpec as P
 
@@ -212,6 +281,7 @@ def main():
     # pinned to its jnp fallback HERE, so the measurement below always runs
     # (round-2 lesson: one bad block spec must cost a log line, not the bench)
     kernel_report = apex_tpu.preflight()
+    _SO_FAR["kernels"] = kernel_report
 
     if on_cpu:
         cfg = TransformerConfig(
@@ -237,7 +307,7 @@ def main():
 
     mesh = Mesh([dev], ("model",))
     s = cfg.seq_len
-    sweep = []
+    sweep = _SO_FAR["sweep"]  # shared: partial emitters see live appends
     best = None
     for batch in batches:
         params = stack_layer_params(transformer_init(jax.random.PRNGKey(0), cfg))
@@ -271,15 +341,23 @@ def main():
             (specs, sspec),
         ), donate_argnums=(0, 1))
 
-        try:
-            compile_s, dt, xla_flops = _measure(
-                step, (params, state, tokens, labels, loss_mask),
-                iters=5 if on_cpu else 20,
-            )
-        except Exception as e:  # noqa: BLE001 — e.g. OOM at large batch
-            print(f"bench: batch {batch} failed: {e}", file=sys.stderr)
-            sweep.append({"batch": batch, "error": str(e).splitlines()[0][:200]})
+        result, err = _measure_with_timeout(
+            step, (params, state, tokens, labels, loss_mask),
+            iters=5 if on_cpu else 20,
+            timeout_s=float(os.environ.get("BENCH_BATCH_TIMEOUT_S", "900")),
+        )
+        if err == "hung":
+            # the worker still holds the device client; further batches
+            # would hang behind it — emit what we have and stop
+            print(f"bench: batch {batch} hung; truncating sweep",
+                  file=sys.stderr)
+            sweep.append({"batch": batch, "error": "compile/measure hung"})
+            _emit_partial_and_exit(f"sweep truncated: batch {batch} hung")
+        if err is not None:  # e.g. OOM at large batch
+            print(f"bench: batch {batch} failed: {err}", file=sys.stderr)
+            sweep.append({"batch": batch, "error": str(err).splitlines()[0][:200]})
             continue
+        compile_s, dt, xla_flops = result
         flops = _hand_flops(cfg, batch)
         mfu = flops / dt / peak_flops(dev)
         row = {
@@ -291,34 +369,18 @@ def main():
             "hand_flops": flops,
             "xla_flops": xla_flops,
         }
+        row["seq"] = s
+        row["device"] = str(dev)
+        row["config"] = "toy-cpu" if on_cpu else "bert-large"
         sweep.append(row)
         if best is None or row["samples_per_sec"] > best["samples_per_sec"]:
             best = row
+            _SO_FAR["best"] = row
 
     if best is None:
         raise RuntimeError(f"all batch sizes failed: {sweep}")
 
-    print(
-        json.dumps(
-            {
-                "metric": _METRIC,
-                "value": best["samples_per_sec"],
-                "unit": "samples/sec/chip",
-                "vs_baseline": round(best["mfu"] / 0.50, 4),
-                "ok": True,
-                "detail": {
-                    "mfu": best["mfu"],
-                    "step_ms": best["step_ms"],
-                    "batch": best["batch"],
-                    "seq": s,
-                    "device": str(dev),
-                    "config": "toy-cpu" if on_cpu else "bert-large",
-                    "sweep": sweep,
-                    "kernels": kernel_report,
-                },
-            }
-        )
-    )
+    emit(_success_payload(best, sweep, kernel_report))
 
 
 if __name__ == "__main__":
@@ -326,10 +388,12 @@ if __name__ == "__main__":
     try:
         main()
         dog.cancel()
-    except BaseException as e:  # noqa: BLE001 — ALWAYS emit the JSON line
+    except BaseException as e:  # noqa: BLE001 — ALWAYS emit the JSON line;
+        # if part of the sweep measured, report that instead of an error
         dog.cancel()
         import traceback
 
         traceback.print_exc(file=sys.stderr)
-        emit(_error_payload(f"{type(e).__name__}: {e}"))
-        sys.exit(3)
+        payload = _partial_payload(f"{type(e).__name__}: {e}")
+        emit(payload)
+        sys.exit(0 if payload.get("ok") else 3)
