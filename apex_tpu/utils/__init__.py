@@ -12,3 +12,9 @@ from apex_tpu.utils.dtypes import (  # noqa: F401
     is_float,
     default_half_dtype,
 )
+from apex_tpu.utils.metrics import (  # noqa: F401
+    StepCounters,
+    init_counters,
+    step_metrics,
+    update_counters,
+)

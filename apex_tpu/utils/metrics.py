@@ -1,0 +1,76 @@
+"""Per-step training observability — the reference's minimalist idiom.
+
+Ref: apex keeps no metrics registry; observability is the loss-scale
+printouts (`apex/amp/_amp_state.py::maybe_print` on scale changes) and
+whatever the examples log per step (loss, grad norm —
+`examples/imagenet/main_amp.py`). SURVEY §6 prescribes the same
+minimalism for the rebuild: one optional per-step scalar dict, fully
+device-side so it adds no host sync inside jit — the caller decides when
+(or whether) to pull values to the host.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.utils.pytree import tree_global_norm
+
+
+class StepCounters(NamedTuple):
+    """Device-side cumulative counters (carry them in the train state).
+
+    For amp training loops prefer passing the AmpOptState to
+    ``step_metrics(opt_state=...)`` — it already carries the overflow
+    count (``skipped_steps``, incremented from the axes-reduced flag), so
+    a separate StepCounters would double-count state that can drift.
+    StepCounters is for loops NOT using the amp optimizer wrapper."""
+
+    steps: jnp.ndarray           # i32[] total optimizer steps attempted
+    overflows: jnp.ndarray       # i32[] steps skipped on non-finite grads
+
+
+def init_counters() -> StepCounters:
+    return StepCounters(steps=jnp.int32(0), overflows=jnp.int32(0))
+
+
+def update_counters(counters: StepCounters, found_inf) -> StepCounters:
+    found_inf = jnp.asarray(found_inf)
+    return StepCounters(
+        steps=counters.steps + 1,
+        overflows=counters.overflows + found_inf.astype(jnp.int32),
+    )
+
+
+def step_metrics(
+    loss=None,
+    grads=None,
+    scaler_state=None,
+    found_inf=None,
+    counters: Optional[StepCounters] = None,
+    opt_state=None,
+) -> dict:
+    """Build the per-step scalar dict (loss, grad_norm, loss_scale,
+    found_inf, overflow/step counts). Every value is a device scalar;
+    jit-safe. Pass only what you have — absent inputs are omitted.
+
+    ``opt_state``: an ``amp.AmpOptState`` — reads its scaler scale and
+    ``skipped_steps`` overflow count (single source of truth for amp
+    loops; don't also pass ``counters``)."""
+    out = {}
+    if loss is not None:
+        out["loss"] = jnp.asarray(loss, jnp.float32)
+    if grads is not None:
+        out["grad_norm"] = tree_global_norm(grads)
+    if scaler_state is not None:
+        out["loss_scale"] = scaler_state.scale
+    if found_inf is not None:
+        out["found_inf"] = jnp.asarray(found_inf)
+    if counters is not None:
+        out["steps"] = counters.steps
+        out["overflow_count"] = counters.overflows
+    if opt_state is not None:
+        out["loss_scale"] = opt_state.scaler.scale
+        out["overflow_count"] = opt_state.skipped_steps
+    return out

@@ -1,0 +1,131 @@
+"""DDP ordering/aliasing invariants — the TPU analog of the reference's
+race regression test (tests/distributed/DDP/ddp_race_condition_test.py):
+CUDA bucket/stream races cannot exist under XLA, so what must hold
+instead is that the MATH is invariant to everything the reference's race
+could perturb — bucket boundaries, leaf visit order, buffer reuse."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+shard_map = functools.partial(jax.shard_map, check_vma=False)
+
+from apex_tpu.parallel import DistributedDataParallel
+
+N = 4
+
+
+def _mesh():
+    return Mesh(jax.devices("cpu")[:N], ("data",))
+
+
+def _grads(seed=0):
+    k = jax.random.PRNGKey(seed)
+    return {
+        "a": jax.random.normal(k, (37, 5)),
+        "b": {"w": jax.random.normal(jax.random.fold_in(k, 1), (129,)),
+              "h": jax.random.normal(jax.random.fold_in(k, 2), (8, 8)
+                                     ).astype(jnp.bfloat16)},
+        "c": jax.random.normal(jax.random.fold_in(k, 3), (1,)),
+    }
+
+
+def _run(ddp, grads):
+    mesh = _mesh()
+    f = shard_map(lambda g: ddp.allreduce_gradients(g), mesh=mesh,
+                  in_specs=P(), out_specs=P())
+    return jax.jit(f)(grads)
+
+
+def test_bucket_boundaries_do_not_change_math():
+    """Any message_size (1 byte = every leaf its own bucket, up to one
+    giant bucket) must produce bitwise-identical averaged grads — the
+    invariant behind the reference's bucket-race test."""
+    grads = _grads()
+    ref = _run(DistributedDataParallel(message_size=2 ** 30), grads)
+    for msg in (1, 512, 2 ** 20):
+        got = _run(DistributedDataParallel(message_size=msg), grads)
+        for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(ref)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_leaf_order_does_not_change_math():
+    """Permuting the leaf visit order (list reordering re-buckets
+    everything) leaves each leaf's reduced value unchanged."""
+    leaves = jax.tree.leaves(_grads())
+    ddp = DistributedDataParallel(message_size=300)
+    fwd = _run(ddp, leaves)
+    rev = _run(ddp, leaves[::-1])
+    for a, b in zip(fwd, rev[::-1]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_donation_aliasing_safe():
+    """Buffer donation (the XLA analog of the reference's in-place bucket
+    reuse) must not corrupt results: two runs from identical fresh inputs
+    agree, and a donated run agrees with a non-donated one."""
+    mesh = _mesh()
+    ddp = DistributedDataParallel(message_size=512)
+    f = shard_map(lambda g: ddp.allreduce_gradients(g), mesh=mesh,
+                  in_specs=P(), out_specs=P())
+    plain = jax.jit(f)
+    donating = jax.jit(f, donate_argnums=0)
+    ref = plain(_grads(7))
+    got = donating(_grads(7))
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(ref)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_nan_propagates_not_hidden():
+    """A NaN in any leaf must survive the bucketed reduce (the loss
+    scaler's overflow detection depends on it) — no bucket path may mask
+    it with a fallback value."""
+    grads = _grads()
+    grads["b"]["w"] = grads["b"]["w"].at[7].set(jnp.nan)
+    out = _run(DistributedDataParallel(message_size=64), grads)
+    assert bool(jnp.isnan(out["b"]["w"][7]))
+    assert bool(jnp.all(jnp.isfinite(out["a"])))
+
+
+def test_step_metrics_device_side():
+    """SURVEY §6 observability: the per-step scalar dict is jit-safe and
+    counts overflows device-side."""
+    from apex_tpu.utils import init_counters, step_metrics, update_counters
+
+    @jax.jit
+    def step(counters, grads, found_inf):
+        counters = update_counters(counters, found_inf)
+        return counters, step_metrics(
+            loss=1.5, grads=grads, found_inf=found_inf, counters=counters)
+
+    c = init_counters()
+    g = _grads()
+    c, m = step(c, g, jnp.bool_(False))
+    c, m = step(c, g, jnp.bool_(True))
+    assert int(m["steps"]) == 2 and int(m["overflow_count"]) == 1
+    assert float(m["grad_norm"]) > 0 and float(m["loss"]) == 1.5
+
+
+def test_step_metrics_amp_opt_state():
+    """amp loops read overflow counts straight from AmpOptState —
+    step_metrics must surface skipped_steps/loss scale from it, and
+    update_counters must accept host bools."""
+    from apex_tpu import amp
+    from apex_tpu.optimizers import fused_adam
+    from apex_tpu.utils import init_counters, step_metrics, update_counters
+
+    params = {"w": jnp.ones((4, 4))}
+    _, params, opt = amp.initialize(lambda p: jnp.sum(p["w"]), params,
+                                    fused_adam(1e-2), opt_level="O2",
+                                    verbosity=0)
+    state = opt.init(params)
+    bad = {"w": jnp.full((4, 4), jnp.inf)}
+    _, state = opt.apply_gradients(bad, state, params)
+    m = step_metrics(opt_state=state)
+    assert int(m["overflow_count"]) == 1
+    assert float(m["loss_scale"]) > 0
+    c = update_counters(init_counters(), True)   # host bool accepted
+    assert int(c.overflows) == 1
