@@ -19,6 +19,10 @@ from apex_tpu.transformer.fused_softmax import (
     GenericScaledMaskedSoftmax,
 )
 from apex_tpu.transformer.grad_scaler import GradScaler
+from apex_tpu.transformer.log_util import (  # noqa: F401
+    get_transformer_logger,
+    set_logging_level,
+)
 from apex_tpu.transformer.microbatches import (
     build_num_microbatches_calculator,
     ConstantNumMicroBatchesCalculator,

@@ -1,0 +1,28 @@
+"""Logging minimalism for the transformer package.
+
+Ref: apex/transformer/log_util.py — ``get_transformer_logger`` returns a
+namespaced stdlib logger and ``set_logging_level`` adjusts the package
+logger's threshold; apex deliberately has no metrics registry beyond
+this (SURVEY §6). Per-step scalars live in ``apex_tpu.utils.metrics``.
+"""
+
+from __future__ import annotations
+
+import logging
+
+_PACKAGE = "apex_tpu.transformer"
+
+
+def get_transformer_logger(name: str | None = None) -> logging.Logger:
+    """Namespaced logger (``apex_tpu.transformer[.name]``)."""
+    return logging.getLogger(f"{_PACKAGE}.{name}" if name else _PACKAGE)
+
+
+def set_logging_level(verbosity) -> None:
+    """Set the package logger's threshold. Accepts a stdlib level number
+    or name ("DEBUG", "INFO", ...) — ref: set_logging_level(verbosity)."""
+    if isinstance(verbosity, str):
+        verbosity = logging.getLevelName(verbosity.upper())
+        if not isinstance(verbosity, int):
+            raise ValueError(f"unknown logging level name: {verbosity}")
+    get_transformer_logger().setLevel(verbosity)

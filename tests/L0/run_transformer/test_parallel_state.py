@@ -105,3 +105,23 @@ def test_virtual_pipeline_rank_bookkeeping(eight_cpu_devices):
     assert parallel_state.get_virtual_pipeline_model_parallel_rank() == 0
     parallel_state.set_virtual_pipeline_model_parallel_rank(1)
     assert parallel_state.get_virtual_pipeline_model_parallel_rank() == 1
+
+
+def test_log_util_parity():
+    """Ref: apex/transformer/log_util.py — namespaced logger + level
+    setter by name or number."""
+    import logging
+
+    from apex_tpu.transformer import get_transformer_logger, set_logging_level
+
+    lg = get_transformer_logger("tensor_parallel")
+    assert lg.name == "apex_tpu.transformer.tensor_parallel"
+    set_logging_level("DEBUG")
+    assert get_transformer_logger().level == logging.DEBUG
+    set_logging_level(logging.WARNING)
+    assert get_transformer_logger().level == logging.WARNING
+    try:
+        set_logging_level("NOT_A_LEVEL")
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
