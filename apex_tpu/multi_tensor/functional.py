@@ -24,6 +24,8 @@ from typing import List, Sequence
 
 import jax.numpy as jnp
 
+from apex_tpu.utils.pytree import stacked_sq_sum, tree_global_norm
+
 Tensors = Sequence[jnp.ndarray]
 
 
@@ -71,8 +73,6 @@ def multi_tensor_l2norm(noop_flag, tensor_lists, per_tensor=False):
     and clip_grad_norm. Single source of truth for the reduction is
     ``apex_tpu.utils.pytree.tree_global_norm``.
     """
-    from apex_tpu.utils.pytree import tree_global_norm
-
     (xs,) = tensor_lists
     if not xs:
         z = jnp.float32(0.0)
@@ -231,8 +231,7 @@ def multi_tensor_novograd(
     new_p, new_m, new_v = [], [], []
     for g, p, m, v, stk in zip(grads, params, ms, v_scalars, stacked):
         g32, p32, m32, v32 = _f32(g), _f32(p), _f32(m), _f32(v)
-        axes = tuple(range(1, g32.ndim)) if stk else None
-        gnorm2 = jnp.sum(jnp.square(g32), axis=axes, keepdims=stk)
+        gnorm2 = stacked_sq_sum(g32, stk)
         if stk:
             v32 = v32.reshape(gnorm2.shape)
         v_n = jnp.where(
@@ -311,9 +310,8 @@ def multi_tensor_lamb(
             update = update + weight_decay * p32
         # stacked [L, ...] leaf: one norm PER LAYER SLICE (broadcasts back
         # over the slice); plain leaf: one scalar norm for the whole tensor
-        axes = tuple(range(1, p32.ndim)) if stk else None
-        w_norm = jnp.sqrt(jnp.sum(jnp.square(p32), axis=axes, keepdims=stk))
-        u_norm = jnp.sqrt(jnp.sum(jnp.square(update), axis=axes, keepdims=stk))
+        w_norm = jnp.sqrt(stacked_sq_sum(p32, stk))
+        u_norm = jnp.sqrt(stacked_sq_sum(update, stk))
         if weight_decay != 0.0 or use_nvlamb:
             ratio = jnp.where(
                 (w_norm > 0) & (u_norm > 0), w_norm / u_norm, jnp.float32(1.0)

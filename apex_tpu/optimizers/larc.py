@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from apex_tpu.utils.pytree import is_stacked_path
+from apex_tpu.utils.pytree import is_stacked_path, stacked_sq_sum
 
 
 def larc(
@@ -42,11 +42,10 @@ def larc(
 
         def scale_one(path, g, p):
             stk = is_stacked_path(path, stacked_key)
-            axes = tuple(range(1, jnp.ndim(p))) if stk else None
             g32 = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
-            pn = jnp.sqrt(jnp.sum(p32 * p32, axis=axes, keepdims=stk))
-            gn = jnp.sqrt(jnp.sum(g32 * g32, axis=axes, keepdims=stk))
+            pn = jnp.sqrt(stacked_sq_sum(p32, stk))
+            gn = jnp.sqrt(stacked_sq_sum(g32, stk))
             adaptive_lr = (
                 trust_coefficient * pn / (gn + pn * weight_decay + eps)
             )

@@ -142,3 +142,12 @@ def stacked_flags(tree, stacked_key):
         jnp.ndim(leaf) > 0 and is_stacked_path(path, stacked_key)
         for path, leaf in paths
     ]
+
+
+def stacked_sq_sum(x, stacked: bool):
+    """Sum of squares for per-tensor statistics: one scalar for a plain
+    tensor, one value PER LEADING SLICE (keepdims, broadcastable back) for
+    a lax.scan-stacked [L, ...] tensor. The shared reduction behind LAMB
+    trust ratios, NovoGrad second moments, and LARC adaptive rates."""
+    axes = tuple(range(1, jnp.ndim(x))) if stacked else None
+    return jnp.sum(jnp.square(x), axis=axes, keepdims=stacked)
