@@ -39,8 +39,26 @@ class AmpOptState(NamedTuple):
 
     inner: Any
     master: Optional[Any]        # fp32 master params (O2) or None
-    scaler: ScalerState
+    scaler: ScalerState          # one ScalerState, or a tuple of them when
+                                 # initialize(num_losses=N > 1) — ref: apex
+                                 # keeps one LossScaler per loss_id
     skipped_steps: jnp.ndarray   # i32[] count of overflow-skipped steps
+
+
+def _is_multi(scaler_state) -> bool:
+    # ScalerState is itself a NamedTuple, so isinstance(x, tuple) cannot
+    # distinguish one scaler from a tuple of them
+    return not isinstance(scaler_state, ScalerState)
+
+
+def _scaler_at(scaler_state, loss_id: int):
+    n = len(scaler_state) if _is_multi(scaler_state) else 1
+    if not 0 <= loss_id < n:
+        raise ValueError(
+            f"loss_id={loss_id} out of range: amp was initialized with "
+            f"num_losses={n}"
+        )
+    return scaler_state[loss_id] if _is_multi(scaler_state) else scaler_state
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,6 +74,8 @@ class AmpOptimizer:
     tx: Any                      # optax.GradientTransformation
     policy: Policy
     scaler: LossScaler
+    num_losses: int = 1          # ref: amp.initialize(num_losses=N) — one
+                                 # independent dynamic scaler per loss
     # Original (pre-cast) fp32 params captured by ``initialize`` so O2 master
     # weights start from the TRUE fp32 values, not an upcast of the half-cast
     # copy (ref: _process_optimizer keeps the original fp32 tensors as
@@ -69,18 +89,22 @@ class AmpOptimizer:
         else:
             master = None
         target = master if master is not None else params
+        scaler = (self.scaler.init() if self.num_losses == 1
+                  else tuple(self.scaler.init()
+                             for _ in range(self.num_losses)))
         return AmpOptState(
             inner=self.tx.init(target),
             master=master,
-            scaler=self.scaler.init(),
+            scaler=scaler,
             skipped_steps=jnp.int32(0),
         )
 
-    def scale_loss(self, loss, state: AmpOptState):
-        return self.scaler.scale_loss(state.scaler, loss)
+    def scale_loss(self, loss, state: AmpOptState, loss_id: int = 0):
+        return self.scaler.scale_loss(
+            _scaler_at(state.scaler, loss_id), loss)
 
     def apply_gradients(self, grads, state: AmpOptState, params,
-                        found_inf_axes=()):
+                        found_inf_axes=(), loss_id: int = 0):
         """Returns ``(new_params, new_state)`` with overflow-safe semantics.
 
         ``found_inf_axes``: mesh axis names to reduce the overflow flag
@@ -88,10 +112,16 @@ class AmpOptimizer:
         MP-aware GradScaler (allreduce found_inf across the model-parallel
         group so all TP/PP ranks skip steps together). Pass e.g.
         ``("model",)`` when grads are TP-sharded inside shard_map.
+
+        ``loss_id``: which scaler produced these grads (num_losses > 1;
+        ref: apex scale_loss(loss, optimizer, loss_id) — each loss keeps
+        an independent dynamic scale, and only the scaler that scaled
+        THIS backward is updated by the step).
         """
         import optax
 
-        grads32, found_inf = self.scaler.unscale(state.scaler, grads)
+        this_scaler = _scaler_at(state.scaler, loss_id)
+        grads32, found_inf = self.scaler.unscale(this_scaler, grads)
         for ax in found_inf_axes:
             found_inf = jax.lax.psum(
                 found_inf.astype(jnp.float32), ax
@@ -120,10 +150,16 @@ class AmpOptimizer:
             new_master = None
             new_params = new_target
 
+        new_scaler = self.scaler.update(this_scaler, found_inf)
+        if _is_multi(state.scaler):
+            new_scaler = tuple(
+                new_scaler if i == loss_id else s
+                for i, s in enumerate(state.scaler)
+            )
         new_state = AmpOptState(
             inner=inner_new,
             master=new_master,
-            scaler=self.scaler.update(state.scaler, found_inf),
+            scaler=new_scaler,
             skipped_steps=state.skipped_steps + found_inf.astype(jnp.int32),
         )
         return new_params, new_state
@@ -137,13 +173,34 @@ class AmpOptimizer:
         return params
 
     def state_dict(self, state: AmpOptState) -> dict:
-        d = self.scaler.state_dict(state.scaler)
+        if _is_multi(state.scaler):
+            # ref: amp.state_dict() keys one entry per loss scaler
+            d = {
+                f"loss_scaler{i}": self.scaler.state_dict(s)
+                for i, s in enumerate(state.scaler)
+            }
+        else:
+            d = self.scaler.state_dict(state.scaler)
         d["skipped_steps"] = state.skipped_steps
         return d
 
     def load_state_dict(self, state: AmpOptState, d: dict) -> AmpOptState:
+        if _is_multi(state.scaler):
+            saved = sorted(k for k in d if k.startswith("loss_scaler"))
+            if len(saved) != len(state.scaler):
+                raise ValueError(
+                    f"checkpoint has {len(saved)} loss scalers "
+                    f"({saved}) but amp was initialized with "
+                    f"num_losses={len(state.scaler)}"
+                )
+            scaler = tuple(
+                self.scaler.load_state_dict(d[f"loss_scaler{i}"])
+                for i in range(len(state.scaler))
+            )
+        else:
+            scaler = self.scaler.load_state_dict(d)
         return state._replace(
-            scaler=self.scaler.load_state_dict(d),
+            scaler=scaler,
             skipped_steps=jnp.int32(d.get("skipped_steps", 0)),
         )
 
@@ -161,6 +218,7 @@ def initialize(
     loss_scale=None,
     half_dtype=None,
     keep_fp32_predicate=None,
+    num_losses: int = 1,
     verbosity: int = 1,
 ):
     """Set up mixed-precision training (ref: apex/amp/frontend.py::initialize).
@@ -200,20 +258,23 @@ def initialize(
         tx=optimizer,
         policy=policy,
         scaler=policy.make_scaler(),
+        num_losses=num_losses,
         master_source=params if policy.master_weights else None,
     )
     return wrapped_model_fn, cast_params, amp_opt
 
 
-def scale_loss(loss, opt_state_or_scaler):
+def scale_loss(loss, opt_state_or_scaler, loss_id: int = 0):
     """Scale a loss by the current dynamic scale.
 
     Accepts an :class:`AmpOptState` or a :class:`ScalerState`. Functional form
-    of the reference's ``with amp.scale_loss(loss, optimizer):`` context —
-    unscaling happens inside ``AmpOptimizer.apply_gradients``.
+    of the reference's ``with amp.scale_loss(loss, optimizer, loss_id):``
+    context — unscaling happens inside ``AmpOptimizer.apply_gradients``
+    (pass the same ``loss_id`` there).
     """
     s = opt_state_or_scaler
-    scaler_state = s.scaler if isinstance(s, AmpOptState) else s
+    scaler_state = (_scaler_at(s.scaler, loss_id)
+                    if isinstance(s, AmpOptState) else s)
     return (loss.astype(jnp.float32) * scaler_state.scale).astype(loss.dtype)
 
 

@@ -105,8 +105,7 @@ def main():
         ref_mesh = Mesh(np.array(devs[:1]), ("model",))
         ref_loss = jax.jit(smap(
             lambda p, t: gpt_loss(p, t, ref_cfg), ref_mesh,
-            (pspec, P()), P()))(
-                jax.tree.map(lambda x: x, params), tokens)
+            (pspec, P()), P()))(params, tokens)
         cp_loss = jax.jit(smap(
             lambda p, t: gpt_loss(p, t, cfg), mesh,
             (pspec, P(None, "context")), P()))(params, tokens)

@@ -160,3 +160,51 @@ def test_amp_state_dict_roundtrip():
     d = amp.state_dict(opt, state)
     state2 = amp.load_state_dict(opt, state, jax.tree.map(np.asarray, d))
     assert float(state2.scaler.scale) == float(state.scaler.scale)
+
+
+def test_num_losses_independent_scalers():
+    """Ref: amp.initialize(num_losses=N) + scale_loss(..., loss_id=i) —
+    each loss keeps an independent dynamic scaler; an overflow in loss 1's
+    backward backs off scaler 1 only, and state_dict round-trips all of
+    them (loss_scaler{i} keys, the reference layout)."""
+    from apex_tpu.optimizers import fused_adam
+    params = {"w": jnp.ones((4, 4), jnp.bfloat16)}
+    model_fn, params, opt = amp.initialize(
+        lambda p, x: jnp.sum(p["w"].astype(jnp.float32) * x), params,
+        fused_adam(1e-3), opt_level="O2", num_losses=2, verbosity=0)
+    state = opt.init(params)
+    assert len(state.scaler) == 2
+    x = jnp.ones((4, 4))
+
+    # loss 0: clean step — scaler 0 untouched (growth tracker advances)
+    g0 = jax.grad(lambda p: amp.scale_loss(model_fn(p, x), state, 0))(params)
+    params, state = opt.apply_gradients(g0, state, params, loss_id=0)
+
+    # loss 1: poisoned grads — only scaler 1 backs off
+    g_bad = {"w": jnp.full((4, 4), jnp.inf, jnp.bfloat16)}
+    before = (float(state.scaler[0].scale), float(state.scaler[1].scale))
+    # several overflow steps: exhausts default hysteresis and keeps halving
+    for _ in range(8):
+        params, state = opt.apply_gradients(g_bad, state, params, loss_id=1)
+    after = (float(state.scaler[0].scale), float(state.scaler[1].scale))
+    assert after[0] == before[0], "scaler 0 must be untouched by loss 1"
+    assert after[1] < before[1], "scaler 1 must back off on overflow"
+    assert int(state.skipped_steps) == 8
+
+    # state_dict round-trip with per-loss keys
+    d = opt.state_dict(state)
+    assert "loss_scaler0" in d and "loss_scaler1" in d
+    restored = opt.load_state_dict(opt.init(params), d)
+    assert float(restored.scaler[1].scale) == after[1]
+    assert int(restored.skipped_steps) == 8
+
+    # loss_id out of range on a single-scaler setup errors clearly
+    _, p1, opt1 = amp.initialize(
+        lambda p, x: jnp.sum(p["w"] * x), {"w": jnp.ones((2, 2))},
+        fused_adam(1e-3), opt_level="O1", verbosity=0)
+    s1 = opt1.init(p1)
+    try:
+        amp.scale_loss(jnp.float32(1.0), s1, 1)
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
