@@ -32,9 +32,6 @@ from apex_tpu.normalization.fused_layer_norm import (  # noqa: F401 — re-expor
 )
 from apex_tpu.ops.attention import flash_attention
 
-_MASK_FILL = -30000.0  # finite in bf16/fp16; matches the reference softmax fill
-
-
 def swish(x):
     """SiLU. XLA fuses this into the producing matmul's epilogue."""
     return x * jax.nn.sigmoid(x)
@@ -61,32 +58,19 @@ def mha(q, k, v, *, mask=None, bias=None, gate=None, use_pallas=None):
     ``bias`` is the additive pair bias broadcastable to
     ``(*batch, heads, seq_q, seq_k)``. ``gate`` matches q's shape.
     """
-    *lead, h, s_q, d = q.shape
-    s_k = k.shape[-2]
-    b = 1
-    for n in lead:
-        b *= n
-
-    def flat(x):
-        return x.reshape((b,) + x.shape[len(lead):])
-
-    add_bias = None
-    if bias is not None:
-        add_bias = jnp.broadcast_to(
-            bias.astype(jnp.float32), tuple(lead) + (h, s_q, s_k)
-        ).reshape(b, h, s_q, s_k)
-    if mask is not None:
-        mask_bias = jnp.where(mask, 0.0, _MASK_FILL).astype(jnp.float32)
-        mask_bias = jnp.broadcast_to(
-            mask_bias, tuple(lead) + (mask.shape[-3], mask.shape[-2], s_k)
-        ).reshape(b, mask.shape[-3], mask.shape[-2], s_k)
-        add_bias = mask_bias if add_bias is None else add_bias + mask_bias
-
+    # The boolean mask rides flash_attention's MASK path (True = MASKED
+    # there, = attend here): no bias gradient is wanted for it, so the
+    # backward stays O(block) — folding it into ``bias`` would force the
+    # dense dbias pass and refuse streaming lengths. Only a real pair
+    # bias is differentiable. A fully-masked query row returns 0 (the
+    # flash kernel's gradient-safe convention) rather than the
+    # reference's uniform -30000-fill attention; OpenFold never attends
+    # from fully-masked rows, so the difference is unobservable there.
     o = flash_attention(
-        flat(q), flat(k), flat(v), bias=add_bias, causal=False,
-        use_pallas=use_pallas,
+        q, k, v, bias=bias,
+        mask=None if mask is None else ~jnp.asarray(mask, bool),
+        causal=False, use_pallas=use_pallas,
     )
-    o = o.reshape(q.shape)
     if gate is not None:
         o = (o.astype(jnp.float32) * jax.nn.sigmoid(gate.astype(jnp.float32))).astype(o.dtype)
     return o

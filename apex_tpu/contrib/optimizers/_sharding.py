@@ -37,17 +37,15 @@ def flat_meta(params, n_shards: int,
     (``testing.stack_layer_params``). Each such leaf contributes L segment
     ids — one per layer slice — so per-tensor bookkeeping (LAMB trust
     ratios) keeps the reference's per-layer-tensor granularity."""
-    from apex_tpu.utils.pytree import is_stacked_path
+    from apex_tpu.utils.pytree import stacked_flags
 
-    paths, treedef = jax.tree_util.tree_flatten_with_path(params)
-    leaves = [l for _, l in paths]
+    leaves, treedef = jax.tree.flatten(params)
     shapes = tuple(l.shape for l in leaves)
     dtypes = tuple(l.dtype for l in leaves)
     sizes = tuple(int(l.size) for l in leaves)
+    flags = stacked_flags(params, stacked_key)
     sub_counts = tuple(
-        int(l.shape[0])
-        if l.ndim > 0 and is_stacked_path(path, stacked_key) else 1
-        for (path, _), l in zip(paths, leaves)
+        int(l.shape[0]) if f else 1 for f, l in zip(flags, leaves)
     )
     total = sum(sizes)
     padded_total = -(-total // n_shards) * n_shards

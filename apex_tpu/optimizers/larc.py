@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from apex_tpu.utils.pytree import is_stacked_path, stacked_sq_sum
+from apex_tpu.utils.pytree import stacked_flags, stacked_sq_sum
 
 
 def larc(
@@ -40,8 +40,7 @@ def larc(
         if params is None:
             raise ValueError("larc requires params")
 
-        def scale_one(path, g, p):
-            stk = is_stacked_path(path, stacked_key)
+        def scale_one(stk, g, p):
             g32 = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
             pn = jnp.sqrt(stacked_sq_sum(p32, stk))
@@ -62,7 +61,12 @@ def larc(
             g_wd = g32 + weight_decay * p32 if weight_decay else g32
             return (g_wd * factor).astype(g.dtype)
 
-        return jax.tree_util.tree_map_with_path(scale_one, grads, params), state
+        leaves_g, treedef = jax.tree.flatten(grads)
+        flags = stacked_flags(grads, stacked_key)
+        leaves_p = treedef.flatten_up_to(params)
+        scaled = [scale_one(f, g, p)
+                  for f, g, p in zip(flags, leaves_g, leaves_p)]
+        return jax.tree.unflatten(treedef, scaled), state
 
     return optax.GradientTransformation(init_fn, update_fn)
 

@@ -22,7 +22,8 @@ def set_logging_level(verbosity) -> None:
     """Set the package logger's threshold. Accepts a stdlib level number
     or name ("DEBUG", "INFO", ...) — ref: set_logging_level(verbosity)."""
     if isinstance(verbosity, str):
-        verbosity = logging.getLevelName(verbosity.upper())
-        if not isinstance(verbosity, int):
-            raise ValueError(f"unknown logging level name: {verbosity}")
+        level = logging.getLevelName(verbosity.upper())
+        if not isinstance(level, int):
+            raise ValueError(f"unknown logging level name: {verbosity!r}")
+        verbosity = level
     get_transformer_logger().setLevel(verbosity)

@@ -71,6 +71,12 @@ def step_metrics(
         out["steps"] = counters.steps
         out["overflow_count"] = counters.overflows
     if opt_state is not None:
-        out["loss_scale"] = opt_state.scaler.scale
+        from apex_tpu.amp.scaler import ScalerState
+
+        if isinstance(opt_state.scaler, ScalerState):
+            out["loss_scale"] = opt_state.scaler.scale
+        else:  # amp.initialize(num_losses=N): one scale per loss
+            for i, sc in enumerate(opt_state.scaler):
+                out[f"loss_scale{i}"] = sc.scale
         out["overflow_count"] = opt_state.skipped_steps
     return out

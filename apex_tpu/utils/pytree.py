@@ -135,13 +135,26 @@ def is_stacked_path(path, stacked_key) -> bool:
 
 def stacked_flags(tree, stacked_key):
     """Per-leaf stacked booleans for ``tree`` in ``jax.tree.flatten`` order
-    (paths and plain flatten agree on ordering). 0-d leaves are never
-    stacked — there is no leading layer axis to slice."""
+    (paths and plain flatten agree on ordering).
+
+    Guards against structural false positives (the detection is by path,
+    and a third-party tree may store ordinary tensors under the same
+    name): a collection only counts as stacked when it has at least TWO
+    candidate leaves and ALL of them share the same leading dimension —
+    the invariant ``stack_layer_params`` guarantees (every leaf is
+    [L, ...] for one L). 0-d leaves are never stacked."""
     paths, _ = jax.tree_util.tree_flatten_with_path(tree)
-    return [
+    cand = [
         jnp.ndim(leaf) > 0 and is_stacked_path(path, stacked_key)
         for path, leaf in paths
     ]
+    lead_dims = {
+        jnp.shape(leaf)[0]
+        for (_, leaf), c in zip(paths, cand) if c
+    }
+    if sum(cand) < 2 or len(lead_dims) != 1:
+        return [False] * len(cand)
+    return cand
 
 
 def stacked_sq_sum(x, stacked: bool):

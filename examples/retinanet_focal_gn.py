@@ -90,13 +90,13 @@ def main():
     stages = (3, 4, 6, 3) if on_tpu else (1, 1, 1, 1)
     bb_params, bb_state = resnet_init(jax.random.PRNGKey(0), stages=stages,
                                       num_classes=1)  # head unused
-    kl, kf = jax.random.split(jax.random.PRNGKey(1))
+    k3, k4, k5, kf = jax.random.split(jax.random.PRNGKey(1), 4)
     params = {
         "backbone": bb_params,
         "lat": {  # FPN-lite: 1x1 lateral projections to 256ch
-            "c3": 0.05 * jax.random.normal(kl, (1, 1, 512, 256)),
-            "c4": 0.05 * jax.random.normal(kl, (1, 1, 1024, 256)),
-            "c5": 0.05 * jax.random.normal(kl, (1, 1, 2048, 256)),
+            "c3": 0.05 * jax.random.normal(k3, (1, 1, 512, 256)),
+            "c4": 0.05 * jax.random.normal(k4, (1, 1, 1024, 256)),
+            "c5": 0.05 * jax.random.normal(k5, (1, 1, 2048, 256)),
         },
         "head": head_init(kf),
     }

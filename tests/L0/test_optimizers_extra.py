@@ -177,9 +177,21 @@ def test_flat_meta_unstacked_layers_list_single_segments():
     assert meta.sub_counts == (1, 1)
     assert meta.num_tensors == 2
 
-    meta2 = flat_meta({"layers": {"w": jnp.ones((3, 4, 4))}}, 4)
-    assert meta2.sub_counts == (3,)
-    assert meta2.num_tensors == 3
+    # a SINGLE array under "layers" is structurally ambiguous (it could be
+    # an ordinary matrix that merely lives under that name) — not stacked
+    meta1 = flat_meta({"layers": {"w": jnp.ones((3, 4, 4))}}, 4)
+    assert meta1.sub_counts == (1,)
+
+    # two leaves sharing the leading dim = the stack_layer_params invariant
+    meta2 = flat_meta({"layers": {"w": jnp.ones((3, 4, 4)),
+                                  "b": jnp.ones((3, 4))}}, 4)
+    assert meta2.sub_counts == (3, 3)
+    assert meta2.num_tensors == 6
+
+    # mismatched leading dims: misdetection guard refuses to stack any
+    meta3 = flat_meta({"layers": {"w": jnp.ones((3, 4, 4)),
+                                  "p": jnp.ones((7, 2))}}, 4)
+    assert meta3.sub_counts == (1, 1)
 
 
 def test_novograd_stacked_layers_match_per_layer_tensors():
@@ -189,7 +201,9 @@ def test_novograd_stacked_layers_match_per_layer_tensors():
     L = 3
     k = jax.random.PRNGKey(0)
     ws = jax.random.normal(k, (L, 4, 4)) * jnp.arange(1, L + 1)[:, None, None]
+    bs = jax.random.normal(jax.random.fold_in(k, 4), (L, 4)) * 0.1
     gw = jax.random.normal(jax.random.fold_in(k, 1), (L, 4, 4)) * 0.1
+    gb = jax.random.normal(jax.random.fold_in(k, 5), (L, 4)) * 0.1
 
     def run(params, grads):
         tx = fused_novograd(1e-2, weight_decay=0.01)
@@ -199,45 +213,56 @@ def test_novograd_stacked_layers_match_per_layer_tensors():
             params = optax.apply_updates(params, u)
         return params, s
 
-    got, s_got = run({"layers": {"w": ws}}, {"layers": {"w": gw}})
-    want, _ = run({f"l{i}": ws[i] for i in range(L)},
-                  {f"l{i}": gw[i] for i in range(L)})
+    got, s_got = run({"layers": {"w": ws, "b": bs}},
+                     {"layers": {"w": gw, "b": gb}})
+    want, _ = run({f"l{i}": {"w": ws[i], "b": bs[i]} for i in range(L)},
+                  {f"l{i}": {"w": gw[i], "b": gb[i]} for i in range(L)})
     assert s_got.exp_avg_sq["layers"]["w"].shape == (L,)
     for i in range(L):
         np.testing.assert_allclose(np.asarray(got["layers"]["w"][i]),
-                                   np.asarray(want[f"l{i}"]),
+                                   np.asarray(want[f"l{i}"]["w"]),
                                    rtol=1e-6, atol=1e-7)
 
 
 def test_larc_stacked_layers_match_per_layer_tensors():
     """LARC adaptive rates per layer slice for stacked collections (ref:
-    apex/parallel/LARC.py computes one rate per parameter tensor)."""
+    apex/parallel/LARC.py computes one rate per parameter tensor).
+    clip=False keeps the raw adaptive rate (clip=True saturates the
+    factor at 1 at these magnitudes, which would make the test vacuous)."""
     from apex_tpu.optimizers import larc
 
     L = 3
     k = jax.random.PRNGKey(0)
     ws = jax.random.normal(k, (L, 4, 4)) * jnp.arange(1, L + 1)[:, None, None]
+    bs = jax.random.normal(jax.random.fold_in(k, 2), (L, 4)) * 0.1
     gw = jax.random.normal(jax.random.fold_in(k, 1), (L, 4, 4)) * 0.1
+    gb = jax.random.normal(jax.random.fold_in(k, 3), (L, 4)) * 0.1
 
     def run(params, grads):
-        tx = larc(1e-2, weight_decay=0.01)
+        tx = larc(1e-2, weight_decay=0.01, clip=False)
         u, _ = tx.update(grads, tx.init(params), params)
         return u
 
-    got = run({"layers": {"w": ws}}, {"layers": {"w": gw}})
-    want = run({f"l{i}": ws[i] for i in range(L)},
-               {f"l{i}": gw[i] for i in range(L)})
+    got = run({"layers": {"w": ws, "b": bs}}, {"layers": {"w": gw, "b": gb}})
+    want = run({f"l{i}": {"w": ws[i], "b": bs[i]} for i in range(L)},
+               {f"l{i}": {"w": gw[i], "b": gb[i]} for i in range(L)})
     for i in range(L):
         np.testing.assert_allclose(np.asarray(got["layers"]["w"][i]),
-                                   np.asarray(want[f"l{i}"]),
+                                   np.asarray(want[f"l{i}"]["w"]),
                                    rtol=1e-6, atol=1e-7)
+    # whole-stack treatment would use one rate for all layers — prove the
+    # per-slice rates actually differ across layers
+    legacy = run({"L": {"w": ws}}, {"L": {"w": gw}})  # no stacked key
+    assert not np.allclose(np.asarray(legacy["L"]["w"][0]),
+                           np.asarray(want["l0"]["w"]), rtol=1e-6)
 
 
 def test_novograd_scalar_leaf_under_stacked_key():
     """A 0-d leaf stored directly under "layers" has no layer axis to
     slice — it gets an ordinary scalar second moment, not a crash."""
     tx = fused_novograd(1e-2)
-    p = {"layers": {"w": jnp.zeros((3, 4, 4)), "scale": jnp.float32(1.0)}}
+    p = {"layers": {"w": jnp.zeros((3, 4, 4)), "b": jnp.zeros((3, 4)),
+                    "scale": jnp.float32(1.0)}}
     s = tx.init(p)
     assert s.exp_avg_sq["layers"]["w"].shape == (3,)
     assert s.exp_avg_sq["layers"]["scale"].shape == ()

@@ -129,3 +129,20 @@ def test_step_metrics_amp_opt_state():
     assert float(m["loss_scale"]) > 0
     c = update_counters(init_counters(), True)   # host bool accepted
     assert int(c.overflows) == 1
+
+
+def test_step_metrics_multi_loss_opt_state():
+    """step_metrics must handle a num_losses>1 AmpOptState (tuple of
+    scalers) — one loss_scale{i} entry per loss."""
+    from apex_tpu import amp
+    from apex_tpu.optimizers import fused_adam
+    from apex_tpu.utils import step_metrics
+
+    p = {"w": jnp.ones((2, 2), jnp.bfloat16)}
+    _, p, opt = amp.initialize(lambda q, x: jnp.sum(q["w"] * x), p,
+                               fused_adam(1e-3), opt_level="O2",
+                               num_losses=2, verbosity=0)
+    st = opt.init(p)
+    m = step_metrics(opt_state=st)
+    assert "loss_scale0" in m and "loss_scale1" in m
+    assert int(m["overflow_count"]) == 0

@@ -211,7 +211,9 @@ def test_dist_lamb_stacked_layers_per_layer_trust_ratios():
     L = 3
     k = jax.random.PRNGKey(0)
     ws = jax.random.normal(k, (L, 4, 4)) * jnp.arange(1, L + 1)[:, None, None]
+    bs = jax.random.normal(jax.random.fold_in(k, 2), (L, 4)) * 0.1
     gw = jax.random.normal(jax.random.fold_in(k, 1), (L, 4, 4)) * 0.1
+    gb = jax.random.normal(jax.random.fold_in(k, 3), (L, 4)) * 0.1
     emb = jnp.ones((4, 4))
     gemb = jnp.full((4, 4), 0.02)
 
@@ -230,13 +232,15 @@ def test_dist_lamb_stacked_layers_per_layer_trust_ratios():
         return jax.jit(shard_map(train, mesh=mesh, in_specs=P(),
                                  out_specs=P()))(params)
 
-    got = run({"layers": {"w": ws}, "emb": emb},
-              {"layers": {"w": gw}, "emb": gemb})
-    want = run({f"l{i}": ws[i] for i in range(L)} | {"emb": emb},
-               {f"l{i}": gw[i] for i in range(L)} | {"emb": gemb})
+    got = run({"layers": {"w": ws, "b": bs}, "emb": emb},
+              {"layers": {"w": gw, "b": gb}, "emb": gemb})
+    want = run({f"l{i}": {"w": ws[i], "b": bs[i]} for i in range(L)}
+               | {"emb": emb},
+               {f"l{i}": {"w": gw[i], "b": gb[i]} for i in range(L)}
+               | {"emb": gemb})
     for i in range(L):
         np.testing.assert_allclose(np.asarray(got["layers"]["w"][i]),
-                                   np.asarray(want[f"l{i}"]),
+                                   np.asarray(want[f"l{i}"]["w"]),
                                    rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(got["emb"]), np.asarray(want["emb"]),
                                rtol=1e-5, atol=1e-6)
