@@ -139,22 +139,50 @@ def stacked_flags(tree, stacked_key):
 
     Guards against structural false positives (the detection is by path,
     and a third-party tree may store ordinary tensors under the same
-    name): a collection only counts as stacked when it has at least TWO
-    candidate leaves and ALL of them share the same leading dimension —
-    the invariant ``stack_layer_params`` guarantees (every leaf is
-    [L, ...] for one L). 0-d leaves are never stacked."""
+    name): within EACH stacked collection (each distinct subtree rooted
+    at a ``stacked_key`` dict entry — a model may hold several, e.g.
+    encoder and decoder stacks of different depths), leaves count as
+    stacked only when the collection has at least TWO candidate leaves
+    and ALL of them share the same leading dimension — the invariant
+    ``stack_layer_params`` guarantees (every leaf is [L, ...] for one
+    L). A single-array collection is structurally ambiguous and is
+    demoted to per-tensor treatment with a warning. 0-d leaves are never
+    stacked."""
     paths, _ = jax.tree_util.tree_flatten_with_path(tree)
-    cand = [
-        jnp.ndim(leaf) > 0 and is_stacked_path(path, stacked_key)
-        for path, leaf in paths
-    ]
-    lead_dims = {
-        jnp.shape(leaf)[0]
-        for (_, leaf), c in zip(paths, cand) if c
-    }
-    if sum(cand) < 2 or len(lead_dims) != 1:
-        return [False] * len(cand)
-    return cand
+
+    def group_of(path):
+        for i, k in enumerate(path):
+            if isinstance(k, jax.tree_util.DictKey) and k.key == stacked_key:
+                return path[: i + 1]
+        return None
+
+    flags = []
+    groups: dict = {}
+    for idx, (path, leaf) in enumerate(paths):
+        cand = jnp.ndim(leaf) > 0 and is_stacked_path(path, stacked_key)
+        flags.append(cand)
+        if cand:
+            groups.setdefault(group_of(path), []).append(
+                (idx, jnp.shape(leaf)[0])
+            )
+    for gpath, members in groups.items():
+        dims = {d for _, d in members}
+        if len(members) >= 2 and len(dims) == 1:
+            continue
+        if len(members) == 1:
+            import warnings
+
+            warnings.warn(
+                f"collection at {jax.tree_util.keystr(gpath)} has a single "
+                f"array under the stacked key {stacked_key!r} — structurally "
+                "ambiguous, treating it as an ORDINARY tensor (per-tensor "
+                "optimizer statistics). Restructure or pass "
+                "stacked_key=None to silence.",
+                stacklevel=3,
+            )
+        for idx, _ in members:
+            flags[idx] = False
+    return flags
 
 
 def stacked_sq_sum(x, stacked: bool):

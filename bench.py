@@ -299,8 +299,11 @@ def main():
             causal=False, dtype=jnp.bfloat16, scan_layers=True,
             remat=remat_mode != "none", remat_policy=remat_mode,
         )
+        # 144 refines the sweep near the measured peak (128 best, 160
+        # worse on v5e — BASELINE.md); the sweep reports every row, so
+        # extra points only sharpen the "best" pick
         batches = [int(b) for b in os.environ.get(
-            "BENCH_BATCHES", "32,64,96,128").split(",")]
+            "BENCH_BATCHES", "32,64,96,128,144").split(",")]
 
     def model_fn(p, tokens, labels, loss_mask):
         return bert_loss(p, tokens, labels, loss_mask, cfg)

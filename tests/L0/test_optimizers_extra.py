@@ -269,3 +269,29 @@ def test_novograd_scalar_leaf_under_stacked_key():
     g = jax.tree.map(jnp.ones_like, p)
     u, s = tx.update(g, s, p)
     assert all(jnp.all(jnp.isfinite(x)) for x in jax.tree.leaves(u))
+
+
+def test_stacked_flags_per_collection_independent():
+    """Encoder/decoder stacks of DIFFERENT depths are independent
+    collections — one must not disable detection for the other — and a
+    single-array collection demotes (with a warning) without affecting
+    genuine stacks elsewhere."""
+    import warnings
+
+    from apex_tpu.utils.pytree import stacked_flags
+
+    tree = {
+        "enc": {"layers": {"w": jnp.zeros((12, 4, 4)),
+                           "b": jnp.zeros((12, 4))}},
+        "dec": {"layers": {"w": jnp.zeros((6, 4, 4)),
+                           "b": jnp.zeros((6, 4))}},
+    }
+    assert stacked_flags(tree, "layers") == [True] * 4
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        tree2 = dict(tree, odd={"layers": {"proj": jnp.zeros((7, 2))}})
+        flags = stacked_flags(tree2, "layers")
+    # flatten order: dec.b, dec.w, enc.b, enc.w, odd.proj (dict keys sorted)
+    assert flags == [True, True, True, True, False]
+    assert any("ambiguous" in str(x.message) for x in w)
