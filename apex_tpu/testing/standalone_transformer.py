@@ -91,11 +91,22 @@ class TransformerConfig:
     scan_layers: bool = False      # lax.scan over stacked layer params
                                    # (compile time O(1) in depth; pass
                                    # params through stack_layer_params)
+    loss_chunk: object = None      # rows per chunk for the fused
+                                   # linear+CE path (bert_loss): lm-head
+                                   # matmul + cross-entropy run chunked
+                                   # under per-chunk remat, so the full
+                                   # [s*b, v] logits never materialize.
+                                   # None = dense (default). Exact same
+                                   # math; decides peak memory at large
+                                   # batch x vocab.
 
     def __post_init__(self):
         assert self.remat_policy in ("full", "dots", "none"), (
             f"unknown remat_policy {self.remat_policy!r}"
         )
+        assert self.loss_chunk is None or (
+            isinstance(self.loss_chunk, int) and self.loss_chunk > 0
+        ), f"loss_chunk must be None or a positive int, got {self.loss_chunk!r}"
         if self.context_axis is not None:
             assert not self.sequence_parallel, (
                 "context_axis and sequence_parallel both shard the sequence"
@@ -234,7 +245,7 @@ def _mlp(lp, x, cfg: TransformerConfig, dropout_key):
     return y
 
 
-def transformer_forward(params, tokens, cfg: TransformerConfig, *,
+def _forward_hidden(params, tokens, cfg: TransformerConfig, *,
                         seed: int = 1234):
     """tokens: [b, s] int32 (shard_map-local batch shard). Returns
     vocab-parallel logits [s, b, v/tp]."""
@@ -321,6 +332,10 @@ def transformer_forward(params, tokens, cfg: TransformerConfig, *,
         x = gather_from_sequence_parallel_region(x, ax, True)
     else:
         x = copy_to_tensor_model_parallel_region(x, ax)
+    return x
+
+
+def _lm_logits(x, params, cfg: TransformerConfig):
     # Vocab logits stay in the compute dtype (Megatron computes
     # parallel_lm_logits in half precision; vocab_parallel_cross_entropy
     # upcasts to fp32 per-tile). The MXU accumulates bf16 x bf16 in fp32
@@ -330,12 +345,56 @@ def transformer_forward(params, tokens, cfg: TransformerConfig, *,
     # larger [s, b, v] intermediate. Measured on v5e via
     # benchmarks/bench_step_variants.py (see BASELINE.md).
     ldt = jnp.float32 if cfg.fp32_logits else cfg.dtype
-    logits = jnp.matmul(
+    return jnp.matmul(
         x.astype(ldt),
         params["embedding"].astype(ldt).T,
         preferred_element_type=jnp.float32 if cfg.fp32_logits else None,
     )
-    return logits
+
+
+def transformer_forward(params, tokens, cfg: TransformerConfig, *,
+                        seed: int = 1234):
+    """Full forward to vocab-parallel logits [s, b, v/tp]."""
+    x = _forward_hidden(params, tokens, cfg, seed=seed)
+    return _lm_logits(x, params, cfg)
+
+
+def _chunked_masked_ce(x, params, labels_sb, weight_sb, cfg):
+    """Masked CE summed over rows WITHOUT materializing full [s*b, v]
+    logits: row chunks of ``cfg.loss_chunk`` run lm-matmul + CE under
+    jax.checkpoint inside lax.scan, so peak logits memory is
+    O(chunk * v/tp) and the backward recomputes per chunk (the fused
+    linear+cross-entropy pattern; enables batches whose dense logits
+    would not fit). Exact same math as the dense path.
+
+    x [s, b, h]; labels_sb / weight_sb [s, b] (weight 0 = ignore).
+    Returns the weighted SUM of per-token losses (caller divides)."""
+    n = x.shape[0] * x.shape[1]
+    h = x.shape[-1]
+    c = int(cfg.loss_chunk)
+    xf = x.reshape(n, h)
+    lf = labels_sb.reshape(n)
+    wf = weight_sb.reshape(n).astype(jnp.float32)
+    pad = (-n) % c
+    if pad:
+        xf = jnp.concatenate([xf, jnp.zeros((pad, h), xf.dtype)])
+        lf = jnp.concatenate([lf, jnp.zeros((pad,), lf.dtype)])
+        wf = jnp.concatenate([wf, jnp.zeros((pad,), jnp.float32)])
+
+    def one(total, inp):
+        x_c, l_c, w_c = inp
+        logits = _lm_logits(x_c, params, cfg)
+        losses = vocab_parallel_cross_entropy(
+            logits, l_c, axis=cfg.model_axis
+        )
+        return total + jnp.sum(losses * w_c), None
+
+    total, _ = jax.lax.scan(
+        jax.checkpoint(one),
+        jnp.float32(0.0),
+        (xf.reshape(-1, c, h), lf.reshape(-1, c), wf.reshape(-1, c)),
+    )
+    return total
 
 
 def gpt_loss(params, tokens, cfg: TransformerConfig, *, seed: int = 1234):
@@ -384,12 +443,18 @@ def bert_loss(params, tokens, labels, loss_mask, cfg: TransformerConfig, *,
     psum'd over those axes BEFORE dividing — a naive pmean of per-shard
     means would weight shards with few masked tokens too heavily.
     """
-    logits = transformer_forward(params, tokens, cfg, seed=seed)
-    losses = vocab_parallel_cross_entropy(
-        logits, labels.transpose(1, 0), axis=cfg.model_axis
-    )
     mask = loss_mask.transpose(1, 0).astype(jnp.float32)
-    total = (losses * mask).sum()
+    if cfg.loss_chunk:
+        x = _forward_hidden(params, tokens, cfg, seed=seed)
+        total = _chunked_masked_ce(
+            x, params, labels.transpose(1, 0), mask, cfg
+        )
+    else:
+        logits = transformer_forward(params, tokens, cfg, seed=seed)
+        losses = vocab_parallel_cross_entropy(
+            logits, labels.transpose(1, 0), axis=cfg.model_axis
+        )
+        total = (losses * mask).sum()
     count = mask.sum()
     for axis in reduce_axes:
         total = jax.lax.psum(total, axis)

@@ -294,10 +294,12 @@ def main():
         # default stays on the measured-good config; flip after
         # bench_step_variants.py proves a better remat policy on hardware
         remat_mode = os.environ.get("BENCH_REMAT", "full")
+        loss_chunk = int(os.environ.get("BENCH_LOSS_CHUNK", "0")) or None
         cfg = TransformerConfig(
             vocab_size=30528, seq_len=512, hidden=1024, layers=24, heads=16,
             causal=False, dtype=jnp.bfloat16, scan_layers=True,
             remat=remat_mode != "none", remat_policy=remat_mode,
+            loss_chunk=loss_chunk,
         )
         # 144 refines the sweep near the measured peak (128 best, 160
         # worse on v5e — BASELINE.md); the sweep reports every row, so

@@ -85,6 +85,7 @@ def main():
                        "optim_flat"], "full"),
         "split_bwd": ([], "full"),  # + APEX_TPU_FLASH_SPLIT_BWD=1 env
         "fp32_logits": ([], "full"),   # pre-round-3 lm-head (fp32 inputs)
+        "chunked_loss": ([], "full"),  # fused linear+CE, 8192-row chunks
         "flash_b128": ([], "full"),    # + APEX_TPU_FLASH_BLOCK=128
         "flash_b512": ([], "full"),    # + APEX_TPU_FLASH_BLOCK=512
     }
@@ -102,6 +103,8 @@ def main():
         if name.startswith("flash_b"):
             _os.environ["APEX_TPU_FLASH_BLOCK"] = name[len("flash_b"):]
         cfg_over = {"fp32_logits": True} if name == "fp32_logits" else None
+        if name == "chunked_loss":
+            cfg_over = {"loss_chunk": 8192}
         try:
             step, args = build_step(batch, remat=remat_mode != "none",
                                     remat_policy=remat_mode,
