@@ -92,7 +92,8 @@ class TransformerConfig:
                                    # (compile time O(1) in depth; pass
                                    # params through stack_layer_params)
     loss_chunk: object = None      # rows per chunk for the fused
-                                   # linear+CE path (bert_loss): lm-head
+                                   # linear+CE path (bert_loss AND
+                                   # gpt_loss, incl. CP): lm-head
                                    # matmul + cross-entropy run chunked
                                    # under per-chunk remat, so the full
                                    # [s*b, v] logits never materialize.
@@ -105,7 +106,9 @@ class TransformerConfig:
             f"unknown remat_policy {self.remat_policy!r}"
         )
         assert self.loss_chunk is None or (
-            isinstance(self.loss_chunk, int) and self.loss_chunk > 0
+            isinstance(self.loss_chunk, int)
+            and not isinstance(self.loss_chunk, bool)
+            and self.loss_chunk > 0
         ), f"loss_chunk must be None or a positive int, got {self.loss_chunk!r}"
         if self.context_axis is not None:
             assert not self.sequence_parallel, (
@@ -246,9 +249,10 @@ def _mlp(lp, x, cfg: TransformerConfig, dropout_key):
 
 
 def _forward_hidden(params, tokens, cfg: TransformerConfig, *,
-                        seed: int = 1234):
-    """tokens: [b, s] int32 (shard_map-local batch shard). Returns
-    vocab-parallel logits [s, b, v/tp]."""
+                    seed: int = 1234):
+    """tokens: [b, s] int32 (shard_map-local batch shard). Returns the
+    post-gather hidden states [s, b, h] — the tensor the lm head
+    (_lm_logits) consumes; transformer_forward composes the two."""
     ax = cfg.model_axis
     if cfg.sequence_parallel:
         # Megatron SP entry: the vocab-parallel combine IS the seq scatter —
@@ -405,7 +409,6 @@ def gpt_loss(params, tokens, cfg: TransformerConfig, *, seed: int = 1234):
     FIRST token of the next rank's chunk — fetched with one tiny ppermute —
     and the global final position is excluded; sum and count psum over the
     context axis so the mean matches the unsharded loss exactly."""
-    logits = transformer_forward(params, tokens, cfg, seed=seed)
     if cfg.context_axis is not None:
         axc = cfg.context_axis
         c = jax.lax.axis_size(axc)
@@ -415,17 +418,36 @@ def gpt_loss(params, tokens, cfg: TransformerConfig, *, seed: int = 1234):
             tokens[:, :1], axc, [((i + 1) % c, i) for i in range(c)]
         )                                            # next chunk's first token
         targets = jnp.concatenate([tokens[:, 1:], nxt], axis=1).transpose(1, 0)
-        losses = vocab_parallel_cross_entropy(
-            logits, targets, axis=cfg.model_axis
-        )                                            # [s_local, b]
         valid = jnp.where(
             r == c - 1,
             jnp.arange(s_local) < s_local - 1,
             jnp.ones((s_local,), bool),
         ).astype(jnp.float32)
-        total = jax.lax.psum((losses * valid[:, None]).sum(), axc)
+        weights = jnp.broadcast_to(valid[:, None], (s_local, b))
+        if cfg.loss_chunk:
+            x = _forward_hidden(params, tokens, cfg, seed=seed)
+            total = _chunked_masked_ce(x, params, targets, weights, cfg)
+        else:
+            logits = transformer_forward(params, tokens, cfg, seed=seed)
+            losses = vocab_parallel_cross_entropy(
+                logits, targets, axis=cfg.model_axis
+            )                                        # [s_local, b]
+            total = (losses * weights).sum()
+        total = jax.lax.psum(total, axc)
         count = jax.lax.psum(valid.sum() * b, axc)
         return total / count
+    s_len, b = tokens.shape[1], tokens.shape[0]
+    if cfg.loss_chunk:
+        # weight 0 on the final position replaces the logits[:-1] slice
+        x = _forward_hidden(params, tokens, cfg, seed=seed)
+        targets = jnp.roll(tokens, -1, axis=1).transpose(1, 0)   # [s, b]
+        weights = jnp.broadcast_to(
+            (jnp.arange(s_len) < s_len - 1).astype(jnp.float32)[:, None],
+            (s_len, b),
+        )
+        total = _chunked_masked_ce(x, params, targets, weights, cfg)
+        return total / ((s_len - 1) * b)
+    logits = transformer_forward(params, tokens, cfg, seed=seed)
     targets = tokens[:, 1:].transpose(1, 0)          # [s-1, b]
     losses = vocab_parallel_cross_entropy(
         logits[:-1], targets, axis=cfg.model_axis
