@@ -57,7 +57,7 @@ def main():
         cfg = TransformerConfig(
             vocab_size=50304, seq_len=32768, hidden=1024, layers=24, heads=16,
             causal=True, dtype=jnp.bfloat16, scan_layers=True, remat=True,
-            context_axis="context")
+            context_axis="context", loss_chunk=8192)
         batch = args.batch or 1
     else:
         cfg = TransformerConfig(
