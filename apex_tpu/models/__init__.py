@@ -23,3 +23,10 @@ from apex_tpu.testing.standalone_transformer import (  # noqa: F401
     gpt_loss,
     transformer_init,
 )
+from apex_tpu.models.configs import (  # noqa: F401
+    bert_base,
+    bert_large,
+    gpt2_large,
+    gpt2_medium,
+    gpt2_small,
+)

@@ -1,0 +1,53 @@
+"""Named TransformerConfig presets for the reference's benchmark models.
+
+Ref: the model geometries NVIDIA's apex examples and MLPerf submissions
+train (BERT-large is the DistributedFusedLAMB MLPerf model; GPT-2 medium
+is the Megatron tensor-parallel example size). These are plain
+dataclasses — override any field with dataclasses.replace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from apex_tpu.testing.standalone_transformer import TransformerConfig
+
+
+def _preset(**kw) -> TransformerConfig:
+    base = dict(dtype=jnp.bfloat16, scan_layers=True, remat=True)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def bert_base(**over) -> TransformerConfig:
+    return dataclasses.replace(_preset(
+        vocab_size=30528, seq_len=512, hidden=768, layers=12, heads=12,
+        causal=False), **over)
+
+
+def bert_large(**over) -> TransformerConfig:
+    """The north-star benchmark model (bench.py / BASELINE config 3)."""
+    return dataclasses.replace(_preset(
+        vocab_size=30528, seq_len=512, hidden=1024, layers=24, heads=16,
+        causal=False), **over)
+
+
+def gpt2_small(**over) -> TransformerConfig:
+    return dataclasses.replace(_preset(
+        vocab_size=50304, seq_len=1024, hidden=768, layers=12, heads=12,
+        causal=True), **over)
+
+
+def gpt2_medium(**over) -> TransformerConfig:
+    """BASELINE config 4 (tensor-parallel example)."""
+    return dataclasses.replace(_preset(
+        vocab_size=50304, seq_len=1024, hidden=1024, layers=24, heads=16,
+        causal=True), **over)
+
+
+def gpt2_large(**over) -> TransformerConfig:
+    return dataclasses.replace(_preset(
+        vocab_size=50304, seq_len=1024, hidden=1280, layers=36, heads=20,
+        causal=True), **over)

@@ -76,3 +76,18 @@ def test_syncbn_matches_full_batch_bn(eight_cpu_devices):
         lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                                 rtol=1e-4, atol=1e-5),
         state, ref_state)
+
+
+def test_transformer_config_presets():
+    """Named geometries from the reference's example/MLPerf models."""
+    import dataclasses
+
+    from apex_tpu.models import bert_base, bert_large, gpt2_medium
+
+    bl = bert_large()
+    assert (bl.hidden, bl.layers, bl.heads, bl.seq_len) == (1024, 24, 16, 512)
+    assert not bl.causal and bl.remat and bl.scan_layers
+    assert gpt2_medium().causal
+    assert bert_base(sequence_parallel=True).sequence_parallel
+    # presets are plain dataclasses: replace works
+    assert dataclasses.replace(bl, layers=2).layers == 2
