@@ -293,11 +293,12 @@ def main():
         # BERT-large: 24 x 1024 x 16 heads, seq 512, vocab 30528 (padded)
         # default stays on the measured-good config; flip after
         # bench_step_variants.py proves a better remat policy on hardware
+        from apex_tpu.models import bert_large
+
         remat_mode = os.environ.get("BENCH_REMAT", "full")
         loss_chunk = int(os.environ.get("BENCH_LOSS_CHUNK", "0")) or None
-        cfg = TransformerConfig(
-            vocab_size=30528, seq_len=512, hidden=1024, layers=24, heads=16,
-            causal=False, dtype=jnp.bfloat16, scan_layers=True,
+        # the north-star geometry lives in ONE place: models.bert_large
+        cfg = bert_large(
             remat=remat_mode != "none", remat_policy=remat_mode,
             loss_chunk=loss_chunk,
         )

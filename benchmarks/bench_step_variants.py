@@ -21,13 +21,13 @@ def build_step(batch, remat, remat_policy="full", cfg_over=None):
     from apex_tpu import amp
     from apex_tpu.optimizers import fused_lamb
     from apex_tpu.testing import (
-        TransformerConfig, bert_loss, stack_layer_params, transformer_init)
+        bert_loss, stack_layer_params, transformer_init)
     from apex_tpu.testing.commons import smap
 
-    cfg = TransformerConfig(
-        vocab_size=30528, seq_len=512, hidden=1024, layers=24, heads=16,
-        causal=False, dtype=jnp.bfloat16, scan_layers=True, remat=remat,
-        remat_policy=remat_policy, **(cfg_over or {}))
+    from apex_tpu.models import bert_large
+
+    cfg = bert_large(remat=remat, remat_policy=remat_policy,
+                     **(cfg_over or {}))
     params = stack_layer_params(transformer_init(jax.random.PRNGKey(0), cfg))
 
     def model_fn(p, tokens, labels, mask):
