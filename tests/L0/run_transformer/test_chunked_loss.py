@@ -100,3 +100,37 @@ def test_chunked_gpt_loss_context_parallel(eight_cpu_devices):
         (pspec, P()), P()))(params, toks)
     np.testing.assert_allclose(float(l_cp), float(l_ref),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_chunked_loss_reduces_peak_temp_memory(eight_cpu_devices):
+    """XLA's own memory_analysis must show the chunked path materially
+    below the dense path at a logits-dominated shape — the reason the
+    feature exists. (Measured ~7x at this geometry; assert a loose 2x so
+    compiler scheduling changes don't flake the test.)"""
+    kw = dict(vocab_size=8192, seq_len=128, hidden=64, layers=1, heads=4,
+              causal=False, dtype=jnp.float32)
+    mesh = Mesh(np.array(eight_cpu_devices[:1]), ("model",))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 128), 0, 8192)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (8, 128), 0, 8192)
+    mask = jax.random.uniform(jax.random.PRNGKey(3), (8, 128)) < 0.3
+
+    def peak_temp(cfg):
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        specs = param_specs(cfg)
+
+        def body(p, t, l, m):
+            return jax.grad(lambda p: bert_loss(p, t, l, m, cfg))(p)
+
+        c = jax.jit(smap(body, mesh, (specs, P(), P(), P()), specs)).lower(
+            params, toks, labels, mask).compile()
+        ma = c.memory_analysis()
+        if ma is None:  # backend without the analysis: nothing to assert
+            return None
+        return ma.temp_size_in_bytes
+
+    dense = peak_temp(TransformerConfig(**kw))
+    chunked = peak_temp(TransformerConfig(loss_chunk=128, **kw))
+    if dense is None or chunked is None:
+        import pytest
+        pytest.skip("memory_analysis unavailable on this backend")
+    assert chunked * 2 < dense, (chunked, dense)
