@@ -34,6 +34,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 
 from apex_tpu.ops._utils import default_use_pallas, pallas_interpret
@@ -1014,6 +1015,16 @@ def _flash_core_fwd(q, k, v, bias, causal, scale, use_pallas, need_dbias):
         o, lse = _fwd_pallas(q, k, v, bias, causal, scale)
     else:
         o, lse = _attn_ref(q, k, v, bias, causal, scale)
+    # Name the kernel's residuals so remat policies can pin them:
+    # jax.checkpoint(policy=save_only_these_names("flash_out", "flash_lse"))
+    # then keeps exactly (o, lse) across the forward, and the backward
+    # recompute drops the whole flash forward kernel (its only outputs are
+    # saved) while still recomputing the cheap surrounding matmuls. Verified
+    # structurally in tests/L0/run_transformer/test_remat_policy.py. Outside
+    # remat
+    # the names lower to identity and XLA erases them.
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, bias, o, lse)
 
 

@@ -80,6 +80,21 @@ class TransformerConfig:
                                    # fails to compile on a single 16 GB
                                    # chip; it is the right policy only
                                    # once state is ZeRO/TP-sharded.
+                                   # "flash" = the mid-granularity policy
+                                   # between those extremes: save ONLY the
+                                   # flash-attention kernel's named
+                                   # residuals ("flash_out"/"flash_lse",
+                                   # ops/attention.py::_flash_core_fwd) —
+                                   # [s,b,h] bf16 + [b,nh,s] fp32 per layer
+                                   # (~1/9 of what "dots" pins) — so the
+                                   # backward recompute skips the attention
+                                   # forward kernel (the one op whose
+                                   # recompute is NOT a plain MXU matmul)
+                                   # but still recomputes the cheap linear
+                                   # fwds. The reference's own selective
+                                   # recompute (random.py::
+                                   # CheckpointFunction) is the analogous
+                                   # per-op choice.
     fp32_logits: bool = False      # force fp32 INPUTS to the lm-head
                                    # matmul (3-pass MXU product + 2x
                                    # logits memory). Default follows
@@ -102,7 +117,7 @@ class TransformerConfig:
                                    # batch x vocab.
 
     def __post_init__(self):
-        assert self.remat_policy in ("full", "dots", "none"), (
+        assert self.remat_policy in ("full", "dots", "flash", "none"), (
             f"unknown remat_policy {self.remat_policy!r}"
         )
         assert self.loss_chunk is None or (
@@ -310,6 +325,13 @@ def _forward_hidden(params, tokens, cfg: TransformerConfig, *,
             block = jax.checkpoint(
                 block,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        elif cfg.remat_policy == "flash":
+            block = jax.checkpoint(
+                block,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "flash_out", "flash_lse"
+                ),
             )
         else:
             block = jax.checkpoint(block)

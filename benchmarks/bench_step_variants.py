@@ -77,6 +77,9 @@ def main():
     variants = {
         "pallas": ([], "full"),
         "pallas_dots": ([], "dots"),
+        "pallas_flashsave": ([], "flash"),  # save flash o/lse, skip its
+                                            # fwd in the bwd recompute
+        "flashsave_chunked": ([], "flash"),  # + fused linear+CE loss
         "pallas_noremat": ([], "none"),
         "no_ln": (["layer_norm", "rms_norm"], "full"),
         "no_flash": (["flash_attention"], "full"),
@@ -103,7 +106,7 @@ def main():
         if name.startswith("flash_b"):
             _os.environ["APEX_TPU_FLASH_BLOCK"] = name[len("flash_b"):]
         cfg_over = {"fp32_logits": True} if name == "fp32_logits" else None
-        if name == "chunked_loss":
+        if name in ("chunked_loss", "flashsave_chunked"):
             cfg_over = {"loss_chunk": 8192}
         try:
             step, args = build_step(batch, remat=remat_mode != "none",
