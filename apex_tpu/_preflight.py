@@ -191,12 +191,46 @@ def _probe_flash_attention_stream() -> None:
                     "flash_attention_stream grad mismatch vs oracle"
 
 
+def _probe_flash_attention_dropout() -> None:
+    """Fused-dropout flash kernels (counter-RNG mask in fwd + fused bwd).
+
+    The jnp fallback draws the SAME threefry bits (block_rng.keep_full),
+    so this is an exact-mask grad parity check, not a statistical one. On
+    failure only the dropout family pins to jnp — dropout-free flash
+    keeps its kernels."""
+    from apex_tpu.ops.attention import flash_attention
+
+    with _pinned_env("APEX_TPU_FLASH_STREAM", "0"):
+        rng = jax.random.PRNGKey(17)
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 256, 64),
+                              jnp.bfloat16)
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 256, 64),
+                              jnp.bfloat16)
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, 2, 256, 64),
+                              jnp.bfloat16)
+        do = jax.random.normal(jax.random.PRNGKey(3), q.shape, q.dtype)
+
+        def f(q, k, v, use):
+            y = flash_attention(q, k, v, causal=True, dropout_p=0.2,
+                                dropout_rng=rng, use_pallas=use)
+            return jnp.vdot(y.astype(jnp.float32), do.astype(jnp.float32))
+
+        gp = jax.jit(jax.grad(lambda q, k, v: f(q, k, v, True),
+                              argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.jit(jax.grad(lambda q, k, v: f(q, k, v, False),
+                              argnums=(0, 1, 2)))(q, k, v)
+        for a, c in zip(gp, gr):
+            assert _maxdiff(a, c) < 0.1, \
+                "flash_attention_dropout grad mismatch vs oracle"
+
+
 # family name (as consulted by default_use_pallas) -> probe
 PROBES: Dict[str, Callable[[], None]] = {
     "layer_norm": _probe_layer_norm,
     "rms_norm": _probe_rms_norm,
     "flash_attention": _probe_flash_attention,
     "flash_attention_stream": _probe_flash_attention_stream,
+    "flash_attention_dropout": _probe_flash_attention_dropout,
     "optim_flat": _probe_optim_flat,
 }
 

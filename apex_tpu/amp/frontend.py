@@ -103,29 +103,28 @@ class AmpOptimizer:
         return self.scaler.scale_loss(
             _scaler_at(state.scaler, loss_id), loss)
 
-    def apply_gradients(self, grads, state: AmpOptState, params,
-                        found_inf_axes=(), loss_id: int = 0):
-        """Returns ``(new_params, new_state)`` with overflow-safe semantics.
-
-        ``found_inf_axes``: mesh axis names to reduce the overflow flag
-        over — the analog of apex/transformer/amp/grad_scaler.py's
-        MP-aware GradScaler (allreduce found_inf across the model-parallel
-        group so all TP/PP ranks skip steps together). Pass e.g.
-        ``("model",)`` when grads are TP-sharded inside shard_map.
-
-        ``loss_id``: which scaler produced these grads (num_losses > 1;
-        ref: apex scale_loss(loss, optimizer, loss_id) — each loss keeps
-        an independent dynamic scale, and only the scaler that scaled
-        THIS backward is updated by the step).
-        """
-        import optax
-
+    def unscale_gradients(self, grads, state: AmpOptState,
+                          loss_id: int = 0, found_inf_axes=()):
+        """Unscale ``loss_id``-scaled grads WITHOUT stepping: returns
+        ``(grads32, found_inf)``. The multi-loss building block (ref: apex
+        scale_loss contexts unscale on __exit__ so differently-scaled
+        backwards can be SUMMED into one optimizer step): unscale each
+        loss's grads, combine them yourself, then step once via
+        :meth:`apply_unscaled_gradients` with the per-loss flags."""
         this_scaler = _scaler_at(state.scaler, loss_id)
         grads32, found_inf = self.scaler.unscale(this_scaler, grads)
         for ax in found_inf_axes:
             found_inf = jax.lax.psum(
                 found_inf.astype(jnp.float32), ax
             ) > 0.0
+        return grads32, found_inf
+
+    def _step_unscaled(self, grads32, state: AmpOptState, params,
+                       found_inf, new_scaler):
+        """Shared step body: inner update on already-fp32 grads, skip-on-
+        overflow, master/params sync. ``new_scaler`` is the caller's
+        already-advanced scaler state(s)."""
+        import optax
 
         target = state.master if state.master is not None else params
         updates, inner_new = self.tx.update(grads32, state.inner, target)
@@ -150,12 +149,6 @@ class AmpOptimizer:
             new_master = None
             new_params = new_target
 
-        new_scaler = self.scaler.update(this_scaler, found_inf)
-        if _is_multi(state.scaler):
-            new_scaler = tuple(
-                new_scaler if i == loss_id else s
-                for i, s in enumerate(state.scaler)
-            )
         new_state = AmpOptState(
             inner=inner_new,
             master=new_master,
@@ -163,6 +156,71 @@ class AmpOptimizer:
             skipped_steps=state.skipped_steps + found_inf.astype(jnp.int32),
         )
         return new_params, new_state
+
+    def apply_gradients(self, grads, state: AmpOptState, params,
+                        found_inf_axes=(), loss_id: int = 0):
+        """Returns ``(new_params, new_state)`` with overflow-safe semantics.
+
+        ``found_inf_axes``: mesh axis names to reduce the overflow flag
+        over — the analog of apex/transformer/amp/grad_scaler.py's
+        MP-aware GradScaler (allreduce found_inf across the model-parallel
+        group so all TP/PP ranks skip steps together). Pass e.g.
+        ``("model",)`` when grads are TP-sharded inside shard_map.
+
+        ``loss_id``: which scaler produced these grads (num_losses > 1;
+        ref: apex scale_loss(loss, optimizer, loss_id) — each loss keeps
+        an independent dynamic scale, and only the scaler that scaled
+        THIS backward is updated by the step).
+
+        NOTE on multi-loss semantics: this method unscales AND steps, so
+        calling it once per loss takes one full inner-optimizer step per
+        loss. To accumulate differently-scaled backwards into a SINGLE
+        step (the reference's nested scale_loss pattern), unscale each
+        loss via :meth:`unscale_gradients`, sum the fp32 grads, and call
+        :meth:`apply_unscaled_gradients` once with the per-loss flags.
+        """
+        grads32, found_inf = self.unscale_gradients(
+            grads, state, loss_id=loss_id, found_inf_axes=found_inf_axes)
+        new_scaler = self.scaler.update(
+            _scaler_at(state.scaler, loss_id), found_inf)
+        if _is_multi(state.scaler):
+            new_scaler = tuple(
+                new_scaler if i == loss_id else s
+                for i, s in enumerate(state.scaler)
+            )
+        return self._step_unscaled(grads32, state, params, found_inf,
+                                   new_scaler)
+
+    def apply_unscaled_gradients(self, grads32, state: AmpOptState, params,
+                                 found_infs):
+        """One inner-optimizer step on ALREADY-UNSCALED (fp32) grads —
+        typically the sum of per-loss :meth:`unscale_gradients` results.
+
+        ``found_infs``: the per-loss overflow flags in loss_id order (a
+        single flag is accepted when num_losses == 1). The step is skipped
+        if ANY loss overflowed; each loss's dynamic scaler advances on its
+        OWN flag (apex semantics: per-loss backoff, shared step).
+        """
+        n = len(state.scaler) if _is_multi(state.scaler) else 1
+        if not isinstance(found_infs, (tuple, list)):
+            found_infs = (found_infs,)
+        if len(found_infs) != n:
+            raise ValueError(
+                f"got {len(found_infs)} found_inf flags but amp was "
+                f"initialized with num_losses={n}"
+            )
+        any_inf = found_infs[0]
+        for f in found_infs[1:]:
+            any_inf = jnp.logical_or(any_inf, f)
+        if _is_multi(state.scaler):
+            new_scaler = tuple(
+                self.scaler.update(s, f)
+                for s, f in zip(state.scaler, found_infs)
+            )
+        else:
+            new_scaler = self.scaler.update(state.scaler, found_infs[0])
+        return self._step_unscaled(grads32, state, params, any_inf,
+                                   new_scaler)
 
     # -- introspection / checkpointing -----------------------------------
     def master_params(self, state: AmpOptState, params=None):

@@ -1,0 +1,105 @@
+"""Counter-based RNG for in-kernel dropout (threefry2x32 in plain jnp).
+
+Ref: apex/contrib/csrc/multihead_attn/* (``mask_softmax_dropout_*``) and
+fmha — the reference's attention kernels fuse dropout by drawing Philox
+bits from a per-launch (seed, offset) pair inside the kernel. Same idea
+here, with two TPU-driven differences:
+
+- The generator is **stateless**: every element's bits are a pure function
+  of ``(seed, batch_head, row, col)``. The flash forward visits (q-block,
+  k-block) pairs in a different order than the backward kernels do, so a
+  sequential generator (e.g. ``pltpu.prng_random_bits``, whose stream
+  advances with each call) could never reproduce the forward's mask in the
+  backward. Counter mode makes order irrelevant — and the fwd/bwd masks
+  bit-identical by construction.
+- It is written in **plain jnp uint32 ops** (add/xor/rotate), so the same
+  function runs inside a Pallas kernel body (Mosaic lowers it to VPU ops),
+  in the jnp fallback path, and in interpret mode on CPU — one bit-exact
+  mask everywhere, which is what makes kernel-vs-fallback dropout parity
+  testable at all (``pltpu.prng_seed`` has no CPU interpret lowering).
+
+The cipher is standard threefry2x32-20 (Salmon et al., "Parallel random
+numbers: as easy as 1, 2, 3" — the same generator jax.random is built on);
+validated bit-for-bit against jax's internal implementation in
+tests/L0/test_attention.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# rotation schedule for threefry2x32 (8 constants, cycled; 20 rounds)
+_ROTATIONS = (13, 15, 26, 6, 17, 29, 16, 24)
+# plain int, converted per-call: a module-level jnp constant would be a
+# captured tracer inside Pallas kernel bodies (pallas_call rejects those)
+_PARITY = 0x1BD11BDA
+
+
+def _rotl(x, r: int):
+    return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
+
+
+def threefry2x32(k0, k1, c0, c1):
+    """threefry2x32-20 block cipher: two uint32 key words, two uint32
+    counter words -> two uint32 output words. All inputs broadcast;
+    outputs have the broadcast shape. Pure jnp — safe inside Pallas."""
+    k0 = jnp.asarray(k0, jnp.uint32)
+    k1 = jnp.asarray(k1, jnp.uint32)
+    ks2 = jnp.uint32(_PARITY) ^ k0 ^ k1
+    x0 = jnp.asarray(c0, jnp.uint32) + k0
+    x1 = jnp.asarray(c1, jnp.uint32) + k1
+    ks = (k0, k1, ks2)
+    for r in range(20):
+        x0 = x0 + x1
+        x1 = _rotl(x1, _ROTATIONS[r % 8])
+        x1 = x1 ^ x0
+        if r % 4 == 3:
+            j = r // 4 + 1  # injection index 1..5
+            x0 = x0 + ks[j % 3]
+            x1 = x1 + ks[(j + 1) % 3] + jnp.uint32(j)
+    return x0, x1
+
+
+def keep_threshold(keep_prob: float) -> int:
+    """Static uint32 threshold t with P[bits < t] = keep_prob (+-2^-32)."""
+    assert 0.0 < keep_prob <= 1.0, keep_prob
+    return min(int(round(keep_prob * 2.0 ** 32)), 2 ** 32 - 1)
+
+
+def keep_block(seed0, seed1, bh, row0, col0, shape, threshold: int):
+    """Boolean keep-mask for a [rows, cols] tile whose top-left element is
+    global coordinate (row0, col0) of batch-head ``bh``.
+
+    seed0/seed1: uint32 scalars (traced ok). bh/row0/col0: int scalars
+    (traced ok — program_id * block inside kernels). The key is
+    (seed0, seed1 + bh) and the counter is the global (row, col), so the
+    mask is independent of tiling, loop order, and padding — the property
+    the flash backward relies on to reproduce the forward's mask.
+    """
+    rows, cols = shape
+    r = jnp.uint32(row0) + jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+    c = jnp.uint32(col0) + jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+    bits, _ = threefry2x32(seed0, jnp.uint32(seed1) + jnp.uint32(bh), r, c)
+    return bits < jnp.uint32(threshold)
+
+
+def keep_full(seed, b, sq, sk, threshold: int):
+    """Full [b, sq, sk] keep-mask — the jnp-fallback / oracle view of the
+    exact bits the kernels draw (seed: uint32[2])."""
+    bh = jnp.arange(b, dtype=jnp.uint32)[:, None, None]
+    r = jnp.arange(sq, dtype=jnp.uint32)[None, :, None]
+    c = jnp.arange(sk, dtype=jnp.uint32)[None, None, :]
+    bits, _ = threefry2x32(seed[0], seed[1] + bh, r, c)
+    return bits < jnp.uint32(threshold)
+
+
+def seed_words(rng):
+    """A jax PRNG key (typed or raw uint32[2]) -> uint32[2] seed words for
+    the kernels. Typed keys go through key_data; raw arrays pass through.
+    """
+    if jnp.issubdtype(jnp.asarray(rng).dtype, jax.dtypes.prng_key):
+        rng = jax.random.key_data(rng)
+    rng = jnp.asarray(rng, jnp.uint32)
+    assert rng.shape == (2,), f"expected a 2-word key, got {rng.shape}"
+    return rng

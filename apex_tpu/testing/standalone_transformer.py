@@ -58,6 +58,13 @@ class TransformerConfig:
     causal: bool = True            # GPT; False = BERT
     sequence_parallel: bool = False
     dropout_p: float = 0.0
+    attn_dropout_p: float = 0.0    # dropout on the attention PROBABILITIES,
+                                   # fused into the flash kernel (counter
+                                   # RNG — ops/attention.py). Key comes
+                                   # from the rank-varying model-parallel
+                                   # stream (each TP rank owns different
+                                   # heads; Megatron forks the model-
+                                   # parallel RNG for attention dropout).
     dtype: object = jnp.float32
     model_axis: str = "model"
     context_axis: object = None    # name of a mesh axis sharding the
@@ -129,7 +136,7 @@ class TransformerConfig:
             assert not self.sequence_parallel, (
                 "context_axis and sequence_parallel both shard the sequence"
             )
-            assert self.dropout_p == 0.0, (
+            assert self.dropout_p == 0.0 and self.attn_dropout_p == 0.0, (
                 "context parallelism does not thread per-chunk dropout keys"
             )
 
@@ -207,7 +214,7 @@ def param_specs(cfg: TransformerConfig):
     }
 
 
-def _attention(lp, x, cfg: TransformerConfig, dropout_key):
+def _attention(lp, x, cfg: TransformerConfig, dropout_key, attn_key=None):
     """x: [s(, /tp if SP), b, h] -> same. Column QKV (no output gather) ->
     flash attention on the tp-local heads -> row projection."""
     ax = cfg.model_axis
@@ -230,6 +237,12 @@ def _attention(lp, x, cfg: TransformerConfig, dropout_key):
         from apex_tpu.transformer.context_parallel import ring_attention
 
         o = ring_attention(q, k, v, cfg.context_axis, causal=cfg.causal)
+    elif cfg.attn_dropout_p > 0.0:
+        # fused in-kernel probability dropout; the rank-varying attn_key
+        # desyncs masks across TP ranks (each holds different heads)
+        o = flash_attention(q, k, v, causal=cfg.causal,
+                            dropout_p=cfg.attn_dropout_p,
+                            dropout_rng=attn_key)
     else:
         o = flash_attention(q, k, v, causal=cfg.causal)
     o = o.transpose(2, 0, 1, 3).reshape(s, b, n_local * cfg.head_dim)
@@ -308,12 +321,17 @@ def _forward_hidden(params, tokens, cfg: TransformerConfig, *,
     # rank-varying model-parallel stream is the right one.
     keys = model_parallel_seed(seed, ax)
     mp_key = keys.model_parallel if cfg.sequence_parallel else keys.default
+    # attention-PROB dropout always draws from the rank-varying stream
+    # (folded away from the 2i/2i+1 output-dropout folds above)
+    attn_base = jax.random.fold_in(keys.model_parallel, 0x617474)
 
     def block(x, lp, i):
         k1 = jax.random.fold_in(mp_key, 2 * i)
         k2 = jax.random.fold_in(mp_key, 2 * i + 1)
+        ka = jax.random.fold_in(attn_base, i)
         x = x + _attention(
-            lp, layer_norm(x, lp["ln1"]["gamma"], lp["ln1"]["beta"]), cfg, k1
+            lp, layer_norm(x, lp["ln1"]["gamma"], lp["ln1"]["beta"]), cfg,
+            k1, ka,
         )
         x = x + _mlp(
             lp, layer_norm(x, lp["ln2"]["gamma"], lp["ln2"]["beta"]), cfg, k2

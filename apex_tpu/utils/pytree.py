@@ -169,15 +169,29 @@ def stacked_flags(tree, stacked_key):
         dims = {d for _, d in members}
         if len(members) >= 2 and len(dims) == 1:
             continue
-        if len(members) == 1:
-            import warnings
+        import warnings
 
+        if len(members) == 1:
             warnings.warn(
                 f"collection at {jax.tree_util.keystr(gpath)} has a single "
                 f"array under the stacked key {stacked_key!r} — structurally "
                 "ambiguous, treating it as an ORDINARY tensor (per-tensor "
                 "optimizer statistics). Restructure or pass "
                 "stacked_key=None to silence.",
+                stacklevel=3,
+            )
+        else:
+            # >=2 leaves with DISAGREEING leading dims: a malformed stack
+            # (e.g. one leaf transposed) must not silently flip LAMB/
+            # NovoGrad/LARC from per-layer to whole-tensor statistics
+            # (round-3 advisor item)
+            warnings.warn(
+                f"collection at {jax.tree_util.keystr(gpath)} has leaves "
+                f"with mismatched leading dims {sorted(dims)} under the "
+                f"stacked key {stacked_key!r} — not a lax.scan stack; "
+                "treating ALL its leaves as ORDINARY tensors (per-tensor "
+                "optimizer statistics). Check for a transposed/misshaped "
+                "leaf, or pass stacked_key=None to silence.",
                 stacklevel=3,
             )
         for idx, _ in members:

@@ -219,6 +219,10 @@ def _success_payload(best, sweep, kernels, note=None):
         "unit": "samples/sec/chip",
         "vs_baseline": round(best["mfu"] / 0.50, 4),
         "ok": True,
+        # a truncated sweep still reports its best row with ok:true, but
+        # consumers can tell a degraded partial round from a clean one
+        # without parsing detail.note (round-3 advisor item)
+        "partial": note is not None,
         "detail": {
             "mfu": best["mfu"],
             "step_ms": best["step_ms"],

@@ -81,6 +81,10 @@ def main():
                                             # fwd in the bwd recompute
         "flashsave_chunked": ([], "flash"),  # + fused linear+CE loss
         "pallas_noremat": ([], "none"),
+        "attn_dropout": ([], "full"),   # fused kernel dropout p=0.1 (the
+                                        # as-trained BERT config keeps the
+                                        # flash kernel — verdict Weak #5)
+        "attn_dropout_jnp": (["flash_attention_dropout"], "full"),
         "no_ln": (["layer_norm", "rms_norm"], "full"),
         "no_flash": (["flash_attention"], "full"),
         "no_flash_dots": (["flash_attention"], "dots"),
@@ -94,7 +98,8 @@ def main():
     }
     for name in which:
         disable, remat_mode = variants[name]
-        for k in ("layer_norm", "rms_norm", "flash_attention", "optim_flat"):
+        for k in ("layer_norm", "rms_norm", "flash_attention",
+                  "flash_attention_dropout", "optim_flat"):
             _utils.enable_kernel(k)
         for k in disable:
             _utils.disable_kernel(k)
@@ -108,6 +113,8 @@ def main():
         cfg_over = {"fp32_logits": True} if name == "fp32_logits" else None
         if name in ("chunked_loss", "flashsave_chunked"):
             cfg_over = {"loss_chunk": 8192}
+        if name.startswith("attn_dropout"):
+            cfg_over = {"attn_dropout_p": 0.1}
         try:
             step, args = build_step(batch, remat=remat_mode != "none",
                                     remat_policy=remat_mode,

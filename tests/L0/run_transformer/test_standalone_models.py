@@ -241,3 +241,52 @@ def test_gpt_sp_replicated_grads_in_sync_across_ranks():
 
     assert desync(False) > 1e-6  # the bug is observable...
     assert desync(True) == 0.0  # ...and the sync kills it exactly
+
+
+def test_gpt_attn_dropout_fused_deterministic_and_rank_varying():
+    """Attention-PROB dropout (fused flash kernel path): deterministic for
+    a fixed seed, seed-sensitive, and drawn from the RANK-VARYING stream
+    (each TP rank owns different heads and must draw different bits)."""
+    cfg = TransformerConfig(**CFG, attn_dropout_p=0.4)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    tokens = _tokens()
+
+    def loss_at(tp, seed):
+        mesh = cpu_mesh({"model": tp})
+        return float(jax.jit(smap(
+            lambda p, t: gpt_loss(p, t, cfg, seed=seed),
+            mesh, (param_specs(cfg), P()), P(),
+        ))(params, tokens))
+
+    a, b, c = loss_at(2, 1), loss_at(2, 1), loss_at(2, 2)
+    assert a == b
+    assert a != c
+    # dropout actually perturbs the loss vs the clean model
+    clean = TransformerConfig(**CFG)
+    ref = float(jax.jit(smap(
+        lambda p, t: gpt_loss(p, t, clean),
+        cpu_mesh({"model": 2}), (param_specs(clean), P()), P(),
+    ))(params, tokens))
+    assert a != ref
+    # the rank-varying property itself: the attention key stream must
+    # differ across model ranks — regressing attn_base to the TP-synced
+    # default stream (the silent-desync bug this test pins) fails here
+    from apex_tpu.transformer.tensor_parallel.random import (
+        model_parallel_seed,
+    )
+
+    def attn_key_per_rank():
+        from apex_tpu.ops.block_rng import seed_words
+
+        keys = model_parallel_seed(1, "model")
+        base = jax.random.fold_in(keys.model_parallel, 0x617474)
+        return seed_words(base)[None]
+
+    mesh = cpu_mesh({"model": 2})
+    per_rank = np.asarray(jax.jit(smap(
+        attn_key_per_rank, mesh, (), P("model"),
+    ))())
+    assert per_rank.shape[0] == 2
+    assert (per_rank[0] != per_rank[1]).any(), (
+        "attention dropout keys are TP-synced — masks would repeat "
+        "across ranks that own different heads")

@@ -208,3 +208,72 @@ def test_num_losses_independent_scalers():
         raise AssertionError("expected ValueError")
     except ValueError:
         pass
+
+
+def test_multi_loss_single_combined_step():
+    """Ref: nested amp.scale_loss contexts unscale on exit so two
+    differently-scaled backwards can be SUMMED into ONE optimizer step.
+    Functional form: unscale_gradients per loss -> sum fp32 grads ->
+    apply_unscaled_gradients once. Must match a plain-fp32 single step on
+    summed grads; each scaler advances on its OWN overflow flag, and one
+    poisoned loss skips the shared step without touching the other's
+    scale."""
+    from apex_tpu.optimizers import fused_adam
+
+    params = {"w": jnp.ones((4, 4), jnp.bfloat16)}
+    model_fn, params, opt = amp.initialize(
+        lambda p, x: jnp.sum(p["w"].astype(jnp.float32) * x), params,
+        fused_adam(1e-1), opt_level="O2", num_losses=2, verbosity=0)
+    state = opt.init(params)
+    x0 = jnp.ones((4, 4))
+    x1 = 2.0 * jnp.ones((4, 4))
+
+    g0 = jax.grad(lambda p: amp.scale_loss(model_fn(p, x0), state, 0))(params)
+    g1 = jax.grad(lambda p: amp.scale_loss(model_fn(p, x1), state, 1))(params)
+    u0, inf0 = opt.unscale_gradients(g0, state, loss_id=0)
+    u1, inf1 = opt.unscale_gradients(g1, state, loss_id=1)
+    assert not bool(inf0) and not bool(inf1)
+    summed = jax.tree.map(jnp.add, u0, u1)
+    new_params, new_state = opt.apply_unscaled_gradients(
+        summed, state, params, (inf0, inf1))
+    assert int(new_state.skipped_steps) == 0
+
+    # oracle: one fused_adam step on the true fp32 summed grads
+    import optax
+    ref_grads = {"w": jnp.full((4, 4), 3.0, jnp.float32)}  # d/dw (x0+x1)*w
+    tx = fused_adam(1e-1)
+    ref_upd, _ = tx.update(ref_grads, tx.init(state.master), state.master)
+    ref_master = optax.apply_updates(state.master, ref_upd)
+    np.testing.assert_allclose(
+        np.asarray(new_state.master["w"]), np.asarray(ref_master["w"]),
+        rtol=1e-6)
+
+    # poisoned loss 1: shared step skipped, scaler 1 (only) backs off
+    g_bad = {"w": jnp.full((4, 4), jnp.inf, jnp.bfloat16)}
+    u0b, inf0b = opt.unscale_gradients(g0, new_state, loss_id=0)
+    u1b, inf1b = opt.unscale_gradients(g_bad, new_state, loss_id=1)
+    assert not bool(inf0b) and bool(inf1b)
+    comb = jax.tree.map(jnp.add, u0b, jax.tree.map(
+        lambda g: jnp.where(jnp.isfinite(g), g, 0.0), u1b))
+    before = (float(new_state.scaler[0].scale),
+              float(new_state.scaler[1].scale))
+    p3, s3 = opt.apply_unscaled_gradients(
+        comb, new_state, new_params, (inf0b, inf1b))
+    np.testing.assert_array_equal(
+        np.asarray(p3["w"], np.float32), np.asarray(new_params["w"],
+                                                    np.float32))
+    assert int(s3.skipped_steps) == 1
+    assert float(s3.scaler[0].scale) == before[0]
+    # 8 consecutive overflow rounds exhaust hysteresis -> scale halves
+    for _ in range(7):
+        _, infb = opt.unscale_gradients(g_bad, s3, loss_id=1)
+        _, s3 = opt.apply_unscaled_gradients(
+            u0b, s3, p3, (jnp.bool_(False), infb))
+    assert float(s3.scaler[1].scale) < before[1]
+
+    # wrong flag arity fails loudly
+    try:
+        opt.apply_unscaled_gradients(summed, s3, p3, (inf0,))
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass

@@ -348,3 +348,176 @@ def test_flash_block_size_override_parity(monkeypatch):
     monkeypatch.setenv("APEX_TPU_FLASH_BLOCK", "100")
     with __import__("pytest").raises(ValueError):
         flash_attention(q, k, v, use_pallas=True)
+
+
+# ---------------------------------------------------------------------------
+# fused (in-kernel) dropout — counter-RNG mask (block_rng.py)
+# ---------------------------------------------------------------------------
+
+def test_threefry_matches_jax_internal():
+    """block_rng.threefry2x32 must be bit-identical to the threefry jax
+    itself uses — the cipher the whole fused-dropout design trusts."""
+    from jax._src.prng import threefry_2x32
+
+    from apex_tpu.ops.block_rng import threefry2x32
+
+    k = jnp.array([0xDEADBEEF, 0x12345678], jnp.uint32)
+    c = jnp.arange(64, dtype=jnp.uint32)
+    ref = np.asarray(threefry_2x32(k, c))
+    x0, x1 = threefry2x32(k[0], k[1], c[:32], c[32:])
+    np.testing.assert_array_equal(np.asarray(x0), ref[:32])
+    np.testing.assert_array_equal(np.asarray(x1), ref[32:])
+
+
+@pytest.mark.parametrize("causal,masked,ragged", [
+    (True, False, False),
+    (False, True, False),
+    (False, False, True),   # sq=96 -> padded q blocks exercise coord offsets
+])
+def test_dropout_kernel_matches_ctr_fallback(causal, masked, ragged):
+    """Kernel-path dropout vs the jnp fallback: SAME threefry bits by
+    construction, so fwd and all grads agree to rounding — a bit-exact
+    mask parity test, not a statistical one (round-3 verdict item 5)."""
+    sq = 96 if ragged else 128
+    q, k, v = _make_qkv(2, 2, sq, 128, 64, jnp.float32, seed=5)
+    rng = jax.random.PRNGKey(7)
+    mask = (
+        jnp.zeros((2, 2, 1, 128), bool).at[..., 100:].set(True)
+        if masked else None
+    )
+    do = _rand(jax.random.PRNGKey(9), q.shape, q.dtype)
+
+    def f(q, k, v, use):
+        y = flash_attention(q, k, v, mask=mask, causal=causal,
+                            dropout_p=0.3, dropout_rng=rng, use_pallas=use)
+        return jnp.vdot(y, do), y
+
+    (_, yk), gk = jax.value_and_grad(
+        lambda *a: f(*a, True), argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    (_, yr), gr = jax.value_and_grad(
+        lambda *a: f(*a, False), argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    np.testing.assert_allclose(np.asarray(yk), np.asarray(yr), atol=2e-5)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_dropout_grads_match_explicit_mask_oracle():
+    """End-to-end vjp check against plain autodiff: rebuild the keep mask
+    with block_rng.keep_full, apply it in a pure-jnp attention (normalized
+    softmax -> where(keep, p/keep_prob, 0) -> @v) with NO custom_vjp, and
+    require value + grads of the kernel path to match jax's own autodiff
+    of that function."""
+    from apex_tpu.ops.block_rng import keep_full, keep_threshold, seed_words
+
+    p_drop = 0.25
+    q, k, v = _make_qkv(1, 2, 128, 128, 64, jnp.float32, seed=11)
+    rng = jax.random.PRNGKey(3)
+    do = _rand(jax.random.PRNGKey(4), q.shape, q.dtype)
+    seed = seed_words(rng)
+    thresh = keep_threshold(1.0 - p_drop)
+
+    def oracle(q, k, v):
+        qf = q.reshape(2, 128, 64)
+        kf = k.reshape(2, 128, 64)
+        vf = v.reshape(2, 128, 64)
+        s = jnp.einsum("bqd,bkd->bqk", qf, kf) / np.sqrt(64.0)
+        mask = jnp.tril(jnp.ones((128, 128), bool))
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        keep = keep_full(seed, 2, 128, 128, thresh)
+        pd = jnp.where(keep, p / (1.0 - p_drop), 0.0)
+        o = jnp.einsum("bqk,bkd->bqd", pd, vf)
+        return jnp.vdot(o.reshape(q.shape), do)
+
+    def kernel(q, k, v):
+        y = flash_attention(q, k, v, causal=True, dropout_p=p_drop,
+                            dropout_rng=rng, use_pallas=True)
+        return jnp.vdot(y, do)
+
+    ref_val, ref_g = jax.value_and_grad(oracle, argnums=(0, 1, 2))(q, k, v)
+    ker_val, ker_g = jax.value_and_grad(kernel, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(float(ker_val), float(ref_val), rtol=1e-5)
+    for a, b in zip(ker_g, ref_g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+def test_dropout_keep_fraction_and_head_desync():
+    from apex_tpu.ops.block_rng import keep_full, keep_threshold
+
+    thresh = keep_threshold(0.7)
+    keep = np.asarray(keep_full(jnp.array([5, 6], jnp.uint32), 4, 256, 256,
+                                thresh))
+    frac = keep.mean()
+    assert abs(frac - 0.7) < 0.01, frac
+    # distinct batch*head slices draw distinct masks (TP desync relies on
+    # the bh key fold PLUS a rank-varying seed from the caller)
+    for i in range(3):
+        assert (keep[i] != keep[i + 1]).mean() > 0.1
+
+
+def test_dropout_dbias_with_learned_bias():
+    """Learned additive bias + dropout: dbias comes from the counter-mask
+    unfused pass and must match autodiff of the explicit-mask oracle."""
+    from apex_tpu.ops.block_rng import keep_full, keep_threshold, seed_words
+
+    p_drop = 0.2
+    q, k, v = _make_qkv(1, 2, 128, 128, 64, jnp.float32, seed=13)
+    bias = _rand(jax.random.PRNGKey(14), (1, 2, 128, 128), jnp.float32)
+    rng = jax.random.PRNGKey(15)
+    do = _rand(jax.random.PRNGKey(16), q.shape, q.dtype)
+    seed = seed_words(rng)
+    thresh = keep_threshold(1.0 - p_drop)
+
+    def oracle(bias):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(64.0) + bias
+        p = jax.nn.softmax(s, axis=-1)
+        keep = keep_full(seed, 2, 128, 128, thresh).reshape(p.shape)
+        pd = jnp.where(keep, p / (1.0 - p_drop), 0.0)
+        o = jnp.einsum("bhqk,bhkd->bhqd", pd, v)
+        return jnp.vdot(o, do)
+
+    def fused(bias):
+        y = flash_attention(q, k, v, bias=bias, dropout_p=p_drop,
+                            dropout_rng=rng, use_pallas=True)
+        return jnp.vdot(y, do)
+
+    ref = jax.grad(oracle)(bias)
+    got = jax.grad(fused)(bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=3e-5)
+
+
+def test_dropout_streaming_length_takes_jnp_ctr_path(monkeypatch):
+    """Forced-streaming shapes can't carry the kernel mask; the path must
+    still produce correct (ctr-mask) dropout via the jnp fallback rather
+    than fail or silently drop dropout."""
+    import apex_tpu.ops.attention as A
+
+    monkeypatch.setenv("APEX_TPU_FLASH_STREAM", "1")
+    if not A._use_streaming(128, 128):
+        pytest.skip("streaming family unavailable on this backend "
+                    "(_pltpu is None) — routing covered under APEX_TPU_HW")
+    # the property under test: streaming + dropout resolves to the jnp
+    # counter path, never the (mask-less) streaming kernels
+    assert not A._drop_kernel_ok(True, 128, 128)
+    q, k, v = _make_qkv(1, 1, 128, 128, 64, jnp.float32, seed=17)
+    rng = jax.random.PRNGKey(18)
+    y = flash_attention(q, k, v, dropout_p=0.5, dropout_rng=rng,
+                        use_pallas=True)
+    monkeypatch.delenv("APEX_TPU_FLASH_STREAM")
+    y_ref = flash_attention(q, k, v, dropout_p=0.5, dropout_rng=rng,
+                            use_pallas=False)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=2e-5)
+
+
+def test_dropout_p_one_and_out_of_range():
+    """dropout_p == 1.0 keeps the pre-fusion semantics (all-zero output,
+    zero grads); p > 1 is rejected loudly."""
+    q, k, v = _make_qkv(1, 1, 64, 64, 64, jnp.float32, seed=19)
+    rng = jax.random.PRNGKey(20)
+    y, g = jax.value_and_grad(
+        lambda q: jnp.sum(flash_attention(q, k, v, dropout_p=1.0,
+                                          dropout_rng=rng)))(q)
+    assert float(y) == 0.0
+    assert not np.asarray(g).any()
+    with pytest.raises(ValueError, match="dropout_p"):
+        flash_attention(q, k, v, dropout_p=1.5, dropout_rng=rng)

@@ -295,3 +295,27 @@ def test_stacked_flags_per_collection_independent():
     # flatten order: dec.b, dec.w, enc.b, enc.w, odd.proj (dict keys sorted)
     assert flags == [True, True, True, True, False]
     assert any("ambiguous" in str(x.message) for x in w)
+
+
+def test_stacked_flags_mismatched_leading_dims_warn_and_demote():
+    """>=2 candidate leaves whose leading dims disagree (e.g. one leaf
+    accidentally transposed) are NOT a lax.scan stack: the collection must
+    demote to per-tensor statistics WITH a warning — silence here would
+    flip LAMB/NovoGrad/LARC from per-layer to whole-stack stats with no
+    signal (round-3 advisor item)."""
+    import warnings
+
+    from apex_tpu.utils.pytree import stacked_flags
+
+    tree = {
+        "good": {"layers": {"w": jnp.zeros((12, 4, 4)),
+                            "b": jnp.zeros((12, 4))}},
+        "bad": {"layers": {"w": jnp.zeros((12, 4, 4)),
+                           "b": jnp.zeros((4, 12))}},   # transposed
+    }
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        flags = stacked_flags(tree, "layers")
+    # flatten order: bad.b, bad.w, good.b, good.w
+    assert flags == [False, False, True, True]
+    assert any("mismatched leading dims" in str(x.message) for x in w)
