@@ -192,7 +192,8 @@ def _probe_flash_attention_stream() -> None:
 
 
 def _probe_flash_attention_dropout() -> None:
-    """Fused-dropout flash kernels (counter-RNG mask in fwd + fused bwd).
+    """Fused-dropout flash kernels (counter-RNG mask) — BOTH the resident
+    fwd+fused-bwd pair and the streaming 3-D-grid family.
 
     The jnp fallback draws the SAME threefry bits (block_rng.keep_full),
     so this is an exact-mask grad parity check, not a statistical one. On
@@ -200,28 +201,34 @@ def _probe_flash_attention_dropout() -> None:
     keeps its kernels."""
     from apex_tpu.ops.attention import flash_attention
 
-    with _pinned_env("APEX_TPU_FLASH_STREAM", "0"):
-        rng = jax.random.PRNGKey(17)
-        q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 256, 64),
+    rng = jax.random.PRNGKey(17)
+    # 256 for the resident leg; 512 for the streaming leg so BOTH grid
+    # axes have >= 2 blocks (default block 256) — nonzero keep_block
+    # coordinate offsets and scratch-revisit interaction actually lower,
+    # same reasoning as _probe_flash_attention_stream's shapes
+    for stream, seq in (("0", 256), ("1", 512)):
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, seq, 64),
                               jnp.bfloat16)
-        k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 256, 64),
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, seq, 64),
                               jnp.bfloat16)
-        v = jax.random.normal(jax.random.PRNGKey(2), (1, 2, 256, 64),
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, 2, seq, 64),
                               jnp.bfloat16)
         do = jax.random.normal(jax.random.PRNGKey(3), q.shape, q.dtype)
 
-        def f(q, k, v, use):
+        def f(q, k, v, use, do=do):
             y = flash_attention(q, k, v, causal=True, dropout_p=0.2,
                                 dropout_rng=rng, use_pallas=use)
             return jnp.vdot(y.astype(jnp.float32), do.astype(jnp.float32))
 
-        gp = jax.jit(jax.grad(lambda q, k, v: f(q, k, v, True),
-                              argnums=(0, 1, 2)))(q, k, v)
-        gr = jax.jit(jax.grad(lambda q, k, v: f(q, k, v, False),
-                              argnums=(0, 1, 2)))(q, k, v)
-        for a, c in zip(gp, gr):
-            assert _maxdiff(a, c) < 0.1, \
-                "flash_attention_dropout grad mismatch vs oracle"
+        with _pinned_env("APEX_TPU_FLASH_STREAM", stream):
+            gp = jax.jit(jax.grad(lambda q, k, v: f(q, k, v, True),
+                                  argnums=(0, 1, 2)))(q, k, v)
+            gr = jax.jit(jax.grad(lambda q, k, v: f(q, k, v, False),
+                                  argnums=(0, 1, 2)))(q, k, v)
+            for a, c in zip(gp, gr):
+                assert _maxdiff(a, c) < 0.1, (
+                    "flash_attention_dropout grad mismatch vs oracle "
+                    f"(stream={stream})")
 
 
 # family name (as consulted by default_use_pallas) -> probe

@@ -24,11 +24,11 @@ Semantics notes:
   broadcast to the full score shape, and produces no bias gradient.
 - Dropout on the probabilities follows the reference MHA semantics
   (mask after normalization, 1/(1-p) rescale) and is FUSED into the
-  resident fwd + fused bwd kernels via a counter-based threefry mask
-  (block_rng.py) — the same bits in forward, backward, and the jnp
-  fallback, so training configs with attention dropout keep the kernel
-  path (round-3 verdict Weak #5). Streaming (long-seq) shapes take the
-  jnp counter path; the split/debug backward pair never sees dropout.
+  kernels — resident fwd + fused bwd AND the streaming long-seq family —
+  via a counter-based threefry mask (block_rng.py): the same bits in
+  forward, backward, and the jnp fallback, so training configs with
+  attention dropout keep the kernel path at every length (round-3
+  verdict Weak #5). The split/debug backward pair never sees dropout.
 """
 
 from __future__ import annotations
@@ -228,13 +228,17 @@ def _block_mask(qi, ki, bq, bk, offset, s):
     return jnp.where(cols <= rows + offset, s, _NEG_INF)
 
 
-def _fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, causal, offset, scale, nk):
-    # rest is (bias?, o_ref, lse_ref, acc, m, l) — scratch refs last
-    if len(rest) == 6:
-        bias_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
-    else:
-        bias_ref = None
-        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+def _fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, causal, offset, scale, nk,
+                       has_bias, drop_thresh=None, inv_keep=1.0):
+    # rest is (bias?, seed?, o_ref, lse_ref, acc, m, l) — scratch refs last
+    idx = 0
+    bias_ref = seed_ref = None
+    if has_bias:
+        bias_ref, idx = rest[0], 1
+    if drop_thresh is not None:
+        seed_ref, idx = rest[idx], idx + 1
+    o_ref, lse_ref, acc_ref, m_ref, l_ref = rest[idx:idx + 5]
+    bi = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -265,6 +269,10 @@ def _fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, causal, offset, scale, nk):
         alpha = jnp.exp(m_i - m_new)
         l_ref[...] = l_i * alpha + jnp.sum(p, axis=1, keepdims=True)
         m_ref[...] = m_new
+        if drop_thresh is not None:  # mask the accumulate, not the l sum
+            keep = keep_block(seed_ref[0], seed_ref[1], bi, qi * bq,
+                              ki * bk, (bq, bk), drop_thresh)
+            p = jnp.where(keep, p * inv_keep, 0.0)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p, vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -284,7 +292,7 @@ def _fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, causal, offset, scale, nk):
         lse_ref[0] = m_ref[...] + jnp.log(l_safe)
 
 
-def _fwd_stream_pallas(q, k, v, bias, causal, scale):
+def _fwd_stream_pallas(q, k, v, bias, causal, scale, drop=None):
     b, sq, d = q.shape
     sk = k.shape[1]
     bq = _block_size(sq)
@@ -305,10 +313,15 @@ def _fwd_stream_pallas(q, k, v, bias, causal, scale):
     if bias_p is not None:
         in_specs.append(_bias_spec_stream(broadcast_q, bq, bk, kv_major=False))
         args.append(bias_p)
+    seed, thresh, inv_keep = drop if drop is not None else (None, None, 1.0)
+    if drop is not None:
+        in_specs.append(_seed_spec())
+        args.append(seed)
     o, lse = pl.pallas_call(
         functools.partial(
             _fwd_stream_kernel, causal=causal, offset=sk - sq, scale=scale,
-            nk=nk,
+            nk=nk, has_bias=bias_p is not None, drop_thresh=thresh,
+            inv_keep=inv_keep,
         ),
         grid=(b, nq, nk),
         in_specs=in_specs,
@@ -331,12 +344,16 @@ def _fwd_stream_pallas(q, k, v, bias, causal, scale):
 
 
 def _bwd_dq_stream_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref,
-                          *rest, causal, offset, scale, nk):
-    if len(rest) == 3:
-        bias_ref, dq_ref, acc_ref = rest
-    else:
-        bias_ref = None
-        dq_ref, acc_ref = rest
+                          *rest, causal, offset, scale, nk, has_bias,
+                          drop_thresh=None, inv_keep=1.0):
+    idx = 0
+    bias_ref = seed_ref = None
+    if has_bias:
+        bias_ref, idx = rest[0], 1
+    if drop_thresh is not None:
+        seed_ref, idx = rest[idx], idx + 1
+    dq_ref, acc_ref = rest[idx], rest[idx + 1]
+    bi = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -367,6 +384,10 @@ def _bwd_dq_stream_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref,
             do, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if drop_thresh is not None:  # dP = D∘dPraw, same bits as fwd
+            keep = keep_block(seed_ref[0], seed_ref[1], bi, qi * bq,
+                              ki * bk, (bq, bk), drop_thresh)
+            dp = jnp.where(keep, dp * inv_keep, 0.0)
         ds = p * (dp - delta) * scale
         acc_ref[...] += jax.lax.dot_general(
             ds, kb, (((1,), (0,)), ((), ())),
@@ -386,12 +407,16 @@ def _bwd_dq_stream_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref,
 
 
 def _bwd_dkv_stream_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref,
-                           *rest, causal, offset, scale, nq):
-    if len(rest) == 4:
-        bias_ref, dk_ref, dv_ref, acc2_ref = rest
-    else:
-        bias_ref = None
-        dk_ref, dv_ref, acc2_ref = rest
+                           *rest, causal, offset, scale, nq, has_bias,
+                           drop_thresh=None, inv_keep=1.0):
+    idx = 0
+    bias_ref = seed_ref = None
+    if has_bias:
+        bias_ref, idx = rest[0], 1
+    if drop_thresh is not None:
+        seed_ref, idx = rest[idx], idx + 1
+    dk_ref, dv_ref, acc2_ref = rest[idx:idx + 3]
+    bi = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -419,14 +444,22 @@ def _bwd_dkv_stream_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref,
         if causal:
             s = _block_mask(qi, ki, bq, bk, offset, s)
         p = jnp.where(s > _VALID_THRESHOLD, jnp.exp(s - lse), 0.0)
+        if drop_thresh is not None:
+            keep = keep_block(seed_ref[0], seed_ref[1], bi, qi * bq,
+                              ki * bk, (bq, bk), drop_thresh)
+            p_v = jnp.where(keep, p * inv_keep, 0.0)
+        else:
+            p_v = p
         dv_new = jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_v, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if drop_thresh is not None:
+            dp = jnp.where(keep, dp * inv_keep, 0.0)
         ds = p * (dp - delta) * scale
         dk_new = jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -448,11 +481,13 @@ def _bwd_dkv_stream_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref,
         dv_ref[0] = acc2_ref[1].astype(dv_ref.dtype)
 
 
-def _bwd_stream_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
+def _bwd_stream_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None,
+                       drop=None):
     (qp, kp, vp, dop, lsep, deltap, bias_p, broadcast_q, dims) = \
         _bwd_prologue(q, k, v, bias, o, lse, do, dlse)
     b, sq, sk, d, bq, bk, sqp, skp = dims
     nq, nk = sqp // bq, skp // bk
+    seed, thresh, inv_keep = drop if drop is not None else (None, None, 1.0)
 
     common = [qp, kp, vp, lsep, dop, deltap]
 
@@ -468,10 +503,14 @@ def _bwd_stream_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
     if bias_p is not None:
         dq_specs.append(_bias_spec_stream(broadcast_q, bq, bk, kv_major=False))
         dq_args.append(bias_p)
+    if drop is not None:
+        dq_specs.append(_seed_spec())
+        dq_args.append(seed)
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_stream_kernel, causal=causal, offset=sk - sq,
-            scale=scale, nk=nk,
+            scale=scale, nk=nk, has_bias=bias_p is not None,
+            drop_thresh=thresh, inv_keep=inv_keep,
         ),
         grid=(b, nq, nk),
         in_specs=dq_specs,
@@ -493,10 +532,14 @@ def _bwd_stream_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
     if bias_p is not None:
         dkv_specs.append(_bias_spec_stream(broadcast_q, bq, bk, kv_major=True))
         dkv_args.append(bias_p)
+    if drop is not None:
+        dkv_specs.append(_seed_spec())
+        dkv_args.append(seed)
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_stream_kernel, causal=causal, offset=sk - sq,
-            scale=scale, nq=nq,
+            scale=scale, nq=nq, has_bias=bias_p is not None,
+            drop_thresh=thresh, inv_keep=inv_keep,
         ),
         grid=(b, nk, nq),
         in_specs=dkv_specs,
@@ -576,8 +619,7 @@ def _seed_spec():
 
 def _fwd_pallas(q, k, v, bias, causal, scale, drop=None):
     if _use_streaming(q.shape[1], k.shape[1]):
-        assert drop is None, "streaming kernels take the jnp dropout path"
-        return _fwd_stream_pallas(q, k, v, bias, causal, scale)
+        return _fwd_stream_pallas(q, k, v, bias, causal, scale, drop=drop)
     b, sq, d = q.shape
     sk = k.shape[1]
     bq = _block_size(sq)
@@ -924,14 +966,14 @@ def _bwd_fused_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None,
 
 def _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None,
                 drop=None):
-    if drop is not None:
-        # dropout lives in the fused backward only (the split/debug pair
-        # and the streaming kernels take the jnp counter path instead)
-        return _bwd_fused_pallas(q, k, v, bias, causal, scale, o, lse, do,
-                                 dlse, drop=drop)
     if _use_streaming(q.shape[1], k.shape[1]):
         return _bwd_stream_pallas(q, k, v, bias, causal, scale, o, lse, do,
-                                  dlse)
+                                  dlse, drop=drop)
+    if drop is not None:
+        # resident dropout lives in the fused backward only (the
+        # split/debug pair never sees a mask)
+        return _bwd_fused_pallas(q, k, v, bias, causal, scale, o, lse, do,
+                                 dlse, drop=drop)
     if os.environ.get("APEX_TPU_FLASH_SPLIT_BWD") != "1":
         return _bwd_fused_pallas(q, k, v, bias, causal, scale, o, lse, do,
                                  dlse)
@@ -1154,15 +1196,13 @@ def _flash_core_bwd(causal, scale, use_pallas, need_dbias, res, do):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-def _drop_kernel_ok(use_pallas, sq, sk) -> bool:
-    """Kernel path for fused dropout: resident lengths only (the streaming
-    kernels don't carry the mask), behind its own preflight family so a
-    Mosaic regression in the RNG lowering degrades just this path."""
+def _drop_kernel_ok(use_pallas) -> bool:
+    """Kernel path for fused dropout (resident AND streaming kernels carry
+    the counter-RNG mask), behind its own preflight family so a Mosaic
+    regression in the RNG lowering degrades just this path."""
     if use_pallas is None:
-        use = default_use_pallas("flash_attention_dropout")
-    else:
-        use = use_pallas
-    return use and not _use_streaming(sq, sk)
+        return default_use_pallas("flash_attention_dropout")
+    return use_pallas
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
@@ -1183,7 +1223,7 @@ def _flash_core_drop_fwd(q, k, v, bias, seed, causal, scale, dropout_p,
                          use_pallas, need_dbias):
     thresh = keep_threshold(1.0 - dropout_p)
     inv_keep = 1.0 / (1.0 - dropout_p)
-    if _drop_kernel_ok(use_pallas, q.shape[1], k.shape[1]):
+    if _drop_kernel_ok(use_pallas):
         o, lse = _fwd_pallas(q, k, v, bias, causal, scale,
                              drop=(seed, thresh, inv_keep))
     else:
@@ -1200,7 +1240,7 @@ def _flash_core_drop_bwd(causal, scale, dropout_p, use_pallas, need_dbias,
     thresh = keep_threshold(1.0 - dropout_p)
     inv_keep = 1.0 / (1.0 - dropout_p)
     ds = None
-    if _drop_kernel_ok(use_pallas, q.shape[1], k.shape[1]):
+    if _drop_kernel_ok(use_pallas):
         dq, dk, dv = _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do,
                                  drop=(seed, thresh, inv_keep))
     else:

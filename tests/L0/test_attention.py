@@ -486,27 +486,37 @@ def test_dropout_dbias_with_learned_bias():
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=3e-5)
 
 
-def test_dropout_streaming_length_takes_jnp_ctr_path(monkeypatch):
-    """Forced-streaming shapes can't carry the kernel mask; the path must
-    still produce correct (ctr-mask) dropout via the jnp fallback rather
-    than fail or silently drop dropout."""
+@pytest.mark.parametrize("causal", [True, False])
+def test_dropout_streaming_kernels_match_ctr_fallback(monkeypatch, causal):
+    """The STREAMING kernel family carries the same counter-RNG mask:
+    forced-streaming dropout (multi-block grids, 512x512 at block 128)
+    must match the jnp ctr fallback bit-for-bit in fwd and all grads —
+    the counters are global coordinates, so the (b, qi, ki) vs (b, ki, qi)
+    grid orders and the resident kernels all draw identical masks."""
     import apex_tpu.ops.attention as A
 
     monkeypatch.setenv("APEX_TPU_FLASH_STREAM", "1")
-    if not A._use_streaming(128, 128):
+    monkeypatch.setenv("APEX_TPU_FLASH_BLOCK", "128")
+    if not A._use_streaming(512, 512):
         pytest.skip("streaming family unavailable on this backend "
-                    "(_pltpu is None) — routing covered under APEX_TPU_HW")
-    # the property under test: streaming + dropout resolves to the jnp
-    # counter path, never the (mask-less) streaming kernels
-    assert not A._drop_kernel_ok(True, 128, 128)
-    q, k, v = _make_qkv(1, 1, 128, 128, 64, jnp.float32, seed=17)
+                    "(_pltpu is None) — covered under APEX_TPU_HW")
+    q, k, v = _make_qkv(1, 2, 512, 512, 64, jnp.float32, seed=17)
     rng = jax.random.PRNGKey(18)
-    y = flash_attention(q, k, v, dropout_p=0.5, dropout_rng=rng,
-                        use_pallas=True)
+    do = _rand(jax.random.PRNGKey(21), q.shape, q.dtype)
+
+    def f(q, k, v, use):
+        y = flash_attention(q, k, v, causal=causal, dropout_p=0.4,
+                            dropout_rng=rng, use_pallas=use)
+        return jnp.vdot(y, do), y
+
+    (_, yk), gk = jax.value_and_grad(
+        lambda *a: f(*a, True), argnums=(0, 1, 2), has_aux=True)(q, k, v)
     monkeypatch.delenv("APEX_TPU_FLASH_STREAM")
-    y_ref = flash_attention(q, k, v, dropout_p=0.5, dropout_rng=rng,
-                            use_pallas=False)
-    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=2e-5)
+    (_, yr), gr = jax.value_and_grad(
+        lambda *a: f(*a, False), argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    np.testing.assert_allclose(np.asarray(yk), np.asarray(yr), atol=2e-5)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
 
 
 def test_dropout_p_one_and_out_of_range():
