@@ -112,15 +112,24 @@ def _attn_ref(q, k, v, bias, causal, scale, dropout_p=0.0, dropout_rng=None,
 # Pallas forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, offset, scale, block_k,
-                sk, has_bias, drop_thresh=None, inv_keep=1.0):
+def _unpack_refs(rest, has_bias, has_seed, n_out):
+    """Shared kernel-prologue unpack. Pallas passes refs positionally in
+    in_specs order — rest = ([bias], [seed], *fixed_refs) — and five
+    kernels share the optional-bias/optional-seed convention; one walker
+    keeps their bindings from skewing."""
     idx = 0
     bias_ref = seed_ref = None
     if has_bias:
         bias_ref, idx = rest[0], 1
-    if drop_thresh is not None:
+    if has_seed:
         seed_ref, idx = rest[idx], idx + 1
-    o_ref, lse_ref = rest[idx], rest[idx + 1]
+    return (bias_ref, seed_ref) + tuple(rest[idx:idx + n_out])
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, offset, scale, block_k,
+                sk, has_bias, drop_thresh=None, inv_keep=1.0):
+    bias_ref, seed_ref, o_ref, lse_ref = _unpack_refs(
+        rest, has_bias, drop_thresh is not None, 2)
     q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
     bq, d = q.shape
     nk = sk // block_k
@@ -231,13 +240,8 @@ def _block_mask(qi, ki, bq, bk, offset, s):
 def _fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, causal, offset, scale, nk,
                        has_bias, drop_thresh=None, inv_keep=1.0):
     # rest is (bias?, seed?, o_ref, lse_ref, acc, m, l) — scratch refs last
-    idx = 0
-    bias_ref = seed_ref = None
-    if has_bias:
-        bias_ref, idx = rest[0], 1
-    if drop_thresh is not None:
-        seed_ref, idx = rest[idx], idx + 1
-    o_ref, lse_ref, acc_ref, m_ref, l_ref = rest[idx:idx + 5]
+    bias_ref, seed_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = _unpack_refs(
+        rest, has_bias, drop_thresh is not None, 5)
     bi = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -346,13 +350,8 @@ def _fwd_stream_pallas(q, k, v, bias, causal, scale, drop=None):
 def _bwd_dq_stream_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref,
                           *rest, causal, offset, scale, nk, has_bias,
                           drop_thresh=None, inv_keep=1.0):
-    idx = 0
-    bias_ref = seed_ref = None
-    if has_bias:
-        bias_ref, idx = rest[0], 1
-    if drop_thresh is not None:
-        seed_ref, idx = rest[idx], idx + 1
-    dq_ref, acc_ref = rest[idx], rest[idx + 1]
+    bias_ref, seed_ref, dq_ref, acc_ref = _unpack_refs(
+        rest, has_bias, drop_thresh is not None, 2)
     bi = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -409,13 +408,8 @@ def _bwd_dq_stream_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref,
 def _bwd_dkv_stream_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref,
                            *rest, causal, offset, scale, nq, has_bias,
                            drop_thresh=None, inv_keep=1.0):
-    idx = 0
-    bias_ref = seed_ref = None
-    if has_bias:
-        bias_ref, idx = rest[0], 1
-    if drop_thresh is not None:
-        seed_ref, idx = rest[idx], idx + 1
-    dk_ref, dv_ref, acc2_ref = rest[idx:idx + 3]
+    bias_ref, seed_ref, dk_ref, dv_ref, acc2_ref = _unpack_refs(
+        rest, has_bias, drop_thresh is not None, 3)
     bi = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -683,13 +677,8 @@ def _fwd_pallas(q, k, v, bias, causal, scale, drop=None):
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref, *rest,
                       causal, offset, scale, block_q, sq, has_bias,
                       drop_thresh=None, inv_keep=1.0):
-    idx = 0
-    bias_ref = seed_ref = None
-    if has_bias:
-        bias_ref, idx = rest[0], 1
-    if drop_thresh is not None:
-        seed_ref, idx = rest[idx], idx + 1
-    dq_ref, dk_ref, dv_ref = rest[idx], rest[idx + 1], rest[idx + 2]
+    bias_ref, seed_ref, dq_ref, dk_ref, dv_ref = _unpack_refs(
+        rest, has_bias, drop_thresh is not None, 3)
     kb = k_ref[0].astype(jnp.float32)                 # [bk, d]
     vb = v_ref[0].astype(jnp.float32)
     bk, d = kb.shape

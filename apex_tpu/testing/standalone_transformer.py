@@ -102,6 +102,12 @@ class TransformerConfig:
                                    # recompute (random.py::
                                    # CheckpointFunction) is the analogous
                                    # per-op choice.
+                                   # "flash_offload" = same saved set, but
+                                   # the flash residuals live in
+                                   # pinned_host instead of HBM (device
+                                   # memory of "flash" traded for d2h/h2d
+                                   # transfers — an A/B candidate for
+                                   # batch unlocking on 16 GB chips).
     fp32_logits: bool = False      # force fp32 INPUTS to the lm-head
                                    # matmul (3-pass MXU product + 2x
                                    # logits memory). Default follows
@@ -124,9 +130,9 @@ class TransformerConfig:
                                    # batch x vocab.
 
     def __post_init__(self):
-        assert self.remat_policy in ("full", "dots", "flash", "none"), (
-            f"unknown remat_policy {self.remat_policy!r}"
-        )
+        assert self.remat_policy in (
+            "full", "dots", "flash", "flash_offload", "none"
+        ), f"unknown remat_policy {self.remat_policy!r}"
         assert self.loss_chunk is None or (
             isinstance(self.loss_chunk, int)
             and not isinstance(self.loss_chunk, bool)
@@ -349,6 +355,16 @@ def _forward_hidden(params, tokens, cfg: TransformerConfig, *,
                 block,
                 policy=jax.checkpoint_policies.save_only_these_names(
                     "flash_out", "flash_lse"
+                ),
+            )
+        elif cfg.remat_policy == "flash_offload":
+            block = jax.checkpoint(
+                block,
+                policy=jax.checkpoint_policies
+                .save_and_offload_only_these_names(
+                    names_which_can_be_saved=[],
+                    names_which_can_be_offloaded=["flash_out", "flash_lse"],
+                    offload_src="device", offload_dst="pinned_host",
                 ),
             )
         else:

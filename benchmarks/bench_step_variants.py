@@ -80,6 +80,7 @@ def main():
         "pallas_flashsave": ([], "flash"),  # save flash o/lse, skip its
                                             # fwd in the bwd recompute
         "flashsave_chunked": ([], "flash"),  # + fused linear+CE loss
+        "flash_offload": ([], "flash_offload"),  # flash o/lse to host mem
         "pallas_noremat": ([], "none"),
         "attn_dropout": ([], "full"),   # fused kernel dropout p=0.1 (the
                                         # as-trained BERT config keeps the

@@ -1,0 +1,71 @@
+#!/bin/bash
+# Staged TPU measurement battery (BASELINE.md "Pending hardware
+# measurements" + the round-4 remat/dropout levers). Designed for the
+# axon tunnel's failure modes: every item runs under `timeout`, items
+# continue past individual failures, and the persistent compilation
+# cache is shared so a second window resumes cheaply.
+#
+#   ./benchmarks/run_battery.sh [--wait] [logdir]
+#
+# --wait: poll (2 min interval, up to ~13 h) until a TPU probe succeeds
+# before starting. Logs go to $logdir (default benchmarks/logs_r4).
+
+set -u
+cd "$(dirname "$0")/.."
+
+WAIT=0
+if [ "${1:-}" = "--wait" ]; then WAIT=1; shift; fi
+LOGDIR="${1:-benchmarks/logs_r4}"
+mkdir -p "$LOGDIR"
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}"
+
+probe() {
+  timeout 90 python -c "
+import jax
+d = jax.devices()
+assert d and d[0].platform == 'tpu', d
+print('TPU:', d[0])
+" >> "$LOGDIR/battery.log" 2>&1
+}
+
+log() { echo "[battery $(date -u +%H:%M:%S)] $*" | tee -a "$LOGDIR/battery.log"; }
+
+if [ "$WAIT" = 1 ]; then
+  for i in $(seq 1 400); do
+    if probe; then log "TPU up (probe $i)"; break; fi
+    [ "$i" = 400 ] && { log "TPU never came up"; exit 1; }
+    sleep 120
+  done
+fi
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name="$1" t="$2"; shift 2
+  log "START $name: $*"
+  ( timeout "$t" "$@" ) > "$LOGDIR/$name.log" 2>&1
+  local rc=$?
+  log "END   $name rc=$rc (tail: $(tail -1 "$LOGDIR/$name.log" 2>/dev/null | cut -c1-120))"
+}
+
+# ordered by expected value per minute of tunnel time
+run variants_remat   3600 python benchmarks/bench_step_variants.py 128 \
+                          pallas pallas_flashsave flashsave_chunked flash_offload
+run variants_logits  1800 python benchmarks/bench_step_variants.py 128 fp32_logits
+run variants_dropout 2400 python benchmarks/bench_step_variants.py 128 \
+                          attn_dropout attn_dropout_jnp
+run variants_flash   2400 python benchmarks/bench_step_variants.py 128 \
+                          flash_b128 flash_b512 chunked_loss
+run tests_tpu        3600 env APEX_TPU_HW=1 python -m pytest tests/tpu -q
+run components       2400 python benchmarks/bench_components.py
+run optim_kernels    1800 python benchmarks/bench_optim_kernels.py
+run ops_gbps         1800 python benchmarks/bench_ops.py
+run batch_unlock     3600 env BENCH_LOSS_CHUNK=8192 BENCH_BATCHES=160,192,256 \
+                          BENCH_WATCHDOG_S=3400 python bench.py
+run flash_remat_bench 3600 env BENCH_REMAT=flash BENCH_LOSS_CHUNK=8192 \
+                          BENCH_BATCHES=128,192 BENCH_WATCHDOG_S=3400 python bench.py
+run long_context     2400 python benchmarks/bench_long_context.py
+run ex_mnist         1200 python examples/mnist_mlp_amp.py --bench
+run ex_resnet        2400 python examples/resnet50_amp_ddp.py --bench
+run ex_gpt2tp        2400 python examples/gpt2_tensor_parallel.py --bench
+run ex_retinanet     2400 python examples/retinanet_focal_gn.py --bench
+run ex_main_amp      1200 python examples/main_amp.py --bench
+log "battery complete"
