@@ -6,12 +6,18 @@ groups for a 3D (TP x PP x DP) decomposition; here a single named
 """
 
 from apex_tpu.transformer import context_parallel
+from apex_tpu.transformer import moe
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer import pipeline_parallel
 from apex_tpu.transformer import tensor_parallel
 from apex_tpu.transformer.context_parallel import (
     ring_attention,
     ulysses_attention,
+)
+from apex_tpu.transformer.moe import (
+    MoEConfig,
+    moe_apply,
+    moe_init,
 )
 from apex_tpu.transformer.enums import AttnType, AttnMaskType, LayerType, ModelType
 from apex_tpu.transformer.fused_softmax import (

@@ -1,0 +1,175 @@
+"""Mixture-of-Experts layer with expert parallelism (EP) over a mesh axis.
+
+NOT in the reference — NVIDIA/apex has no MoE layer (SURVEY §3 lists
+none); this is bonus surface completing the framework's parallelism set
+(dp/tp/pp/sp/cp/**ep**), built the TPU way: deterministic capacity-based
+token-choice routing with STATIC shapes (the GShard/Switch einsum
+dispatch — no data-dependent shapes, so the whole layer jits), and the
+dispatch/return exchanges ride two ``lax.all_to_all``s over the expert
+axis (ICI-friendly, the same collective discipline as
+context_parallel.ulysses_attention).
+
+Layout (shard_map-local):
+  x [t, h]           — this rank's tokens (t = local token count)
+  router wg [h, E]   — replicated over the expert axis
+  experts w1 [E_local, h, f], w2 [E_local, f, h] — each rank OWNS
+                       E_local = E / ep_size experts (the EP sharding)
+
+Per token the router picks top-k experts; a token occupies a slot in an
+expert's fixed capacity C = ceil(t * k * capacity_factor / E) in router-
+score order (priority dispatch); overflow tokens are DROPPED from that
+expert — their combine weight is 0 and the caller's residual connection
+carries them through unchanged (Switch-Transformer semantics).
+
+Aux outputs: the Switch load-balance loss (E * Σ_e fraction_e * prob_e)
+and the router z-loss (mean log²Z) — add them to the task loss with small
+coefficients; both psum-ready (they are plain means over local tokens).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    hidden: int
+    ffn: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    expert_axis: object = None     # mesh axis name sharding experts, or
+                                   # None = all experts local (ep = 1)
+    dtype: object = jnp.float32
+
+    def __post_init__(self):
+        assert 1 <= self.top_k <= self.num_experts
+
+    def capacity(self, tokens: int) -> int:
+        c = -(-tokens * self.top_k * self.capacity_factor // self.num_experts)
+        return max(int(c), 1)
+
+
+def moe_init(key, cfg: MoEConfig):
+    """FULL-size params: router [h, E] fp32 (replicate), w1 [E, h, f] and
+    w2 [E, f, h] in cfg.dtype. Under expert parallelism shard w1/w2 on
+    the leading (expert) dim — P(expert_axis, ...) — and let shard_map
+    hand each rank its E_local = E / ep_size slice."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    e, h, f = cfg.num_experts, cfg.hidden, cfg.ffn
+    scale = 0.02
+    return {
+        "router": (jax.random.normal(k1, (h, e)) * scale).astype(jnp.float32),
+        "w1": (jax.random.normal(k2, (e, h, f)) * scale).astype(cfg.dtype),
+        "w2": (jax.random.normal(k3, (e, f, h)) * scale).astype(cfg.dtype),
+    }
+
+
+def _dispatch_masks(logits, cfg: MoEConfig, capacity: int):
+    """Static-shape top-k capacity dispatch.
+
+    logits [t, E] fp32. Returns (dispatch [t, E, C] bool,
+    combine [t, E, C] fp32, aux dict). Tokens take expert slots in
+    router-probability order (priority dispatch): within each expert,
+    higher-prob tokens win the capacity race — deterministic and
+    argsort-stable."""
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)                    # [t, E]
+    _, top_idx = lax.top_k(probs, cfg.top_k)                   # [t, k]
+
+    # kth-choice one-hots, flattened over (token, k): a token can occupy
+    # at most one slot per expert (top_k indices are distinct)
+    sel = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)        # [t, k, E]
+    gate = jnp.take_along_axis(probs, top_idx, axis=-1)        # [t, k]
+
+    # priority order: sort (expert, -prob) pairs implicitly by ranking
+    # each selection within its expert by gate DESC. rank via argsort of
+    # (-gate) per expert using a stable double-argsort over the flat
+    # [t*k] selections.
+    flat_sel = sel.reshape(t * cfg.top_k, e)                   # [tk, E]
+    flat_gate = gate.reshape(t * cfg.top_k)                    # [tk]
+    order = jnp.argsort(-flat_gate)                            # high first
+    sel_sorted = flat_sel[order]
+    pos_sorted = jnp.cumsum(sel_sorted, axis=0) - sel_sorted   # slot index
+    inv = jnp.argsort(order)
+    pos = jnp.take_along_axis(
+        pos_sorted, inv[:, None], axis=0
+    )                                                          # [tk, E]
+    pos = jnp.sum(pos * flat_sel, axis=-1).reshape(t, cfg.top_k)
+    pos = pos.astype(jnp.int32)
+    fits = pos < capacity                                      # [t, k]
+
+    slot = jax.nn.one_hot(
+        jnp.where(fits, pos, capacity), capacity + 1, dtype=jnp.float32
+    )[..., :capacity]                                          # [t, k, C]
+    # dispatch[t, e, c] = 1 iff token t sits in slot c of expert e
+    dispatch = jnp.einsum("tke,tkc->tec", sel, slot)
+    combine = jnp.einsum("tke,tkc,tk->tec", sel, slot,
+                         jnp.where(fits, gate, 0.0))
+
+    # Switch aux losses (computed pre-capacity so the signal pushes the
+    # router toward balance, not toward whatever fit)
+    frac_tokens = jnp.mean(sel[:, 0], axis=0)   # top-1 assignment fraction
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = {
+        "load_balance": e * jnp.sum(frac_tokens * frac_probs),
+        "router_z": jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2),
+        "dropped_fraction": 1.0 - jnp.sum(combine > 0) / (t * cfg.top_k),
+    }
+    return dispatch, combine, aux
+
+
+def moe_apply(params, x, cfg: MoEConfig):
+    """x [t, h] -> ([t, h], aux). Inside shard_map when expert_axis is
+    set: params["w1"/"w2"] are the rank-LOCAL [E_local, ...] shards and
+    two all_to_alls move token slots between expert owners."""
+    t, h = x.shape
+    cap = cfg.capacity(t)
+    logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    dispatch, combine, aux = _dispatch_masks(logits, cfg, cap)
+    # dispatch is one-hot, so this gather-einsum is exact in any dtype;
+    # cast to the compute dtype BEFORE the exchange (halves ICI bytes)
+    xin = jnp.einsum("tec,th->ech", dispatch.astype(cfg.dtype),
+                     x.astype(cfg.dtype))
+
+    if cfg.expert_axis is not None:
+        p = lax.axis_size(cfg.expert_axis)
+        e_local = cfg.num_experts // p
+        # [E, C, h] -> [p, E_local, C, h] -> exchange expert-major for
+        # source-rank-major: each rank ends with ITS experts' slots from
+        # every source rank, concatenated on the slot dim
+        xin = xin.reshape(p, e_local, cap, h)
+        xin = lax.all_to_all(xin, cfg.expert_axis, split_axis=0,
+                             concat_axis=0, tiled=False)       # [p, eL, C, h]
+        xin = xin.transpose(1, 0, 2, 3).reshape(e_local, p * cap, h)
+    # expert FFN — one batched einsum over the local experts; operands in
+    # the compute dtype at full MXU rate, fp32 MXU accumulation
+    hmid = jax.nn.gelu(jnp.einsum(
+        "ech,ehf->ecf", xin, params["w1"],
+        preferred_element_type=jnp.float32))
+    out = jnp.einsum(
+        "ecf,efh->ech", hmid.astype(cfg.dtype), params["w2"],
+        preferred_element_type=jnp.float32)
+    # same cast on BOTH the EP and ep=1 paths (keeps them bitwise equal)
+    # so the return all_to_all also moves compute-dtype bytes
+    out = out.astype(cfg.dtype)
+    if cfg.expert_axis is not None:
+        p = lax.axis_size(cfg.expert_axis)
+        e_local = cfg.num_experts // p
+        out = out.reshape(e_local, p, cap, h).transpose(1, 0, 2, 3)
+        out = lax.all_to_all(out, cfg.expert_axis, split_axis=0,
+                             concat_axis=0, tiled=False)
+        out = out.reshape(cfg.num_experts, cap, h)
+    y = jnp.einsum("tec,ech->th", combine, out.astype(jnp.float32))
+    return y.astype(x.dtype), aux
+
+
+def moe_reference(params, x, cfg: MoEConfig):
+    """ep=1 oracle: identical math with all experts local (used by tests
+    to pin the all_to_all exchange)."""
+    cfg1 = dataclasses.replace(cfg, expert_axis=None)
+    return moe_apply(params, x, cfg1)
