@@ -128,11 +128,30 @@ class TransformerConfig:
                                    # None = dense (default). Exact same
                                    # math; decides peak memory at large
                                    # batch x vocab.
+    moe_experts: int = 0           # > 0 replaces the dense MLP with the
+                                   # MoE layer (transformer/moe.py):
+                                   # experts sharded over the MODEL axis
+                                   # (expert parallelism rides the TP
+                                   # group; attention stays TP). Router
+                                   # is replicated — without SP every
+                                   # rank routes identical tokens, so
+                                   # ep=tp output equals the tp=1 model
+                                   # exactly; under SP router grads join
+                                   # the sp_grad_sync psum class like
+                                   # every replicated leaf. Aux losses
+                                   # (Switch load-balance + router z)
+                                   # are folded into gpt/bert_loss with
+                                   # the coefficients below.
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coeff: float = 0.01    # load-balance loss weight
+    moe_z_coeff: float = 1e-3      # router z-loss weight
 
     def __post_init__(self):
         assert self.remat_policy in (
             "full", "dots", "flash", "flash_offload", "none"
         ), f"unknown remat_policy {self.remat_policy!r}"
+        assert self.moe_experts >= 0
         assert self.loss_chunk is None or (
             isinstance(self.loss_chunk, int)
             and not isinstance(self.loss_chunk, bool)
@@ -167,7 +186,7 @@ def transformer_init(key, cfg: TransformerConfig):
         "layers": [],
     }
     for _ in range(cfg.layers):
-        params["layers"].append({
+        layer = {
             "ln1": {"gamma": jnp.ones((h,), cfg.dtype),
                     "beta": jnp.zeros((h,), cfg.dtype)},
             "qkv": {"kernel": norm(next(keys), (h, 3 * h), 0.02),
@@ -177,13 +196,32 @@ def transformer_init(key, cfg: TransformerConfig):
                      "bias": jnp.zeros((h,), cfg.dtype)},
             "ln2": {"gamma": jnp.ones((h,), cfg.dtype),
                     "beta": jnp.zeros((h,), cfg.dtype)},
-            "fc1": {"kernel": norm(next(keys), (h, ffn), 0.02),
-                    "bias": jnp.zeros((ffn,), cfg.dtype)},
-            "fc2": {"kernel": norm(next(keys), (ffn, h),
-                                   0.02 / (2 * cfg.layers) ** 0.5),
-                    "bias": jnp.zeros((h,), cfg.dtype)},
-        })
+        }
+        if cfg.moe_experts:
+            from apex_tpu.transformer.moe import moe_init
+
+            layer["moe"] = moe_init(next(keys), _moe_cfg(cfg))
+        else:
+            layer.update({
+                "fc1": {"kernel": norm(next(keys), (h, ffn), 0.02),
+                        "bias": jnp.zeros((ffn,), cfg.dtype)},
+                "fc2": {"kernel": norm(next(keys), (ffn, h),
+                                       0.02 / (2 * cfg.layers) ** 0.5),
+                        "bias": jnp.zeros((h,), cfg.dtype)},
+            })
+        params["layers"].append(layer)
     return params
+
+
+def _moe_cfg(cfg: TransformerConfig):
+    from apex_tpu.transformer.moe import MoEConfig
+
+    return MoEConfig(
+        hidden=cfg.hidden, ffn=cfg.hidden * cfg.ffn_mult,
+        num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
+        capacity_factor=cfg.moe_capacity_factor,
+        expert_axis=cfg.model_axis, dtype=cfg.dtype,
+    )
 
 
 def stack_layer_params(params):
@@ -208,9 +246,18 @@ def param_specs(cfg: TransformerConfig):
         "qkv": {"kernel": lspec(None, ax), "bias": lspec(ax)},
         "proj": {"kernel": lspec(ax, None), "bias": lspec()},
         "ln2": {"gamma": lspec(), "beta": lspec()},
-        "fc1": {"kernel": lspec(None, ax), "bias": lspec(ax)},
-        "fc2": {"kernel": lspec(ax, None), "bias": lspec()},
     }
+    if cfg.moe_experts:
+        # experts shard over the model axis (EP rides the TP group);
+        # the router is replicated like LN params
+        layer["moe"] = {"router": lspec(),
+                        "w1": lspec(ax, None, None),
+                        "w2": lspec(ax, None, None)}
+    else:
+        layer.update({
+            "fc1": {"kernel": lspec(None, ax), "bias": lspec(ax)},
+            "fc2": {"kernel": lspec(ax, None), "bias": lspec()},
+        })
     return {
         "embedding": P(ax, None),
         "pos_embedding": P(),
@@ -218,6 +265,16 @@ def param_specs(cfg: TransformerConfig):
         "layers": layer if cfg.scan_layers
         else [dict(layer) for _ in range(cfg.layers)],
     }
+
+
+def _output_dropout(y, cfg: TransformerConfig, dropout_key):
+    """Inverted dropout on a sublayer output (one definition for the
+    attention, dense-MLP, and MoE paths — key discipline is the caller's,
+    see _forward_hidden)."""
+    if cfg.dropout_p > 0.0:
+        keep = jax.random.bernoulli(dropout_key, 1 - cfg.dropout_p, y.shape)
+        y = jnp.where(keep, y / (1 - cfg.dropout_p), 0.0).astype(y.dtype)
+    return y
 
 
 def _attention(lp, x, cfg: TransformerConfig, dropout_key, attn_key=None):
@@ -257,10 +314,7 @@ def _attention(lp, x, cfg: TransformerConfig, dropout_key, attn_key=None):
         input_is_parallel=True,
         sequence_parallel_enabled=cfg.sequence_parallel,
     )
-    if cfg.dropout_p > 0.0:
-        keep = jax.random.bernoulli(dropout_key, 1 - cfg.dropout_p, o.shape)
-        o = jnp.where(keep, o / (1 - cfg.dropout_p), 0.0).astype(o.dtype)
-    return o
+    return _output_dropout(o, cfg, dropout_key)
 
 
 def _mlp(lp, x, cfg: TransformerConfig, dropout_key):
@@ -276,10 +330,25 @@ def _mlp(lp, x, cfg: TransformerConfig, dropout_key):
         input_is_parallel=True,
         sequence_parallel_enabled=cfg.sequence_parallel,
     )
-    if cfg.dropout_p > 0.0:
-        keep = jax.random.bernoulli(dropout_key, 1 - cfg.dropout_p, y.shape)
-        y = jnp.where(keep, y / (1 - cfg.dropout_p), 0.0).astype(y.dtype)
-    return y
+    return _output_dropout(y, cfg, dropout_key)
+
+
+def _moe_mlp(lp, x, cfg: TransformerConfig, dropout_key):
+    """MoE replacement for _mlp: x [s(,/tp under SP), b, h] -> (y, aux).
+    Experts ride the model axis (expert parallelism inside the TP group);
+    aux is the weighted Switch load-balance + router-z scalar for this
+    layer. Without SP every rank routes identical tokens, so the output
+    is TP-replicated exactly like _mlp's row-parallel output."""
+    from apex_tpu.transformer.moe import moe_apply
+
+    s_dim, b = x.shape[0], x.shape[1]
+    y, aux = moe_apply(
+        lp["moe"], x.reshape(s_dim * b, cfg.hidden), _moe_cfg(cfg)
+    )
+    y = _output_dropout(y.reshape(s_dim, b, cfg.hidden), cfg, dropout_key)
+    aux_total = (cfg.moe_aux_coeff * aux["load_balance"]
+                 + cfg.moe_z_coeff * aux["router_z"])
+    return y, aux_total
 
 
 def _forward_hidden(params, tokens, cfg: TransformerConfig, *,
@@ -339,10 +408,12 @@ def _forward_hidden(params, tokens, cfg: TransformerConfig, *,
             lp, layer_norm(x, lp["ln1"]["gamma"], lp["ln1"]["beta"]), cfg,
             k1, ka,
         )
-        x = x + _mlp(
-            lp, layer_norm(x, lp["ln2"]["gamma"], lp["ln2"]["beta"]), cfg, k2
-        )
-        return x
+        ln2 = layer_norm(x, lp["ln2"]["gamma"], lp["ln2"]["beta"])
+        if cfg.moe_experts:
+            y, aux = _moe_mlp(lp, ln2, cfg, k2)
+        else:
+            y, aux = _mlp(lp, ln2, cfg, k2), jnp.float32(0.0)
+        return x + y, aux
 
     if cfg.remat and cfg.remat_policy != "none":
         if cfg.remat_policy == "dots":
@@ -369,14 +440,21 @@ def _forward_hidden(params, tokens, cfg: TransformerConfig, *,
             )
         else:
             block = jax.checkpoint(block)
+    aux_sum = jnp.float32(0.0)
     if cfg.scan_layers:
-        x, _ = jax.lax.scan(
-            lambda carry, li: (block(carry, li[0], li[1]), None),
-            x, (params["layers"], jnp.arange(cfg.layers)),
+        def scan_body(carry, li):
+            x, acc = carry
+            x, aux = block(x, li[0], li[1])
+            return (x, acc + aux), None
+
+        (x, aux_sum), _ = jax.lax.scan(
+            scan_body, (x, aux_sum),
+            (params["layers"], jnp.arange(cfg.layers)),
         )
     else:
         for i, lp in enumerate(params["layers"]):
-            x = block(x, lp, i)
+            x, aux = block(x, lp, i)
+            aux_sum = aux_sum + aux
     # Final LN runs on the seq-sharded x under SP (Megatron keeps it inside
     # the SP region), so its grads are seq-local and sp_grad_sync's psum is
     # the correct completion.
@@ -392,7 +470,14 @@ def _forward_hidden(params, tokens, cfg: TransformerConfig, *,
         x = gather_from_sequence_parallel_region(x, ax, True)
     else:
         x = copy_to_tensor_model_parallel_region(x, ax)
-    return x
+    # MoE aux must be a TP-consistent scalar: under SP each model rank
+    # routed only its s/tp tokens (under CP its seq chunk) — average so
+    # every rank adds the same aux to the loss
+    if cfg.moe_experts and cfg.sequence_parallel:
+        aux_sum = jax.lax.pmean(aux_sum, ax)
+    if cfg.moe_experts and cfg.context_axis is not None:
+        aux_sum = jax.lax.pmean(aux_sum, cfg.context_axis)
+    return x, aux_sum
 
 
 def _lm_logits(x, params, cfg: TransformerConfig):
@@ -414,8 +499,9 @@ def _lm_logits(x, params, cfg: TransformerConfig):
 
 def transformer_forward(params, tokens, cfg: TransformerConfig, *,
                         seed: int = 1234):
-    """Full forward to vocab-parallel logits [s, b, v/tp]."""
-    x = _forward_hidden(params, tokens, cfg, seed=seed)
+    """Full forward to vocab-parallel logits [s, b, v/tp]. (MoE aux
+    losses are dropped here — use gpt_loss/bert_loss for training.)"""
+    x, _ = _forward_hidden(params, tokens, cfg, seed=seed)
     return _lm_logits(x, params, cfg)
 
 
@@ -480,35 +566,35 @@ def gpt_loss(params, tokens, cfg: TransformerConfig, *, seed: int = 1234):
             jnp.ones((s_local,), bool),
         ).astype(jnp.float32)
         weights = jnp.broadcast_to(valid[:, None], (s_local, b))
+        x, aux = _forward_hidden(params, tokens, cfg, seed=seed)
         if cfg.loss_chunk:
-            x = _forward_hidden(params, tokens, cfg, seed=seed)
             total = _chunked_masked_ce(x, params, targets, weights, cfg)
         else:
-            logits = transformer_forward(params, tokens, cfg, seed=seed)
+            logits = _lm_logits(x, params, cfg)
             losses = vocab_parallel_cross_entropy(
                 logits, targets, axis=cfg.model_axis
             )                                        # [s_local, b]
             total = (losses * weights).sum()
         total = jax.lax.psum(total, axc)
         count = jax.lax.psum(valid.sum() * b, axc)
-        return total / count
+        return total / count + aux
     s_len, b = tokens.shape[1], tokens.shape[0]
+    x, aux = _forward_hidden(params, tokens, cfg, seed=seed)
     if cfg.loss_chunk:
         # weight 0 on the final position replaces the logits[:-1] slice
-        x = _forward_hidden(params, tokens, cfg, seed=seed)
         targets = jnp.roll(tokens, -1, axis=1).transpose(1, 0)   # [s, b]
         weights = jnp.broadcast_to(
             (jnp.arange(s_len) < s_len - 1).astype(jnp.float32)[:, None],
             (s_len, b),
         )
         total = _chunked_masked_ce(x, params, targets, weights, cfg)
-        return total / ((s_len - 1) * b)
-    logits = transformer_forward(params, tokens, cfg, seed=seed)
+        return total / ((s_len - 1) * b) + aux
+    logits = _lm_logits(x, params, cfg)
     targets = tokens[:, 1:].transpose(1, 0)          # [s-1, b]
     losses = vocab_parallel_cross_entropy(
         logits[:-1], targets, axis=cfg.model_axis
     )
-    return losses.mean()
+    return losses.mean() + aux
 
 
 def bert_loss(params, tokens, labels, loss_mask, cfg: TransformerConfig, *,
@@ -522,13 +608,13 @@ def bert_loss(params, tokens, labels, loss_mask, cfg: TransformerConfig, *,
     means would weight shards with few masked tokens too heavily.
     """
     mask = loss_mask.transpose(1, 0).astype(jnp.float32)
+    x, aux = _forward_hidden(params, tokens, cfg, seed=seed)
     if cfg.loss_chunk:
-        x = _forward_hidden(params, tokens, cfg, seed=seed)
         total = _chunked_masked_ce(
             x, params, labels.transpose(1, 0), mask, cfg
         )
     else:
-        logits = transformer_forward(params, tokens, cfg, seed=seed)
+        logits = _lm_logits(x, params, cfg)
         losses = vocab_parallel_cross_entropy(
             logits, labels.transpose(1, 0), axis=cfg.model_axis
         )
@@ -537,7 +623,8 @@ def bert_loss(params, tokens, labels, loss_mask, cfg: TransformerConfig, *,
     for axis in reduce_axes:
         total = jax.lax.psum(total, axis)
         count = jax.lax.psum(count, axis)
-    return total / jnp.maximum(count, 1.0)
+        aux = jax.lax.pmean(aux, axis)
+    return total / jnp.maximum(count, 1.0) + aux
 
 
 def sp_grad_sync(grads, cfg: TransformerConfig):

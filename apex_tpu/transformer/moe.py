@@ -138,6 +138,9 @@ def moe_apply(params, x, cfg: MoEConfig):
 
     if cfg.expert_axis is not None:
         p = lax.axis_size(cfg.expert_axis)
+        assert cfg.num_experts % p == 0, (
+            f"num_experts={cfg.num_experts} not divisible by "
+            f"|{cfg.expert_axis}|={p}")
         e_local = cfg.num_experts // p
         # [E, C, h] -> [p, E_local, C, h] -> exchange expert-major for
         # source-rank-major: each rank ends with ITS experts' slots from
