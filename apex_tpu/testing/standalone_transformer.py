@@ -342,8 +342,12 @@ def _moe_mlp(lp, x, cfg: TransformerConfig, dropout_key):
     from apex_tpu.transformer.moe import moe_apply
 
     s_dim, b = x.shape[0], x.shape[1]
+    # without SP the activations are TP-replicated: every model rank
+    # routes the same tokens, so the expert-grad 1/p correction applies
+    # (see moe_apply); under SP each rank holds its own s/tp tokens
     y, aux = moe_apply(
-        lp["moe"], x.reshape(s_dim * b, cfg.hidden), _moe_cfg(cfg)
+        lp["moe"], x.reshape(s_dim * b, cfg.hidden), _moe_cfg(cfg),
+        tokens_replicated_over_axis=not cfg.sequence_parallel,
     )
     y = _output_dropout(y.reshape(s_dim, b, cfg.hidden), cfg, dropout_key)
     aux_total = (cfg.moe_aux_coeff * aux["load_balance"]

@@ -29,10 +29,28 @@ coefficients; both psum-ready (they are plain means over local tokens).
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _grad_scale(x, s: float):
+    """Identity forward, cotangent scaled by ``s`` in the backward."""
+    return x
+
+
+def _grad_scale_fwd(x, s):
+    return x, None
+
+
+def _grad_scale_bwd(s, _, ct):
+    return (ct * s,)
+
+
+_grad_scale.defvjp(_grad_scale_fwd, _grad_scale_bwd)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -123,12 +141,30 @@ def _dispatch_masks(logits, cfg: MoEConfig, capacity: int):
     return dispatch, combine, aux
 
 
-def moe_apply(params, x, cfg: MoEConfig):
+def moe_apply(params, x, cfg: MoEConfig, *,
+              tokens_replicated_over_axis: bool = False):
     """x [t, h] -> ([t, h], aux). Inside shard_map when expert_axis is
     set: params["w1"/"w2"] are the rank-LOCAL [E_local, ...] shards and
-    two all_to_alls move token slots between expert owners."""
+    two all_to_alls move token slots between expert owners.
+
+    ``tokens_replicated_over_axis``: set True when x is the SAME tokens on
+    every expert-axis rank (e.g. MoE riding a TP group without sequence
+    parallelism). The forward is then p-fold redundant but correct; the
+    BACKWARD however hands each expert owner p identical cotangent copies
+    through the all_to_all transpose, so the local expert grads come out
+    p x the true gradient — corrected here by scaling the w1/w2
+    cotangents by 1/p (the router's grads flow only through this rank's
+    own combine weights and are already 1x). With genuinely sharded
+    tokens (SP, or one shard per rank) leave it False: each expert's grad
+    sums DISJOINT token slices and is already complete."""
     t, h = x.shape
     cap = cfg.capacity(t)
+    w1, w2 = params["w1"], params["w2"]
+    if tokens_replicated_over_axis and cfg.expert_axis is not None:
+        inv_p = 1.0 / lax.axis_size(cfg.expert_axis)
+        w1 = _grad_scale(w1, inv_p)
+        w2 = _grad_scale(w2, inv_p)
+    params = dict(params, w1=w1, w2=w2)
     logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
     dispatch, combine, aux = _dispatch_masks(logits, cfg, cap)
     # dispatch is one-hot, so this gather-einsum is exact in any dtype;

@@ -68,4 +68,5 @@ run ex_resnet        2400 python examples/resnet50_amp_ddp.py --bench
 run ex_gpt2tp        2400 python examples/gpt2_tensor_parallel.py --bench
 run ex_retinanet     2400 python examples/retinanet_focal_gn.py --bench
 run ex_main_amp      1200 python examples/main_amp.py --bench
+run ex_moe           2400 python examples/gpt_moe_ep.py --bench
 log "battery complete"

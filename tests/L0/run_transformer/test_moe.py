@@ -157,7 +157,11 @@ def test_moe_gpt_tp_matches_single_device():
     """moe_experts>0 swaps the dense MLP for the MoE layer with experts
     sharded over the MODEL axis. Without SP every rank routes identical
     (replicated) tokens, so tp=4 (ep=4) must equal the tp=1 model
-    exactly — the expert-parallel analog of the TP parity contract."""
+    exactly — LOSS AND GRADS. The grad half pins the 1/ep cotangent
+    correction in moe_apply(tokens_replicated_over_axis=True): without
+    it each expert owner receives ep identical cotangent copies through
+    the all_to_all transpose and w1/w2 grads come out exactly ep x too
+    large (found by review; the fwd-only check missed it)."""
     from apex_tpu.testing import (TransformerConfig, gpt_loss, param_specs,
                                   transformer_init)
 
@@ -167,15 +171,26 @@ def test_moe_gpt_tp_matches_single_device():
     params = transformer_init(jax.random.PRNGKey(0), cfg)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 96)
 
-    def loss_at(tp):
+    def loss_and_grads_at(tp):
         mesh = cpu_mesh({"model": tp})
-        return float(jax.jit(smap(
-            lambda p, t: gpt_loss(p, t, cfg),
-            mesh, (param_specs(cfg), P()), P(),
-        ))(params, tokens))
+        specs = param_specs(cfg)
+        loss, g = jax.jit(smap(
+            lambda p, t: jax.value_and_grad(
+                lambda q: gpt_loss(q, t, cfg))(p),
+            mesh, (specs, P()), (P(), specs),
+        ))(params, tokens)
+        return float(loss), g
 
-    ref = loss_at(1)
-    np.testing.assert_allclose(loss_at(4), ref, rtol=1e-5)
+    ref, g_ref = loss_and_grads_at(1)
+    out, g_out = loss_and_grads_at(4)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(g_ref)[0],
+        jax.tree_util.tree_flatten_with_path(g_out)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-4, atol=1e-6,
+            err_msg=jax.tree_util.keystr(path))
     # aux losses are actually in the loss: zeroing the coefficients moves it
     cfg0 = TransformerConfig(**CFG, moe_aux_coeff=0.0, moe_z_coeff=0.0)
     mesh = cpu_mesh({"model": 1})

@@ -214,3 +214,25 @@ def test_flash_offload_policy_matches_full_remat():
     )(stacked, tokens)
     np.testing.assert_allclose(float(loss_scan), float(loss_full),
                                rtol=1e-6)
+
+
+def test_flash_policy_composes_with_fused_attn_dropout():
+    """The as-trained config: attn_dropout_p > 0 AND remat_policy='flash'.
+    The dropout core names its (o, lse) the same way, so the policy saves
+    them and the backward recompute regenerates the SAME counter-RNG mask
+    — loss and grads must match full remat exactly."""
+    cfg_kw = dict(**CFG, attn_dropout_p=0.2)
+    params = transformer_init(jax.random.PRNGKey(0),
+                              TransformerConfig(**cfg_kw))
+    tokens = _tokens()
+    loss_full, g_full = _grad_fn(
+        TransformerConfig(**cfg_kw, remat=True, remat_policy="full")
+    )(params, tokens)
+    loss_flash, g_flash = _grad_fn(
+        TransformerConfig(**cfg_kw, remat=True, remat_policy="flash")
+    )(params, tokens)
+    np.testing.assert_allclose(float(loss_flash), float(loss_full),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_flash)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
