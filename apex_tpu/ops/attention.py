@@ -296,8 +296,8 @@ def _fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, causal, offset, scale, nk,
         lse_ref[0] = m_ref[...] + jnp.log(l_safe)
 
 
-def _fwd_stream_pallas(q, k, v, bias, causal, scale, drop=None):
-    b, sq, d = q.shape
+def _fwd_stream_pallas(q, k, v, bias, causal, scale, drop=None, group=1):
+    b, sq, d = q.shape                    # b = batch * QUERY heads
     sk = k.shape[1]
     bq = _block_size(sq)
     bk = _block_size(sk)
@@ -310,8 +310,8 @@ def _fwd_stream_pallas(q, k, v, bias, causal, scale, drop=None):
 
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda i, qi, ki: (i, qi, 0)),
-        pl.BlockSpec((1, bk, d), lambda i, qi, ki: (i, ki, 0)),
-        pl.BlockSpec((1, bk, d), lambda i, qi, ki: (i, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, qi, ki: (i // group, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, qi, ki: (i // group, ki, 0)),
     ]
     args = [qp, kp, vp]
     if bias_p is not None:
@@ -476,10 +476,10 @@ def _bwd_dkv_stream_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref,
 
 
 def _bwd_stream_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None,
-                       drop=None):
+                       drop=None, group=1):
     (qp, kp, vp, dop, lsep, deltap, bias_p, broadcast_q, dims) = \
         _bwd_prologue(q, k, v, bias, o, lse, do, dlse)
-    b, sq, sk, d, bq, bk, sqp, skp = dims
+    b, sq, sk, d, bq, bk, sqp, skp = dims  # b = batch * QUERY heads
     nq, nk = sqp // bq, skp // bk
     seed, thresh, inv_keep = drop if drop is not None else (None, None, 1.0)
 
@@ -487,8 +487,8 @@ def _bwd_stream_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None,
 
     dq_specs = [
         pl.BlockSpec((1, bq, d), lambda i, qi, ki: (i, qi, 0)),
-        pl.BlockSpec((1, bk, d), lambda i, qi, ki: (i, ki, 0)),
-        pl.BlockSpec((1, bk, d), lambda i, qi, ki: (i, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, qi, ki: (i // group, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, qi, ki: (i // group, ki, 0)),
         pl.BlockSpec((1, bq, 1), lambda i, qi, ki: (i, qi, 0)),
         pl.BlockSpec((1, bq, d), lambda i, qi, ki: (i, qi, 0)),
         pl.BlockSpec((1, bq, 1), lambda i, qi, ki: (i, qi, 0)),
@@ -516,8 +516,8 @@ def _bwd_stream_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None,
 
     dkv_specs = [
         pl.BlockSpec((1, bq, d), lambda i, ki, qi: (i, qi, 0)),
-        pl.BlockSpec((1, bk, d), lambda i, ki, qi: (i, ki, 0)),
-        pl.BlockSpec((1, bk, d), lambda i, ki, qi: (i, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, ki, qi: (i // group, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, ki, qi: (i // group, ki, 0)),
         pl.BlockSpec((1, bq, 1), lambda i, ki, qi: (i, qi, 0)),
         pl.BlockSpec((1, bq, d), lambda i, ki, qi: (i, qi, 0)),
         pl.BlockSpec((1, bq, 1), lambda i, ki, qi: (i, qi, 0)),
@@ -611,10 +611,11 @@ def _seed_spec():
     return pl.BlockSpec((2,), lambda *_: (0,))
 
 
-def _fwd_pallas(q, k, v, bias, causal, scale, drop=None):
+def _fwd_pallas(q, k, v, bias, causal, scale, drop=None, group=1):
     if _use_streaming(q.shape[1], k.shape[1]):
-        return _fwd_stream_pallas(q, k, v, bias, causal, scale, drop=drop)
-    b, sq, d = q.shape
+        return _fwd_stream_pallas(q, k, v, bias, causal, scale, drop=drop,
+                                  group=group)
+    b, sq, d = q.shape                    # b = batch * QUERY heads
     sk = k.shape[1]
     bq = _block_size(sq)
     bk = _block_size(sk)
@@ -631,10 +632,12 @@ def _fwd_pallas(q, k, v, bias, causal, scale, drop=None):
         block_k=bk, sk=skp, has_bias=bias_p is not None,
         drop_thresh=thresh, inv_keep=inv_keep,
     )
+    # GQA: the group's q heads read the SAME kv row (index i // group);
+    # consecutive grid steps with an unchanged index skip the re-fetch
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-        pl.BlockSpec((1, skp, d), lambda i, j: (i, 0, 0)),
-        pl.BlockSpec((1, skp, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, skp, d), lambda i, j: (i // group, 0, 0)),
+        pl.BlockSpec((1, skp, d), lambda i, j: (i // group, 0, 0)),
     ]
     args = [qp, kp, vp]
     if bias_p is not None:
@@ -904,16 +907,18 @@ def _bwd_prologue(q, k, v, bias, o, lse, do, dlse):
 
 
 def _bwd_fused_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None,
-                      drop=None):
+                      drop=None, group=1):
     (qp, kp, vp, dop, lsep, deltap, bias_p, broadcast_q, dims) = \
         _bwd_prologue(q, k, v, bias, o, lse, do, dlse)
-    b, sq, sk, d, bq, bk, sqp, skp = dims
+    b, sq, sk, d, bq, bk, sqp, skp = dims  # b = batch * QUERY heads
 
     common = [qp, kp, vp, lsep, dop, deltap]
+    # GQA: kv reads shared across the group (i // group); dk/dv emit one
+    # slice PER Q HEAD (out index i) — the caller group-sums them
     specs = [
         pl.BlockSpec((1, sqp, d), lambda i, j: (i, 0, 0)),
-        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
-        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, j: (i // group, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, j: (i // group, j, 0)),
         pl.BlockSpec((1, sqp, 1), lambda i, j: (i, 0, 0)),
         pl.BlockSpec((1, sqp, d), lambda i, j: (i, 0, 0)),
         pl.BlockSpec((1, sqp, 1), lambda i, j: (i, 0, 0)),
@@ -954,25 +959,29 @@ def _bwd_fused_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None,
 
 
 def _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None,
-                drop=None):
+                drop=None, group=1):
+    """dk/dv come back PER QUERY HEAD ([Bq, sk, d]) when group > 1 — the
+    caller applies _sum_groups."""
     if _use_streaming(q.shape[1], k.shape[1]):
         return _bwd_stream_pallas(q, k, v, bias, causal, scale, o, lse, do,
-                                  dlse, drop=drop)
+                                  dlse, drop=drop, group=group)
     if drop is not None:
         # resident dropout lives in the fused backward only (the
         # split/debug pair never sees a mask)
         return _bwd_fused_pallas(q, k, v, bias, causal, scale, o, lse, do,
-                                 dlse, drop=drop)
+                                 dlse, drop=drop, group=group)
     if os.environ.get("APEX_TPU_FLASH_SPLIT_BWD") != "1":
         return _bwd_fused_pallas(q, k, v, bias, causal, scale, o, lse, do,
-                                 dlse)
-    return _bwd_split_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse)
+                                 dlse, group=group)
+    return _bwd_split_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse,
+                             group=group)
 
 
-def _bwd_split_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
+def _bwd_split_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None,
+                      group=1):
     (qp, kp, vp, dop, lsep, deltap, bias_p, broadcast_q, dims) = \
         _bwd_prologue(q, k, v, bias, o, lse, do, dlse)
-    b, sq, sk, d, bq, bk, sqp, skp = dims
+    b, sq, sk, d, bq, bk, sqp, skp = dims  # b = batch * QUERY heads
 
     common = [qp, kp, vp, lsep, dop, deltap]
     if bias_p is not None:
@@ -980,8 +989,8 @@ def _bwd_split_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
 
     dq_specs = [
         pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-        pl.BlockSpec((1, skp, d), lambda i, j: (i, 0, 0)),
-        pl.BlockSpec((1, skp, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, skp, d), lambda i, j: (i // group, 0, 0)),
+        pl.BlockSpec((1, skp, d), lambda i, j: (i // group, 0, 0)),
         pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),
         pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
         pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),
@@ -1002,8 +1011,8 @@ def _bwd_split_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None):
 
     dkv_specs = [
         pl.BlockSpec((1, sqp, d), lambda i, j: (i, 0, 0)),
-        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
-        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, j: (i // group, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, j: (i // group, j, 0)),
         pl.BlockSpec((1, sqp, 1), lambda i, j: (i, 0, 0)),
         pl.BlockSpec((1, sqp, d), lambda i, j: (i, 0, 0)),
         pl.BlockSpec((1, sqp, 1), lambda i, j: (i, 0, 0)),
@@ -1136,18 +1145,21 @@ def _dbias_from_ds(ds, bias):
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_core(q, k, v, bias, causal, scale, use_pallas, need_dbias):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_core(q, k, v, bias, causal, scale, use_pallas, need_dbias,
+                group=1):
     return _flash_core_fwd(q, k, v, bias, causal, scale, use_pallas,
-                           need_dbias)[0]
+                           need_dbias, group)[0]
 
 
-def _flash_core_fwd(q, k, v, bias, causal, scale, use_pallas, need_dbias):
+def _flash_core_fwd(q, k, v, bias, causal, scale, use_pallas, need_dbias,
+                    group=1):
     use = default_use_pallas("flash_attention") if use_pallas is None else use_pallas
     if use:
-        o, lse = _fwd_pallas(q, k, v, bias, causal, scale)
+        o, lse = _fwd_pallas(q, k, v, bias, causal, scale, group=group)
     else:
-        o, lse = _attn_ref(q, k, v, bias, causal, scale)
+        o, lse = _attn_ref(q, _rep_kv(k, group), _rep_kv(v, group), bias,
+                           causal, scale)
     # Name the kernel's residuals so remat policies can pin them:
     # jax.checkpoint(policy=save_only_these_names("flash_out", "flash_lse"))
     # then keeps exactly (o, lse) across the forward, and the backward
@@ -1161,21 +1173,25 @@ def _flash_core_fwd(q, k, v, bias, causal, scale, use_pallas, need_dbias):
     return o, (q, k, v, bias, o, lse)
 
 
-def _flash_core_bwd(causal, scale, use_pallas, need_dbias, res, do):
+def _flash_core_bwd(causal, scale, use_pallas, need_dbias, group, res, do):
     q, k, v, bias, o, lse = res
     use = default_use_pallas("flash_attention") if use_pallas is None else use_pallas
     ds = None
     if use:
-        dq, dk, dv = _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do)
+        dq, dk, dv = _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do,
+                                 group=group)
     else:
-        dq, dk, dv, ds = _bwd_ref(q, k, v, bias, causal, scale, o, lse, do)
+        dq, dk, dv, ds = _bwd_ref(q, _rep_kv(k, group), _rep_kv(v, group),
+                                  bias, causal, scale, o, lse, do)
+    dk, dv = _sum_groups(dk, group), _sum_groups(dv, group)
     dbias = None
     if bias is not None:
         if need_dbias:
             if ds is None:  # pallas path: one unfused pass just for dbias
                 _check_dbias_seq(q, k)
-                _, ds, _ = _bwd_pieces(q, k, v, bias, causal, scale, o, lse,
-                                       do)
+                _, ds, _ = _bwd_pieces(q, _rep_kv(k, group),
+                                       _rep_kv(v, group), bias, causal,
+                                       scale, o, lse, do)
             dbias = _dbias_from_ds(ds, bias)
         else:  # bias came from a boolean mask — no gradient wanted
             dbias = jnp.zeros_like(bias)
@@ -1194,9 +1210,9 @@ def _drop_kernel_ok(use_pallas) -> bool:
     return use_pallas
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash_core_drop(q, k, v, bias, seed, causal, scale, dropout_p,
-                     use_pallas, need_dbias):
+                     use_pallas, need_dbias, group=1):
     """_flash_core with fused probability dropout. ``seed`` is uint32[2]
     (from block_rng.seed_words); the keep mask is a pure function of
     (seed, batch_head, row, col) — identical bits in the forward kernel,
@@ -1205,18 +1221,19 @@ def _flash_core_drop(q, k, v, bias, seed, causal, scale, dropout_p,
     counter-mode here because the TPU fwd/bwd kernels visit blocks in
     different orders (see block_rng.py)."""
     return _flash_core_drop_fwd(q, k, v, bias, seed, causal, scale,
-                                dropout_p, use_pallas, need_dbias)[0]
+                                dropout_p, use_pallas, need_dbias, group)[0]
 
 
 def _flash_core_drop_fwd(q, k, v, bias, seed, causal, scale, dropout_p,
-                         use_pallas, need_dbias):
+                         use_pallas, need_dbias, group=1):
     thresh = keep_threshold(1.0 - dropout_p)
     inv_keep = 1.0 / (1.0 - dropout_p)
     if _drop_kernel_ok(use_pallas):
         o, lse = _fwd_pallas(q, k, v, bias, causal, scale,
-                             drop=(seed, thresh, inv_keep))
+                             drop=(seed, thresh, inv_keep), group=group)
     else:
-        o, lse = _attn_ref(q, k, v, bias, causal, scale,
+        o, lse = _attn_ref(q, _rep_kv(k, group), _rep_kv(v, group), bias,
+                           causal, scale,
                            ctr_drop=(seed, thresh, inv_keep))
     o = checkpoint_name(o, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
@@ -1224,24 +1241,27 @@ def _flash_core_drop_fwd(q, k, v, bias, seed, causal, scale, dropout_p,
 
 
 def _flash_core_drop_bwd(causal, scale, dropout_p, use_pallas, need_dbias,
-                         res, do):
+                         group, res, do):
     q, k, v, bias, seed, o, lse = res
     thresh = keep_threshold(1.0 - dropout_p)
     inv_keep = 1.0 / (1.0 - dropout_p)
     ds = None
     if _drop_kernel_ok(use_pallas):
         dq, dk, dv = _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do,
-                                 drop=(seed, thresh, inv_keep))
+                                 drop=(seed, thresh, inv_keep), group=group)
     else:
-        dq, dk, dv, ds = _bwd_ref(q, k, v, bias, causal, scale, o, lse, do,
+        dq, dk, dv, ds = _bwd_ref(q, _rep_kv(k, group), _rep_kv(v, group),
+                                  bias, causal, scale, o, lse, do,
                                   ctr_drop=(seed, thresh, inv_keep))
+    dk, dv = _sum_groups(dk, group), _sum_groups(dv, group)
     dbias = None
     if bias is not None:
         if need_dbias:
             if ds is None:  # kernel path: one unfused pass just for dbias
                 _check_dbias_seq(q, k)
-                _, ds, _ = _bwd_pieces(q, k, v, bias, causal, scale, o,
-                                       lse, do,
+                _, ds, _ = _bwd_pieces(q, _rep_kv(k, group),
+                                       _rep_kv(v, group), bias, causal,
+                                       scale, o, lse, do,
                                        ctr_drop=(seed, thresh, inv_keep))
             dbias = _dbias_from_ds(ds, bias)
         else:
@@ -1301,6 +1321,22 @@ def _flash_core_lse_bwd(causal, scale, use_pallas, need_dbias, res, cts):
 _flash_core_lse.defvjp(_flash_core_lse_fwd, _flash_core_lse_bwd)
 
 
+def _rep_kv(x, group: int):
+    """jnp-fallback view of grouped KV: repeat per query head."""
+    return x if group == 1 else jnp.repeat(x, group, axis=0)
+
+
+def _sum_groups(dx, group: int):
+    """Per-query-head dk/dv [Bq, s, d] -> per-kv-head [Bq/group, s, d].
+    The kernels emit one dk/dv slice per q head (their grids run over q
+    heads; KV sharing happens in the read index maps) — the group sum is
+    the transpose of that sharing."""
+    if group == 1:
+        return dx
+    b, s, d = dx.shape
+    return dx.reshape(b // group, group, s, d).sum(1)
+
+
 def _fold_mask(bias, mask):
     """Fold a boolean mask (True = MASKED, the reference convention) into
     the additive bias; only a caller-supplied bias wants gradients."""
@@ -1315,10 +1351,36 @@ def _fold_mask(bias, mask):
 
 def _flatten_qkv(q, k, v, bias):
     """Shared prologue: [..., s, d] -> [B, s, d] 3-D views plus the compact
-    bias broadcast ([B, 1, sk] when query-invariant)."""
+    bias broadcast ([B, 1, sk] when query-invariant).
+
+    Grouped-query attention: when k/v carry FEWER heads than q on the -3
+    dim ([b, hq, sq, d] vs [b, hkv, sk, d] with hq % hkv == 0), returns
+    group = hq // hkv and leaves k/v UNREPEATED at [b*hkv, sk, d] — the
+    kernels share each KV block across the group via their BlockSpec
+    index maps (i // group), so grouped KV never materializes hq copies
+    in HBM."""
     lead = q.shape[:-2]
     sq, d = q.shape[-2:]
     sk = k.shape[-2]
+    group = 1
+    if q.ndim >= 3 and k.shape[:-2] != lead:
+        # ValueError (not assert): wrong head ratios would otherwise read
+        # kv rows out of bounds through the i // group index maps
+        if q.ndim < 4 or k.ndim != q.ndim:
+            raise ValueError(
+                f"GQA needs [..., heads, seq, dim] on both sides; got "
+                f"q {q.shape} k {k.shape}")
+        if k.shape[:-3] != q.shape[:-3] or k.shape[-1] != d:
+            raise ValueError(
+                f"q/k leading dims differ beyond the head axis: "
+                f"q {q.shape} k {k.shape}")
+        hq, hkv = q.shape[-3], k.shape[-3]
+        if hkv < 1 or hq % hkv:
+            raise ValueError(
+                f"query heads {hq} not a multiple of kv heads {hkv}")
+        if v.shape != k.shape:
+            raise ValueError(f"k/v shapes differ: {k.shape} vs {v.shape}")
+        group = hq // hkv
     q3 = q.reshape(-1, sq, d)
     k3 = k.reshape(-1, sk, d)
     v3 = v.reshape(-1, sk, d)
@@ -1327,7 +1389,7 @@ def _flatten_qkv(q, k, v, bias):
         bsq = bias.shape[-2] if bias.ndim >= 2 else 1
         tgt_q = 1 if bsq == 1 else sq
         bias3 = jnp.broadcast_to(bias, lead + (tgt_q, sk)).reshape(-1, tgt_q, sk)
-    return lead, q3, k3, v3, bias3
+    return lead, q3, k3, v3, bias3, group
 
 
 def flash_attention_with_lse(q, k, v, *, bias=None, mask=None, causal=False,
@@ -1341,7 +1403,13 @@ def flash_attention_with_lse(q, k, v, *, bias=None, mask=None, causal=False,
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     bias, need_dbias = _fold_mask(bias, mask)
-    lead, q3, k3, v3, bias3 = _flatten_qkv(q, k, v, bias)
+    lead, q3, k3, v3, bias3, group = _flatten_qkv(q, k, v, bias)
+    if group != 1:
+        raise NotImplementedError(
+            "grouped-query attention is not supported by "
+            "flash_attention_with_lse (the ring/context-parallel building "
+            "block); repeat k/v to the query head count, or use "
+            "flash_attention")
     o, lse = _flash_core_lse(q3, k3, v3, bias3, causal, scale, use_pallas,
                              need_dbias)
     sq, d = q.shape[-2:]
@@ -1364,7 +1432,11 @@ def flash_attention(
     """Fused scaled-dot-product attention.
 
     q: [..., sq, d]; k, v: [..., sk, d] (matching leading dims — typically
-    [batch, heads, seq, head_dim]). ``bias`` is additive [..., sq, sk];
+    [batch, heads, seq, head_dim]). Grouped-query / multi-query attention:
+    k/v may carry FEWER heads ([b, hkv, sk, d] with hq % hkv == 0) — the
+    kernels then share each kv row across the hq/hkv query heads via
+    their index maps (no repeated KV in HBM) and group-sum dk/dv.
+    ``bias`` is additive [..., sq, sk];
     ``mask`` is boolean with True = MASKED (reference padding-mask
     convention, see ops/softmax.py) and adds no O(sq*sk) materialization
     when it only varies over keys. ``causal`` applies the upper-triangular
@@ -1381,7 +1453,7 @@ def flash_attention(
         scale = 1.0 / (d ** 0.5)
 
     bias, need_dbias = _fold_mask(bias, mask)
-    lead, q3, k3, v3, bias3 = _flatten_qkv(q, k, v, bias)
+    lead, q3, k3, v3, bias3, group = _flatten_qkv(q, k, v, bias)
 
     if dropout_p > 0.0:
         if dropout_rng is None:
@@ -1399,10 +1471,10 @@ def flash_attention(
         # decorrelates per flattened batch*head and per (row, col).
         o = _flash_core_drop(q3, k3, v3, bias3, seed_words(dropout_rng),
                              causal, scale, float(dropout_p), use_pallas,
-                             need_dbias)
+                             need_dbias, group)
     else:
         o = _flash_core(q3, k3, v3, bias3, causal, scale, use_pallas,
-                        need_dbias)
+                        need_dbias, group)
     return o.reshape(lead + (sq, d))
 
 

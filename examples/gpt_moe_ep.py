@@ -54,13 +54,16 @@ def main():
     n_experts = 32
     while on_tpu and n_experts % tp:  # experts must divide over the axis
         tp -= 1
+    if tp < len(devs) and on_tpu:
+        print(f"note: using {tp}/{len(devs)} devices so that "
+              f"{n_experts} experts divide the expert axis")
     mesh = Mesh(np.array(devs[:tp]), ("model",))
 
     if on_tpu:
         cfg = TransformerConfig(
             vocab_size=50304, seq_len=1024, hidden=768, layers=12, heads=12,
             causal=True, dtype=jnp.bfloat16, scan_layers=True, remat=True,
-            moe_experts=max(n_experts, tp), moe_top_k=2)
+            moe_experts=n_experts, moe_top_k=2)
         batch = args.batch or 8
     else:
         cfg = TransformerConfig(

@@ -167,9 +167,9 @@ def test_key_mask_stays_compact_no_dense_bias():
     captured = {}
     orig = A._fwd_pallas
 
-    def spy(q, k, v, bias, causal, scale):
+    def spy(q, k, v, bias, causal, scale, **kw):
         captured["bias_shape"] = None if bias is None else bias.shape
-        return orig(q, k, v, bias, causal, scale)
+        return orig(q, k, v, bias, causal, scale, **kw)
 
     A._fwd_pallas = spy
     try:
@@ -531,3 +531,102 @@ def test_dropout_p_one_and_out_of_range():
     assert not np.asarray(g).any()
     with pytest.raises(ValueError, match="dropout_p"):
         flash_attention(q, k, v, dropout_p=1.5, dropout_rng=rng)
+
+
+# ---------------------------------------------------------------------------
+# grouped-query / multi-query attention (kv heads < q heads)
+# ---------------------------------------------------------------------------
+
+def _gqa_setup(hq=8, hkv=2, s=128, seed=23):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    q = jax.random.normal(ks[0], (2, hq, s, 64))
+    k = jax.random.normal(ks[1], (2, hkv, s, 64))
+    v = jax.random.normal(ks[2], (2, hkv, s, 64))
+    do = jax.random.normal(ks[3], q.shape)
+    g = hq // hkv
+    k_rep = jnp.repeat(k, g, axis=1)
+    v_rep = jnp.repeat(v, g, axis=1)
+    return q, k, v, do, k_rep, v_rep, g
+
+
+@pytest.mark.parametrize("hkv", [1, 2, 4])  # 1 = multi-query attention
+@pytest.mark.parametrize("use_pallas", [True, False])
+def test_gqa_matches_repeated_kv_oracle(hkv, use_pallas):
+    """GQA shares kv rows across the query-head group via index maps; the
+    contract is bit-parity with explicitly repeated KV (dk/dv = group-sum
+    of the repeated-head grads), fwd and all grads, kernel AND fallback."""
+    q, k, v, do, k_rep, v_rep, g = _gqa_setup(hkv=hkv)
+    b, hq, s, dd = q.shape
+
+    def f(q, k, v):
+        return jnp.vdot(flash_attention(q, k, v, causal=True,
+                                        use_pallas=use_pallas), do)
+
+    val, grads = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+    rval, rg = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k_rep, v_rep)
+    rdk = rg[1].reshape(b, hkv, g, s, dd).sum(2)
+    rdv = rg[2].reshape(b, hkv, g, s, dd).sum(2)
+    np.testing.assert_allclose(float(val), float(rval), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(grads[0]), np.asarray(rg[0]),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads[1]), np.asarray(rdk),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads[2]), np.asarray(rdv),
+                               atol=1e-5)
+
+
+def test_gqa_streaming_and_split_bwd(monkeypatch):
+    """The kv-sharing index maps exist in every kernel family: forced
+    streaming (multi-block 3-D grids) and the split backward pair must
+    match the repeated-KV oracle too."""
+    for env in ({"APEX_TPU_FLASH_STREAM": "1", "APEX_TPU_FLASH_BLOCK": "128"},
+                {"APEX_TPU_FLASH_SPLIT_BWD": "1"}):
+        for name, val in env.items():
+            monkeypatch.setenv(name, val)
+        q, k, v, do, k_rep, v_rep, g = _gqa_setup(hkv=2, s=256)
+        b, hq, s, dd = q.shape
+
+        def f(q, k, v):
+            return jnp.vdot(flash_attention(q, k, v, causal=True,
+                                            use_pallas=True), do)
+
+        val_, grads = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+        rval, rg = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k_rep, v_rep)
+        rdk = rg[1].reshape(b, 2, g, s, dd).sum(2)
+        np.testing.assert_allclose(float(val_), float(rval), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(grads[1]), np.asarray(rdk),
+                                   atol=1e-5)
+        for name in env:
+            monkeypatch.delenv(name)
+
+
+def test_gqa_with_fused_dropout_and_mask():
+    """GQA composes with in-kernel dropout (same counter bits as the
+    fallback) and with a compact key-padding mask."""
+    q, k, v, do, k_rep, v_rep, g = _gqa_setup(hkv=2)
+    rng = jax.random.PRNGKey(11)
+    mask = jnp.zeros((2, 1, 1, 128), bool).at[..., 100:].set(True)
+
+    def f(q, k, v, use):
+        y = flash_attention(q, k, v, mask=mask, dropout_p=0.25,
+                            dropout_rng=rng, use_pallas=use)
+        return jnp.vdot(y, do), y
+
+    (_, yk), gk = jax.value_and_grad(
+        lambda *a: f(*a, True), argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    (_, yr), gr = jax.value_and_grad(
+        lambda *a: f(*a, False), argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    np.testing.assert_allclose(np.asarray(yk), np.asarray(yr), atol=2e-5)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+def test_gqa_shape_validation():
+    q = jnp.zeros((2, 6, 32, 64))
+    k = v = jnp.zeros((2, 4, 32, 64))    # 6 % 4 != 0
+    with pytest.raises(ValueError, match="not a multiple"):
+        flash_attention(q, k, v)
+    from apex_tpu.ops.attention import flash_attention_with_lse
+    k2 = v2 = jnp.zeros((2, 2, 32, 64))
+    with pytest.raises(NotImplementedError, match="grouped-query"):
+        flash_attention_with_lse(q[:, :4], k2, v2)
