@@ -29,4 +29,6 @@ from apex_tpu.models.configs import (  # noqa: F401
     gpt2_large,
     gpt2_medium,
     gpt2_small,
+    llama2_7b,
+    llama3_8b,
 )

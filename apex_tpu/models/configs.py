@@ -51,3 +51,21 @@ def gpt2_large(**over) -> TransformerConfig:
     return dataclasses.replace(_preset(
         vocab_size=50304, seq_len=1024, hidden=1280, layers=36, heads=20,
         causal=True), **over)
+
+
+def llama2_7b(**over) -> TransformerConfig:
+    """Llama-2-7B geometry: RoPE + RMSNorm + SwiGLU, dense MHA.
+    (Beyond the reference — apex has no decoder-LLM presets; the
+    components are the framework's own rope/rms_norm/flash ops.)"""
+    return dataclasses.replace(_preset(
+        vocab_size=32000, seq_len=4096, hidden=4096, layers=32, heads=32,
+        causal=True, rope=True, norm="rmsnorm", mlp_act="swiglu",
+        ffn_mult=11008 / 4096), **over)
+
+
+def llama3_8b(**over) -> TransformerConfig:
+    """Llama-3-8B geometry: GQA (8 kv heads), RoPE, RMSNorm, SwiGLU."""
+    return dataclasses.replace(_preset(
+        vocab_size=128256, seq_len=8192, hidden=4096, layers=32, heads=32,
+        kv_heads=8, causal=True, rope=True, norm="rmsnorm",
+        mlp_act="swiglu", ffn_mult=14336 / 4096), **over)

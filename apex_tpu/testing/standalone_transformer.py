@@ -54,7 +54,29 @@ class TransformerConfig:
     hidden: int = 64
     layers: int = 2
     heads: int = 4
-    ffn_mult: int = 4
+    kv_heads: int = 0              # 0 = dense MHA (kv_heads == heads).
+                                   # > 0 enables grouped-query attention:
+                                   # heads % kv_heads == 0, the flash
+                                   # kernels share kv rows per group
+                                   # (ops/attention.py GQA). QKV columns
+                                   # are laid out KV-GROUP-major
+                                   # ([q_g..., k_g, v_g] per kv head) so
+                                   # a contiguous TP column split hands
+                                   # each rank whole groups — requires
+                                   # kv_heads % tp == 0.
+    ffn_mult: float = 4            # ffn = int(hidden * ffn_mult)
+    rope: bool = False             # rotary position embeddings on q/k
+                                   # (ops/rope.py) INSTEAD of the learned
+                                   # position table (no pos_embedding
+                                   # param when set); CP offsets each
+                                   # rank's table slice by its chunk.
+    norm: str = "layernorm"        # "layernorm" | "rmsnorm" (rms blocks
+                                   # carry gamma only)
+    mlp_act: str = "gelu"          # "gelu" | "swiglu". SwiGLU pairs
+                                   # gate/up INTERLEAVED per ffn unit
+                                   # ([f0_gate, f0_up, f1_gate, ...]) so
+                                   # TP column splits keep each pair on
+                                   # one rank at any tp.
     causal: bool = True            # GPT; False = BERT
     sequence_parallel: bool = False
     dropout_p: float = 0.0
@@ -152,6 +174,19 @@ class TransformerConfig:
             "full", "dots", "flash", "flash_offload", "none"
         ), f"unknown remat_policy {self.remat_policy!r}"
         assert self.moe_experts >= 0
+        assert self.norm in ("layernorm", "rmsnorm"), self.norm
+        assert self.mlp_act in ("gelu", "swiglu"), self.mlp_act
+        assert not (self.moe_experts and self.mlp_act != "gelu"), (
+            "the MoE expert FFN is gelu-only (transformer/moe.py) — "
+            "mlp_act='swiglu' with moe_experts would silently measure "
+            "gelu experts")
+        if self.kv_heads:
+            assert self.heads % self.kv_heads == 0, (
+                f"heads={self.heads} not a multiple of "
+                f"kv_heads={self.kv_heads}")
+            assert self.context_axis is None, (
+                "GQA + ring context parallelism is unsupported "
+                "(flash_attention_with_lse rejects grouped kv)")
         assert self.loss_chunk is None or (
             isinstance(self.loss_chunk, int)
             and not isinstance(self.loss_chunk, bool)
@@ -170,9 +205,27 @@ class TransformerConfig:
         return self.hidden // self.heads
 
 
+def _ffn_width(cfg: TransformerConfig) -> int:
+    return int(cfg.hidden * cfg.ffn_mult)
+
+
+def _qkv_cols(cfg: TransformerConfig) -> int:
+    if cfg.kv_heads:
+        group = cfg.heads // cfg.kv_heads
+        return cfg.kv_heads * (group + 2) * cfg.head_dim
+    return 3 * cfg.hidden
+
+
+def _ln_init(cfg: TransformerConfig):
+    p = {"gamma": jnp.ones((cfg.hidden,), cfg.dtype)}
+    if cfg.norm == "layernorm":
+        p["beta"] = jnp.zeros((cfg.hidden,), cfg.dtype)
+    return p
+
+
 def transformer_init(key, cfg: TransformerConfig):
     """Full (unsharded) parameters; shard via ``param_specs`` in_specs."""
-    h, ffn = cfg.hidden, cfg.hidden * cfg.ffn_mult
+    h, ffn = cfg.hidden, _ffn_width(cfg)
     keys = iter(jax.random.split(key, 4 + 6 * cfg.layers))
 
     def norm(k, shape, scale):
@@ -180,22 +233,21 @@ def transformer_init(key, cfg: TransformerConfig):
 
     params = {
         "embedding": norm(next(keys), (cfg.vocab_size, h), 0.02),
-        "pos_embedding": norm(next(keys), (cfg.seq_len, h), 0.02),
-        "final_ln": {"gamma": jnp.ones((h,), cfg.dtype),
-                     "beta": jnp.zeros((h,), cfg.dtype)},
+        "final_ln": _ln_init(cfg),
         "layers": [],
     }
+    if not cfg.rope:
+        params["pos_embedding"] = norm(next(keys), (cfg.seq_len, h), 0.02)
+    fc1_cols = ffn * (2 if cfg.mlp_act == "swiglu" else 1)
     for _ in range(cfg.layers):
         layer = {
-            "ln1": {"gamma": jnp.ones((h,), cfg.dtype),
-                    "beta": jnp.zeros((h,), cfg.dtype)},
-            "qkv": {"kernel": norm(next(keys), (h, 3 * h), 0.02),
-                    "bias": jnp.zeros((3 * h,), cfg.dtype)},
+            "ln1": _ln_init(cfg),
+            "qkv": {"kernel": norm(next(keys), (h, _qkv_cols(cfg)), 0.02),
+                    "bias": jnp.zeros((_qkv_cols(cfg),), cfg.dtype)},
             "proj": {"kernel": norm(next(keys), (h, h),
                                     0.02 / (2 * cfg.layers) ** 0.5),
                      "bias": jnp.zeros((h,), cfg.dtype)},
-            "ln2": {"gamma": jnp.ones((h,), cfg.dtype),
-                    "beta": jnp.zeros((h,), cfg.dtype)},
+            "ln2": _ln_init(cfg),
         }
         if cfg.moe_experts:
             from apex_tpu.transformer.moe import moe_init
@@ -203,8 +255,8 @@ def transformer_init(key, cfg: TransformerConfig):
             layer["moe"] = moe_init(next(keys), _moe_cfg(cfg))
         else:
             layer.update({
-                "fc1": {"kernel": norm(next(keys), (h, ffn), 0.02),
-                        "bias": jnp.zeros((ffn,), cfg.dtype)},
+                "fc1": {"kernel": norm(next(keys), (h, fc1_cols), 0.02),
+                        "bias": jnp.zeros((fc1_cols,), cfg.dtype)},
                 "fc2": {"kernel": norm(next(keys), (ffn, h),
                                        0.02 / (2 * cfg.layers) ** 0.5),
                         "bias": jnp.zeros((h,), cfg.dtype)},
@@ -217,7 +269,7 @@ def _moe_cfg(cfg: TransformerConfig):
     from apex_tpu.transformer.moe import MoEConfig
 
     return MoEConfig(
-        hidden=cfg.hidden, ffn=cfg.hidden * cfg.ffn_mult,
+        hidden=cfg.hidden, ffn=_ffn_width(cfg),
         num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
         capacity_factor=cfg.moe_capacity_factor,
         expert_axis=cfg.model_axis, dtype=cfg.dtype,
@@ -241,11 +293,17 @@ def param_specs(cfg: TransformerConfig):
     def lspec(*tail):
         return P(None, *tail) if cfg.scan_layers else P(*tail)
 
+    def ln_spec():
+        s = {"gamma": lspec()}
+        if cfg.norm == "layernorm":
+            s["beta"] = lspec()
+        return s
+
     layer = {
-        "ln1": {"gamma": lspec(), "beta": lspec()},
+        "ln1": ln_spec(),
         "qkv": {"kernel": lspec(None, ax), "bias": lspec(ax)},
         "proj": {"kernel": lspec(ax, None), "bias": lspec()},
-        "ln2": {"gamma": lspec(), "beta": lspec()},
+        "ln2": ln_spec(),
     }
     if cfg.moe_experts:
         # experts shard over the model axis (EP rides the TP group);
@@ -258,13 +316,16 @@ def param_specs(cfg: TransformerConfig):
             "fc1": {"kernel": lspec(None, ax), "bias": lspec(ax)},
             "fc2": {"kernel": lspec(ax, None), "bias": lspec()},
         })
-    return {
+    specs = {
         "embedding": P(ax, None),
-        "pos_embedding": P(),
-        "final_ln": {"gamma": P(), "beta": P()},
+        "final_ln": ({"gamma": P(), "beta": P()}
+                     if cfg.norm == "layernorm" else {"gamma": P()}),
         "layers": layer if cfg.scan_layers
         else [dict(layer) for _ in range(cfg.layers)],
     }
+    if not cfg.rope:
+        specs["pos_embedding"] = P()
+    return specs
 
 
 def _output_dropout(y, cfg: TransformerConfig, dropout_key):
@@ -277,6 +338,28 @@ def _output_dropout(y, cfg: TransformerConfig, dropout_key):
     return y
 
 
+def _norm(x, p, cfg: TransformerConfig):
+    """ln1/ln2/final_ln dispatch: LayerNorm (gamma+beta) or RMSNorm
+    (gamma only) per cfg.norm — both the Pallas-kernel ops."""
+    if cfg.norm == "rmsnorm":
+        from apex_tpu.ops.layer_norm import rms_norm
+
+        return rms_norm(x, p["gamma"])
+    return layer_norm(x, p["gamma"], p["beta"])
+
+
+def _rope_tables(cfg: TransformerConfig, s: int):
+    """cos/sin sliced to this rank's positions (CP chunks are offset)."""
+    from apex_tpu.ops.rope import rope_frequencies
+
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.seq_len)
+    if cfg.context_axis is not None:
+        off = jax.lax.axis_index(cfg.context_axis) * s
+        cos = jax.lax.dynamic_slice_in_dim(cos, off, s, 0)
+        sin = jax.lax.dynamic_slice_in_dim(sin, off, s, 0)
+    return cos, sin
+
+
 def _attention(lp, x, cfg: TransformerConfig, dropout_key, attn_key=None):
     """x: [s(, /tp if SP), b, h] -> same. Column QKV (no output gather) ->
     flash attention on the tp-local heads -> row projection."""
@@ -287,15 +370,42 @@ def _attention(lp, x, cfg: TransformerConfig, dropout_key, attn_key=None):
         sequence_parallel_enabled=cfg.sequence_parallel,
     )                                     # [s, b, 3h/tp]
     s, b = qkv.shape[0], qkv.shape[1]
-    n_local = qkv.shape[-1] // (3 * cfg.head_dim)
-    # Megatron layout: qkv columns are ordered [heads, (q|k|v), head_dim] so
-    # a contiguous column split hands each TP rank WHOLE heads — the same
-    # function at every tp (ref: attention.py reshapes local qkv to
-    # [s, b, nh_local, 3*hd] then split_tensor_along_last_dim). The
-    # round-1 [3, nh, hd] order silently changed the function with tp.
-    qkv = qkv.reshape(s, b, n_local, 3, cfg.head_dim)
-    # [s, b, nh, 3, d] -> 3 x [b, nh, s, d]
-    q, k, v = (qkv[:, :, :, i].transpose(1, 2, 0, 3) for i in range(3))
+    dd = cfg.head_dim
+    if cfg.kv_heads:
+        # KV-GROUP-major layout: per kv head [q_0..q_{g-1}, k, v] — a
+        # contiguous TP column split hands each rank whole groups, same
+        # invariance argument as the dense [heads, (q|k|v), d] order
+        group = cfg.heads // cfg.kv_heads
+        assert qkv.shape[-1] % ((group + 2) * dd) == 0, (
+            f"GQA column split landed mid-group: local qkv cols "
+            f"{qkv.shape[-1]} vs group stride {(group + 2) * dd} — "
+            f"kv_heads={cfg.kv_heads} must be divisible by the model-axis "
+            "size (each TP rank needs whole kv groups)")
+        n_kv = qkv.shape[-1] // ((group + 2) * dd)
+        qkv = qkv.reshape(s, b, n_kv, group + 2, dd)
+        q = qkv[:, :, :, :group].reshape(s, b, n_kv * group, dd)
+        k = qkv[:, :, :, group]           # [s, b, n_kv, d]
+        v = qkv[:, :, :, group + 1]
+    else:
+        n_local = qkv.shape[-1] // (3 * dd)
+        # Megatron layout: qkv columns are ordered [heads, (q|k|v), d] so
+        # a contiguous column split hands each TP rank WHOLE heads — the
+        # same function at every tp (ref: attention.py reshapes local qkv
+        # to [s, b, nh_local, 3*hd] then split_tensor_along_last_dim).
+        # The round-1 [3, nh, hd] order silently changed with tp.
+        qkv = qkv.reshape(s, b, n_local, 3, dd)
+        q, k, v = (qkv[:, :, :, i] for i in range(3))  # [s, b, nh, d]
+    if cfg.rope:
+        from apex_tpu.ops.rope import apply_rope
+
+        cos, sin = _rope_tables(cfg, s)
+        # apply_rope wants [..., s, heads, d]
+        q = apply_rope(q.transpose(1, 0, 2, 3), cos, sin).transpose(
+            1, 0, 2, 3)
+        k = apply_rope(k.transpose(1, 0, 2, 3), cos, sin).transpose(
+            1, 0, 2, 3)
+    # [s, b, nh, d] -> [b, nh, s, d]
+    q, k, v = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
     if cfg.context_axis is not None:
         from apex_tpu.transformer.context_parallel import ring_attention
 
@@ -308,7 +418,7 @@ def _attention(lp, x, cfg: TransformerConfig, dropout_key, attn_key=None):
                             dropout_rng=attn_key)
     else:
         o = flash_attention(q, k, v, causal=cfg.causal)
-    o = o.transpose(2, 0, 1, 3).reshape(s, b, n_local * cfg.head_dim)
+    o = o.transpose(2, 0, 1, 3).reshape(s, b, q.shape[1] * dd)
     o = row_parallel_linear(
         o, lp["proj"]["kernel"], lp["proj"]["bias"], axis=ax,
         input_is_parallel=True,
@@ -324,7 +434,13 @@ def _mlp(lp, x, cfg: TransformerConfig, dropout_key):
         gather_output=False,
         sequence_parallel_enabled=cfg.sequence_parallel,
     )
-    y = jax.nn.gelu(y)
+    if cfg.mlp_act == "swiglu":
+        # interleaved [f0_gate, f0_up, f1_gate, ...] columns: the local
+        # chunk is whole pairs at any tp
+        y = y.reshape(y.shape[:-1] + (y.shape[-1] // 2, 2))
+        y = jax.nn.silu(y[..., 0]) * y[..., 1]
+    else:
+        y = jax.nn.gelu(y)
     y = row_parallel_linear(
         y, lp["fc2"]["kernel"], lp["fc2"]["bias"], axis=ax,
         input_is_parallel=True,
@@ -372,14 +488,19 @@ def _forward_hidden(params, tokens, cfg: TransformerConfig, *,
         )
         x = emb.transpose(1, 0, 2)        # [s, b, h] partial sums
         x = reduce_scatter_to_sequence_parallel_region(x, ax)
-        pos = jax.lax.dynamic_slice_in_dim(
-            params["pos_embedding"][: tokens.shape[1]],
-            jax.lax.axis_index(ax) * x.shape[0], x.shape[0], 0,
-        )
-        x = (x + pos[:, None, :]).astype(cfg.dtype)
+        if cfg.rope:                       # positions live in q/k rotation
+            x = x.astype(cfg.dtype)
+        else:
+            pos = jax.lax.dynamic_slice_in_dim(
+                params["pos_embedding"][: tokens.shape[1]],
+                jax.lax.axis_index(ax) * x.shape[0], x.shape[0], 0,
+            )
+            x = (x + pos[:, None, :]).astype(cfg.dtype)
     else:
         emb = vocab_parallel_embedding(tokens, params["embedding"], axis=ax)
-        if cfg.context_axis is not None:
+        if cfg.rope:                       # positions live in q/k rotation
+            x = emb.astype(cfg.dtype)
+        elif cfg.context_axis is not None:
             # tokens are the LOCAL seq chunk: positions are globally offset
             s_local = tokens.shape[1]
             pos = jax.lax.dynamic_slice_in_dim(
@@ -408,11 +529,8 @@ def _forward_hidden(params, tokens, cfg: TransformerConfig, *,
         k1 = jax.random.fold_in(mp_key, 2 * i)
         k2 = jax.random.fold_in(mp_key, 2 * i + 1)
         ka = jax.random.fold_in(attn_base, i)
-        x = x + _attention(
-            lp, layer_norm(x, lp["ln1"]["gamma"], lp["ln1"]["beta"]), cfg,
-            k1, ka,
-        )
-        ln2 = layer_norm(x, lp["ln2"]["gamma"], lp["ln2"]["beta"])
+        x = x + _attention(lp, _norm(x, lp["ln1"], cfg), cfg, k1, ka)
+        ln2 = _norm(x, lp["ln2"], cfg)
         if cfg.moe_experts:
             y, aux = _moe_mlp(lp, ln2, cfg, k2)
         else:
@@ -462,7 +580,7 @@ def _forward_hidden(params, tokens, cfg: TransformerConfig, *,
     # Final LN runs on the seq-sharded x under SP (Megatron keeps it inside
     # the SP region), so its grads are seq-local and sp_grad_sync's psum is
     # the correct completion.
-    x = layer_norm(x, params["final_ln"]["gamma"], params["final_ln"]["beta"])
+    x = _norm(x, params["final_ln"], cfg)
     # Parallel-lm-head entry for the tied-embedding vocab-parallel logits
     # [s, b, h] @ [h, v/tp]: each rank's dx = dlogits_local @ emb_shard is a
     # PARTIAL sum, so the entry's backward must reduce it — without that,
