@@ -31,4 +31,5 @@ from apex_tpu.models.configs import (  # noqa: F401
     gpt2_small,
     llama2_7b,
     llama3_8b,
+    mixtral_8x7b,
 )

@@ -69,3 +69,14 @@ def llama3_8b(**over) -> TransformerConfig:
         vocab_size=128256, seq_len=8192, hidden=4096, layers=32, heads=32,
         kv_heads=8, causal=True, rope=True, norm="rmsnorm",
         mlp_act="swiglu", ffn_mult=14336 / 4096), **over)
+
+
+def mixtral_8x7b(**over) -> TransformerConfig:
+    """Mixtral-8x7B geometry: Llama-style body (GQA 8 kv heads, RoPE,
+    RMSNorm) with 8 swiglu experts top-2 replacing the dense MLP
+    (transformer/moe.py over the model axis)."""
+    return dataclasses.replace(_preset(
+        vocab_size=32000, seq_len=4096, hidden=4096, layers=32, heads=32,
+        kv_heads=8, causal=True, rope=True, norm="rmsnorm",
+        mlp_act="swiglu", ffn_mult=14336 / 4096, moe_experts=8,
+        moe_top_k=2), **over)

@@ -176,10 +176,8 @@ class TransformerConfig:
         assert self.moe_experts >= 0
         assert self.norm in ("layernorm", "rmsnorm"), self.norm
         assert self.mlp_act in ("gelu", "swiglu"), self.mlp_act
-        assert not (self.moe_experts and self.mlp_act != "gelu"), (
-            "the MoE expert FFN is gelu-only (transformer/moe.py) — "
-            "mlp_act='swiglu' with moe_experts would silently measure "
-            "gelu experts")
+        # mlp_act flows into the experts too (MoEConfig.act) — Mixtral-
+        # style swiglu experts are supported, nothing silently downgrades
         if self.kv_heads:
             assert self.heads % self.kv_heads == 0, (
                 f"heads={self.heads} not a multiple of "
@@ -272,7 +270,7 @@ def _moe_cfg(cfg: TransformerConfig):
         hidden=cfg.hidden, ffn=_ffn_width(cfg),
         num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
         capacity_factor=cfg.moe_capacity_factor,
-        expert_axis=cfg.model_axis, dtype=cfg.dtype,
+        expert_axis=cfg.model_axis, act=cfg.mlp_act, dtype=cfg.dtype,
     )
 
 
@@ -360,9 +358,13 @@ def _rope_tables(cfg: TransformerConfig, s: int):
     return cos, sin
 
 
-def _attention(lp, x, cfg: TransformerConfig, dropout_key, attn_key=None):
+def _attention(lp, x, cfg: TransformerConfig, dropout_key, attn_key=None,
+               rope_tables=None):
     """x: [s(, /tp if SP), b, h] -> same. Column QKV (no output gather) ->
-    flash attention on the tp-local heads -> row projection."""
+    flash attention on the tp-local heads -> row projection.
+    ``rope_tables``: (cos, sin) computed ONCE by the caller so the
+    transcendentals don't re-emit per scan/remat body (None rebuilds —
+    kept for direct callers like test_model_pipeline's blocks)."""
     ax = cfg.model_axis
     qkv = column_parallel_linear(
         x, lp["qkv"]["kernel"], lp["qkv"]["bias"], axis=ax,
@@ -398,7 +400,8 @@ def _attention(lp, x, cfg: TransformerConfig, dropout_key, attn_key=None):
     if cfg.rope:
         from apex_tpu.ops.rope import apply_rope
 
-        cos, sin = _rope_tables(cfg, s)
+        cos, sin = rope_tables if rope_tables is not None \
+            else _rope_tables(cfg, s)
         # apply_rope wants [..., s, heads, d]
         q = apply_rope(q.transpose(1, 0, 2, 3), cos, sin).transpose(
             1, 0, 2, 3)
@@ -524,12 +527,15 @@ def _forward_hidden(params, tokens, cfg: TransformerConfig, *,
     # attention-PROB dropout always draws from the rank-varying stream
     # (folded away from the 2i/2i+1 output-dropout folds above)
     attn_base = jax.random.fold_in(keys.model_parallel, 0x617474)
+    # rope tables once, outside the scan/remat bodies
+    rope_tbl = _rope_tables(cfg, x.shape[0]) if cfg.rope else None
 
     def block(x, lp, i):
         k1 = jax.random.fold_in(mp_key, 2 * i)
         k2 = jax.random.fold_in(mp_key, 2 * i + 1)
         ka = jax.random.fold_in(attn_base, i)
-        x = x + _attention(lp, _norm(x, lp["ln1"], cfg), cfg, k1, ka)
+        x = x + _attention(lp, _norm(x, lp["ln1"], cfg), cfg, k1, ka,
+                           rope_tables=rope_tbl)
         ln2 = _norm(x, lp["ln2"], cfg)
         if cfg.moe_experts:
             y, aux = _moe_mlp(lp, ln2, cfg, k2)

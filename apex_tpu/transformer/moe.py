@@ -62,10 +62,15 @@ class MoEConfig:
     capacity_factor: float = 1.25
     expert_axis: object = None     # mesh axis name sharding experts, or
                                    # None = all experts local (ep = 1)
+    act: str = "gelu"              # "gelu" | "swiglu" (Mixtral-style
+                                   # gated experts: w1 carries [gate|up]
+                                   # halves — experts are whole per rank,
+                                   # so no TP interleaving needed)
     dtype: object = jnp.float32
 
     def __post_init__(self):
         assert 1 <= self.top_k <= self.num_experts
+        assert self.act in ("gelu", "swiglu"), self.act
 
     def capacity(self, tokens: int) -> int:
         c = -(-tokens * self.top_k * self.capacity_factor // self.num_experts)
@@ -79,10 +84,11 @@ def moe_init(key, cfg: MoEConfig):
     hand each rank its E_local = E / ep_size slice."""
     k1, k2, k3 = jax.random.split(key, 3)
     e, h, f = cfg.num_experts, cfg.hidden, cfg.ffn
+    f1 = f * (2 if cfg.act == "swiglu" else 1)
     scale = 0.02
     return {
         "router": (jax.random.normal(k1, (h, e)) * scale).astype(jnp.float32),
-        "w1": (jax.random.normal(k2, (e, h, f)) * scale).astype(cfg.dtype),
+        "w1": (jax.random.normal(k2, (e, h, f1)) * scale).astype(cfg.dtype),
         "w2": (jax.random.normal(k3, (e, f, h)) * scale).astype(cfg.dtype),
     }
 
@@ -187,9 +193,12 @@ def moe_apply(params, x, cfg: MoEConfig, *,
         xin = xin.transpose(1, 0, 2, 3).reshape(e_local, p * cap, h)
     # expert FFN — one batched einsum over the local experts; operands in
     # the compute dtype at full MXU rate, fp32 MXU accumulation
-    hmid = jax.nn.gelu(jnp.einsum(
-        "ech,ehf->ecf", xin, params["w1"],
-        preferred_element_type=jnp.float32))
+    hmid = jnp.einsum("ech,ehf->ecf", xin, params["w1"],
+                      preferred_element_type=jnp.float32)
+    if cfg.act == "swiglu":
+        hmid = jax.nn.silu(hmid[..., :cfg.ffn]) * hmid[..., cfg.ffn:]
+    else:
+        hmid = jax.nn.gelu(hmid)
     out = jnp.einsum(
         "ecf,efh->ech", hmid.astype(cfg.dtype), params["w2"],
         preferred_element_type=jnp.float32)
