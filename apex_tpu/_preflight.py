@@ -115,6 +115,25 @@ def _probe_flash_attention_resident() -> None:
         for a, c in zip(gp, gr):
             assert _maxdiff(a, c) < 0.1, "flash_attention grad mismatch vs oracle"
 
+    # the production default block is sequence-dependent (512 at s<=2048);
+    # probe it at a MULTI-block shape (s=1024 -> 2x2 grid of 512-blocks) so
+    # the default path's cross-block machinery is validated, not just the
+    # single-block degenerate case above
+    q = jax.random.normal(jax.random.PRNGKey(5), (1, 2, 1024, 64), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(6), (1, 2, 1024, 64), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(7), (1, 2, 1024, 64), jnp.bfloat16)
+    do = jax.random.normal(jax.random.PRNGKey(8), q.shape, q.dtype)
+
+    def g(q, k, v, use):
+        y = flash_attention(q, k, v, causal=True, use_pallas=use)
+        return jnp.vdot(y.astype(jnp.float32), do.astype(jnp.float32))
+
+    gp = jax.jit(jax.grad(lambda q, k, v: g(q, k, v, True), argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(lambda q, k, v: g(q, k, v, False), argnums=(0, 1, 2)))(q, k, v)
+    for a, c in zip(gp, gr):
+        assert _maxdiff(a, c) < 0.1, \
+            "flash_attention default-block grad mismatch vs oracle"
+
 
 def _probe_optim_flat() -> None:
     from apex_tpu.ops.pallas_optim import adam_flat, l2norm_flat, lamb_phase1_flat
@@ -156,10 +175,16 @@ def _probe_flash_attention_stream() -> None:
     online-softmax rescale across revisits, causal block skip, revisited
     output copy-out, and the broadcast-bias (mask) spec branch — actually
     lowers and is value-checked. On failure only the streaming path is
-    pinned off; short-seq flash keeps its kernels."""
+    pinned off; short-seq flash keeps its kernels.
+
+    Block size is pinned to 256 here: the production default is sequence-
+    dependent (512 at these probe shapes), which would collapse the grids
+    to a single block and let a regression in the multi-block machinery
+    slip past the probe."""
     from apex_tpu.ops.attention import flash_attention
 
-    with _pinned_env("APEX_TPU_FLASH_STREAM", "1"):
+    with _pinned_env("APEX_TPU_FLASH_STREAM", "1"), \
+            _pinned_env("APEX_TPU_FLASH_BLOCK", "256"):
         for (sq, sk), causal, masked in (
             ((512, 512), True, False),   # causal, 2x2 blocks, skip branch
             ((384, 640), False, True),   # ragged cross-attn + mask branch
@@ -203,9 +228,11 @@ def _probe_flash_attention_dropout() -> None:
 
     rng = jax.random.PRNGKey(17)
     # 256 for the resident leg; 512 for the streaming leg so BOTH grid
-    # axes have >= 2 blocks (default block 256) — nonzero keep_block
-    # coordinate offsets and scratch-revisit interaction actually lower,
-    # same reasoning as _probe_flash_attention_stream's shapes
+    # axes have >= 2 blocks at the PINNED block 256 (the production
+    # default is sequence-dependent and would make these single-block) —
+    # nonzero keep_block coordinate offsets and scratch-revisit
+    # interaction actually lower, same reasoning as
+    # _probe_flash_attention_stream's shapes
     for stream, seq in (("0", 256), ("1", 512)):
         q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, seq, 64),
                               jnp.bfloat16)
@@ -220,7 +247,8 @@ def _probe_flash_attention_dropout() -> None:
                                 dropout_rng=rng, use_pallas=use)
             return jnp.vdot(y.astype(jnp.float32), do.astype(jnp.float32))
 
-        with _pinned_env("APEX_TPU_FLASH_STREAM", stream):
+        with _pinned_env("APEX_TPU_FLASH_STREAM", stream), \
+                _pinned_env("APEX_TPU_FLASH_BLOCK", "256"):
             gp = jax.jit(jax.grad(lambda q, k, v: f(q, k, v, True),
                                   argnums=(0, 1, 2)))(q, k, v)
             gr = jax.jit(jax.grad(lambda q, k, v: f(q, k, v, False),

@@ -64,7 +64,14 @@ def _block_size(s: int) -> int:
                 f"APEX_TPU_FLASH_BLOCK={b} must be a positive multiple of 128"
             )
         return min(b, max(128, -(-s // 128) * 128))
-    return 128 if s <= 128 else 256
+    if s <= 2048:
+        # measured on v5e (BASELINE.md variants table, 2026-07-30): block 512
+        # beats 256 by 1.12x at BERT-large b128 s512 (1712 vs 1922 ms/step)
+        # and 128 loses (2514 ms); larger tiles amortize the grid/fetch
+        # overhead while the fp32 score tile (512x512 = 1 MB) stays tiny in
+        # VMEM. Long/streaming sequences keep 256 until measured.
+        return min(512, max(128, -(-s // 128) * 128))
+    return 256
 
 
 # ---------------------------------------------------------------------------

@@ -13,7 +13,9 @@ Layout (shard_map-local):
   x [t, h]           — this rank's tokens (t = local token count)
   router wg [h, E]   — replicated over the expert axis
   experts w1 [E_local, h, f], w2 [E_local, f, h] — each rank OWNS
-                       E_local = E / ep_size experts (the EP sharding)
+                       E_local = E / ep_size experts (the EP sharding).
+                       act="swiglu" doubles w1's last dim to 2f
+                       ([gate|up] halves); w2 stays [E_local, f, h]
 
 Per token the router picks top-k experts; a token occupies a slot in an
 expert's fixed capacity C = ceil(t * k * capacity_factor / E) in router-
@@ -78,7 +80,8 @@ class MoEConfig:
 
 
 def moe_init(key, cfg: MoEConfig):
-    """FULL-size params: router [h, E] fp32 (replicate), w1 [E, h, f] and
+    """FULL-size params: router [h, E] fp32 (replicate), w1 [E, h, f]
+    ([E, h, 2f] when act="swiglu" — gate|up halves) and
     w2 [E, f, h] in cfg.dtype. Under expert parallelism shard w1/w2 on
     the leading (expert) dim — P(expert_axis, ...) — and let shard_map
     hand each rank its E_local = E / ep_size slice."""
