@@ -76,9 +76,15 @@ def _probe_rms_norm() -> None:
 
 
 @contextlib.contextmanager
-def _pinned_env(name: str, value: str):
+def _pinned_env(name: str, value):
+    """Pin ``name`` to ``value`` for the probe's duration (``None`` =
+    unset, so the probe sees the library DEFAULT, not an inherited
+    operator override)."""
     old = os.environ.get(name)
-    os.environ[name] = value
+    if value is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = value
     try:
         yield
     finally:
@@ -118,7 +124,15 @@ def _probe_flash_attention_resident() -> None:
     # the production default block is sequence-dependent (512 at s<=2048);
     # probe it at a MULTI-block shape (s=1024 -> 2x2 grid of 512-blocks) so
     # the default path's cross-block machinery is validated, not just the
-    # single-block degenerate case above
+    # single-block degenerate case above. An inherited operator override
+    # (e.g. APEX_TPU_FLASH_BLOCK=1024) would collapse this back to a 1x1
+    # grid — unset it so the probe sees the true default.
+    _probe_flash_default_block()
+
+
+def _probe_flash_default_block() -> None:
+    from apex_tpu.ops.attention import flash_attention
+
     q = jax.random.normal(jax.random.PRNGKey(5), (1, 2, 1024, 64), jnp.bfloat16)
     k = jax.random.normal(jax.random.PRNGKey(6), (1, 2, 1024, 64), jnp.bfloat16)
     v = jax.random.normal(jax.random.PRNGKey(7), (1, 2, 1024, 64), jnp.bfloat16)
@@ -128,8 +142,11 @@ def _probe_flash_attention_resident() -> None:
         y = flash_attention(q, k, v, causal=True, use_pallas=use)
         return jnp.vdot(y.astype(jnp.float32), do.astype(jnp.float32))
 
-    gp = jax.jit(jax.grad(lambda q, k, v: g(q, k, v, True), argnums=(0, 1, 2)))(q, k, v)
-    gr = jax.jit(jax.grad(lambda q, k, v: g(q, k, v, False), argnums=(0, 1, 2)))(q, k, v)
+    with _pinned_env("APEX_TPU_FLASH_BLOCK", None):
+        gp = jax.jit(jax.grad(lambda q, k, v: g(q, k, v, True),
+                              argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.jit(jax.grad(lambda q, k, v: g(q, k, v, False),
+                              argnums=(0, 1, 2)))(q, k, v)
     for a, c in zip(gp, gr):
         assert _maxdiff(a, c) < 0.1, \
             "flash_attention default-block grad mismatch vs oracle"

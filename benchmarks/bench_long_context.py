@@ -47,7 +47,17 @@ def main():
         # fwd = 2 matmuls = 4*s^2*d FLOPs per head (2 FLOPs/MAC included);
         # bwd counted as 2x fwd; causal halves the visible area
         fl = 0.5 * 4 * h * s * s * d * 3
-        for use, name in ((True, "flash "), (False, "unfused")):
+        # third leg: block 512 where it is NOT the default (s > 2048).
+        # NOTE which family it measures: 2049..8192 runs the RESIDENT
+        # kernels, above _STREAM_SEQ=8192 the STREAMING grids — record the
+        # rows accordingly (the resident 512-vs-256 win in BASELINE.md
+        # need not carry to either).
+        launch_block = os.environ.get("APEX_TPU_FLASH_BLOCK")
+        legs = [(True, "flash   ", launch_block), (False, "unfused ", launch_block)]
+        if s > 2048 and launch_block is None:
+            fam = "strm" if s > 8192 else "res "
+            legs.append((True, f"b512{fam}", "512"))
+        for use, name, block in legs:
             def g(q, k, v, use=use):
                 def loss(q, k, v):
                     o = flash_attention(q, k, v, causal=True, use_pallas=use)
@@ -55,6 +65,10 @@ def main():
                                     do.astype(jnp.float32))
                 return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
 
+            if block is not None:
+                os.environ["APEX_TPU_FLASH_BLOCK"] = block
+            else:
+                os.environ.pop("APEX_TPU_FLASH_BLOCK", None)
             try:
                 sec = timeit(jax.jit(g), q, k, v)
                 print(f"s={s:6d} {name}: {sec*1e3:9.2f} ms  "
@@ -62,6 +76,10 @@ def main():
             except Exception as e:
                 msg = (str(e).splitlines() or [type(e).__name__])[0][:100]
                 print(f"s={s:6d} {name}: FAILED ({msg})", flush=True)
+        if launch_block is None:
+            os.environ.pop("APEX_TPU_FLASH_BLOCK", None)
+        else:
+            os.environ["APEX_TPU_FLASH_BLOCK"] = launch_block
 
 
 if __name__ == "__main__":

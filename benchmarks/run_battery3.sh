@@ -1,0 +1,74 @@
+#!/bin/bash
+# Round-4 resumption battery. Lessons from run 1: (a) an ungraceful kill
+# of a TPU process can wedge the tunnel, after which EVERY item hangs at
+# device init and burns its full timeout — so now each item is gated on a
+# fresh tunnel probe (poll until it answers); (b) pytest -q gives no
+# failure detail when the whole run is timeout-killed — the TPU test tier
+# now runs per-file, verbose.
+set -u
+cd "$(dirname "$0")/.."
+LOGDIR="${1:-benchmarks/logs_r4c}"
+mkdir -p "$LOGDIR"
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}"
+
+log() { echo "[battery3 $(date -u +%H:%M:%S)] $*" | tee -a "$LOGDIR/battery.log"; }
+
+probe_ok() {
+  timeout 90 python -c "
+import jax
+d = jax.devices()
+assert d and d[0].platform == 'tpu', d
+" > /dev/null 2>&1
+}
+
+wait_tunnel() {  # poll up to ~2 h
+  for i in $(seq 1 60); do
+    if probe_ok; then return 0; fi
+    log "tunnel probe $i failed; sleeping 120s"
+    sleep 120
+  done
+  return 1
+}
+
+run() {  # run <name> <timeout_s> <cmd...> — probe-gated
+  local name="$1" t="$2"; shift 2
+  if ! wait_tunnel; then log "SKIP $name (tunnel never answered)"; return; fi
+  log "START $name: $*"
+  ( timeout "$t" "$@" ) > "$LOGDIR/$name.log" 2>&1
+  local rc=$?
+  log "END   $name rc=$rc (tail: $(tail -1 "$LOGDIR/$name.log" 2>/dev/null | cut -c1-120))"
+}
+
+# -- highest value first --------------------------------------------------
+# batch unlock at the new block-512 default + chunked loss
+run batch_unlock     3600 env BENCH_LOSS_CHUNK=8192 BENCH_BATCHES=160,192,256 \
+                          BENCH_WATCHDOG_S=3400 python bench.py
+# flashsave failure classification: b32 saves ~0.8 GB — compiling means OOM-class
+run flashsave_b32    1800 python benchmarks/bench_step_variants.py 32 \
+                          pallas pallas_flashsave
+# TPU test tier, per-file verbose (diagnose the LN parity failure first)
+run tpu_ln_test      1800 env APEX_TPU_HW=1 python -m pytest \
+                          "tests/tpu/test_kernels_compiled.py::test_layer_norm_compiled" -v
+run tpu_kernels      3600 env APEX_TPU_HW=1 python -m pytest \
+                          tests/tpu/test_kernels_compiled.py -v --deselect \
+                          "tests/tpu/test_kernels_compiled.py::test_layer_norm_compiled"
+run tpu_hlo          1800 env APEX_TPU_HW=1 python -m pytest \
+                          tests/tpu/test_hlo_fusion_tpu.py -v
+# kernel go/no-go tables
+run optim_kernels    1800 python benchmarks/bench_optim_kernels.py
+run ops_gbps         1800 python benchmarks/bench_ops.py
+run components       2400 python benchmarks/bench_components.py
+# A/Bs at the new default
+run split_bwd        1800 python benchmarks/bench_step_variants.py 128 split_bwd
+run flash_b256       1800 python benchmarks/bench_step_variants.py 128 flash_b256
+run batch192         2400 python benchmarks/bench_step_variants.py 192 \
+                          pallas chunked_loss
+# long context + examples
+run long_context     2400 python benchmarks/bench_long_context.py
+run ex_mnist         1200 python examples/mnist_mlp_amp.py --bench
+run ex_resnet        2400 python examples/resnet50_amp_ddp.py --bench
+run ex_gpt2tp        2400 python examples/gpt2_tensor_parallel.py --bench
+run ex_retinanet     2400 python examples/retinanet_focal_gn.py --bench
+run ex_main_amp      1200 python examples/main_amp.py --bench
+run ex_moe           2400 python examples/gpt_moe_ep.py --bench
+log "battery3 complete"
