@@ -42,6 +42,12 @@ from apex_tpu.ops._utils import pallas_interpret
 
 LANES = 128
 _BLOCK_ROWS = 2048  # 2048 x 128 fp32 = 1 MiB per operand tile in VMEM
+# The 7-tile optimizer kernels (4 inputs + 3 outputs) double-buffer every
+# tile, so 1 MiB tiles put ~14 MiB + stack on the 16 MiB scoped-VMEM
+# budget — measured OOM ("17.03M and limit 16.00M") on v5e at real grid
+# sizes. Half-size tiles keep the same sequential streaming pattern
+# (bandwidth-bound either way) with ~7 MiB resident.
+_BLOCK_ROWS_WIDE = 1024
 
 ADAM_MODE_ADAM = 0  # L2 regularization folded into the gradient
 ADAM_MODE_ADAMW = 1  # decoupled weight decay
@@ -119,14 +125,14 @@ def adam_flat(grads, params, exp_avg, exp_avg_sq, *, lr, beta1, beta2, eps,
         jnp.asarray(noop_flag).astype(jnp.float32),
     ])
 
-    g2, n = _pad_rows(grads.astype(jnp.float32), _BLOCK_ROWS)
-    p2, _ = _pad_rows(params, _BLOCK_ROWS)
-    m2, _ = _pad_rows(exp_avg, _BLOCK_ROWS)
-    v2, _ = _pad_rows(exp_avg_sq, _BLOCK_ROWS)
+    g2, n = _pad_rows(grads.astype(jnp.float32), _BLOCK_ROWS_WIDE)
+    p2, _ = _pad_rows(params, _BLOCK_ROWS_WIDE)
+    m2, _ = _pad_rows(exp_avg, _BLOCK_ROWS_WIDE)
+    v2, _ = _pad_rows(exp_avg_sq, _BLOCK_ROWS_WIDE)
     rows = p2.shape[0]
-    grid = rows // _BLOCK_ROWS
+    grid = rows // _BLOCK_ROWS_WIDE
 
-    blk = pl.BlockSpec((_BLOCK_ROWS, LANES), lambda i: (i, 0))
+    blk = pl.BlockSpec((_BLOCK_ROWS_WIDE, LANES), lambda i: (i, 0))
     s_spec = (
         pl.BlockSpec(memory_space=_SMEM)
         if _SMEM is not None and not pallas_interpret()
@@ -224,14 +230,14 @@ def lamb_phase1_flat(grads, params, exp_avg, exp_avg_sq, *, beta1, beta2,
         b1, b2, jnp.float32(eps), bc1, bc2,
         jnp.float32(weight_decay), jnp.asarray(grad_scale, jnp.float32),
     ])
-    g2, n = _pad_rows(grads.astype(jnp.float32), _BLOCK_ROWS)
-    p2, _ = _pad_rows(params, _BLOCK_ROWS)
-    m2, _ = _pad_rows(exp_avg, _BLOCK_ROWS)
-    v2, _ = _pad_rows(exp_avg_sq, _BLOCK_ROWS)
+    g2, n = _pad_rows(grads.astype(jnp.float32), _BLOCK_ROWS_WIDE)
+    p2, _ = _pad_rows(params, _BLOCK_ROWS_WIDE)
+    m2, _ = _pad_rows(exp_avg, _BLOCK_ROWS_WIDE)
+    v2, _ = _pad_rows(exp_avg_sq, _BLOCK_ROWS_WIDE)
     rows = p2.shape[0]
-    grid = rows // _BLOCK_ROWS
+    grid = rows // _BLOCK_ROWS_WIDE
 
-    blk = pl.BlockSpec((_BLOCK_ROWS, LANES), lambda i: (i, 0))
+    blk = pl.BlockSpec((_BLOCK_ROWS_WIDE, LANES), lambda i: (i, 0))
     s_spec = (
         pl.BlockSpec(memory_space=_SMEM)
         if _SMEM is not None and not pallas_interpret()

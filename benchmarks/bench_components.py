@@ -4,15 +4,24 @@ Times each hot component at bench shapes (batch 128, seq 512, h 1024),
 pallas vs jnp where both exist, plus fwd-only / fwd+bwd splits of the full
 model — so kernel decisions and remat policy are set from measurements,
 not guesses (round-2 verdict items 4/5/7).
+
+Component rows run all iterations inside one jitted lax.scan dispatch
+(benchmarks/_timing.py) — per-call dispatch timing is unreliable over the
+remote-TPU tunnel for sub-10ms ops. The full-model rows are seconds-scale,
+where dispatch overhead is noise, and keep plain wall-clock loops.
 """
 
+import os
 import sys
 import time
 
 sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
+
+from benchmarks._timing import dev_time
 
 
 def timeit(fn, *args, iters=10, warmup=2):
@@ -41,20 +50,30 @@ def main():
     do = jax.random.normal(jax.random.PRNGKey(3), (B, NH, S, D), dt)
 
     for use in (True, False):
-        f = jax.jit(lambda q, k, v, use=use: flash_attention(q, k, v, causal=False, use_pallas=use))
-        ms = timeit(f, q, k, v)
+        # chain q through the kernel output (same shape); k, v ride as consts
+        ms = dev_time(
+            lambda q, use=use: flash_attention(q, k, v, causal=False,
+                                               use_pallas=use),
+            q, iters=8) * 1e3
         # fwd attention matmul FLOPs: 2 matmuls x 2*S*S*D MACs per (B,NH)
         fl = 2 * 2 * B * NH * S * S * D
-        print(f"flash fwd   pallas={use}: {ms:8.2f} ms  {fl/ms/1e9:7.1f} GFLOP/s")
+        print(f"flash fwd   pallas={use}: {ms:8.2f} ms  {fl/ms/1e9:7.1f} GFLOP/s",
+              flush=True)
 
         def loss(q, k, v, use=use):
             y = flash_attention(q, k, v, causal=False, use_pallas=use)
             return jnp.vdot(y.astype(jnp.float32), do.astype(jnp.float32))
 
-        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-        ms = timeit(g, q, k, v)
+        g = jax.grad(loss, argnums=(0, 1, 2))
+        # sum all three grads into the q-shaped carry so none of dk/dv can
+        # be dead-coded out of the jnp path (3 extra elementwise adds ~1%
+        # of attention compute at these shapes)
+        ms = dev_time(
+            lambda q, g=g: (lambda t: t[0] + t[1] + t[2])(g(q, k, v)),
+            q, iters=8) * 1e3
         fl = 3 * 2 * 2 * B * NH * S * S * D
-        print(f"flash f+b   pallas={use}: {ms:8.2f} ms  {fl/ms/1e9:7.1f} GFLOP/s")
+        print(f"flash f+b   pallas={use}: {ms:8.2f} ms  {fl/ms/1e9:7.1f} GFLOP/s",
+              flush=True)
 
     # ---- layer norm pallas vs jnp ----
     from apex_tpu.ops.layer_norm import layer_norm_affine
@@ -64,19 +83,21 @@ def main():
     bt = jnp.zeros((H,), jnp.float32)
     dy = jax.random.normal(jax.random.PRNGKey(1), (B, S, H), dt)
     for use in (True, False):
-        f = jax.jit(lambda x, use=use: layer_norm_affine(x, gm, bt, 1e-5, use))
-        ms = timeit(f, x)
+        ms = dev_time(
+            lambda x, use=use: layer_norm_affine(x, gm, bt, 1e-5, use),
+            x, iters=16) * 1e3
         gb = 2 * x.size * x.dtype.itemsize / 1e9
-        print(f"LN fwd      pallas={use}: {ms:8.2f} ms  {gb/ms*1e3:7.1f} GB/s")
+        print(f"LN fwd      pallas={use}: {ms:8.2f} ms  {gb/ms*1e3:7.1f} GB/s",
+              flush=True)
 
         def loss(x, use=use):
             return jnp.vdot(layer_norm_affine(x, gm, bt, 1e-5, use).astype(jnp.float32),
                             dy.astype(jnp.float32))
 
-        g = jax.jit(jax.grad(loss))
-        ms = timeit(g, x)
+        ms = dev_time(jax.grad(loss), x, iters=16) * 1e3
         gb = 4 * x.size * x.dtype.itemsize / 1e9
-        print(f"LN f+b      pallas={use}: {ms:8.2f} ms  {gb/ms*1e3:7.1f} GB/s")
+        print(f"LN f+b      pallas={use}: {ms:8.2f} ms  {gb/ms*1e3:7.1f} GB/s",
+              flush=True)
 
     # ---- full model: fwd vs fwd+bwd vs full step ----
     from apex_tpu import amp

@@ -22,6 +22,26 @@ if os.environ.get("BENCH_CPU") == "1":
     jax.config.update("jax_platforms", "cpu")
 
 
+def _pinned_env(name, value):
+    """Pin ``name`` to ``value`` (None = unset), restored on ANY exit —
+    a KeyboardInterrupt mid-leg must not leak a block override into
+    whatever runs after main(). Reuses the preflight helper rather than
+    keeping a second copy in sync."""
+    from apex_tpu._preflight import _pinned_env as pin
+
+    return pin(name, value)
+
+
+def _family(s):
+    """Which kernel family a run at seq ``s`` actually uses. Asks the
+    attention module's own routing predicate (covers the env override,
+    preflight-disabled streaming, and no-pltpu-backend branches) — the
+    row label must not claim a family the run didn't execute."""
+    from apex_tpu.ops.attention import _use_streaming
+
+    return "strm" if _use_streaming(s, s) else "res "
+
+
 def timeit(fn, *args, iters=5):
     out = fn(*args)
     jax.block_until_ready(out)
@@ -55,8 +75,7 @@ def main():
         launch_block = os.environ.get("APEX_TPU_FLASH_BLOCK")
         legs = [(True, "flash   ", launch_block), (False, "unfused ", launch_block)]
         if s > 2048 and launch_block is None:
-            fam = "strm" if s > 8192 else "res "
-            legs.append((True, f"b512{fam}", "512"))
+            legs.append((True, f"b512{_family(s)}", "512"))
         for use, name, block in legs:
             def g(q, k, v, use=use):
                 def loss(q, k, v):
@@ -65,21 +84,14 @@ def main():
                                     do.astype(jnp.float32))
                 return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
 
-            if block is not None:
-                os.environ["APEX_TPU_FLASH_BLOCK"] = block
-            else:
-                os.environ.pop("APEX_TPU_FLASH_BLOCK", None)
-            try:
-                sec = timeit(jax.jit(g), q, k, v)
-                print(f"s={s:6d} {name}: {sec*1e3:9.2f} ms  "
-                      f"{fl/sec/1e12:6.2f} TFLOP/s", flush=True)
-            except Exception as e:
-                msg = (str(e).splitlines() or [type(e).__name__])[0][:100]
-                print(f"s={s:6d} {name}: FAILED ({msg})", flush=True)
-        if launch_block is None:
-            os.environ.pop("APEX_TPU_FLASH_BLOCK", None)
-        else:
-            os.environ["APEX_TPU_FLASH_BLOCK"] = launch_block
+            with _pinned_env("APEX_TPU_FLASH_BLOCK", block):
+                try:
+                    sec = timeit(jax.jit(g), q, k, v)
+                    print(f"s={s:6d} {name}: {sec*1e3:9.2f} ms  "
+                          f"{fl/sec/1e12:6.2f} TFLOP/s", flush=True)
+                except Exception as e:
+                    msg = (str(e).splitlines() or [type(e).__name__])[0][:100]
+                    print(f"s={s:6d} {name}: FAILED ({msg})", flush=True)
 
 
 if __name__ == "__main__":

@@ -6,6 +6,10 @@ achieved HBM bandwidth — the ops are bandwidth-bound, so GB/s vs the chip's
 peak (~820 GB/s on v5e) is the verdict. tests/L0/test_hlo_fusion.py pins
 the fusion structurally; this pins the speed. Record results in BASELINE.md.
 
+Timing runs every iteration inside one jitted lax.scan dispatch
+(benchmarks/_timing.py) — per-call dispatch timing is meaningless over
+the remote-TPU tunnel.
+
 Usage:  python benchmarks/bench_ops.py          (real device)
         BENCH_CPU=1 python benchmarks/bench_ops.py
 """
@@ -14,7 +18,6 @@ from __future__ import annotations
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -24,15 +27,7 @@ import jax.numpy as jnp
 if os.environ.get("BENCH_CPU") == "1":
     jax.config.update("jax_platforms", "cpu")
 
-
-def timeit(fn, *args, iters=20):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+from benchmarks._timing import dev_time
 
 
 def row(name, sec, traffic_bytes):
@@ -48,42 +43,49 @@ def main():
 
     print(f"device: {jax.devices()[0]}", flush=True)
     B, H, S = 16, 16, 512  # BERT-large attention shapes
+    if os.environ.get("BENCH_OPS_SMALL") == "1":  # CPU smoke of the harness
+        B, H, S = 2, 2, 64
+    iters = int(os.environ.get("BENCH_OPS_ITERS", "16"))
 
     # ---- fused softmax family (fwd and grad) ----
+    # chain: softmax output is same-shape and stays finite under iteration
     x = jax.random.normal(jax.random.PRNGKey(0), (B, H, S, S), jnp.bfloat16)
     mask = jax.random.uniform(jax.random.PRNGKey(1), (B, 1, S, S)) < 0.1
     nbytes = x.size * 2
 
-    f = jax.jit(lambda x, m: scaled_masked_softmax(x, m, 1.0))
-    row("scaled_masked_softmax fwd", timeit(f, x, mask), 2 * nbytes)
+    sec = dev_time(lambda x: scaled_masked_softmax(x, mask, 1.0), x, iters)
+    row("scaled_masked_softmax fwd", sec, 2 * nbytes)
 
-    g = jax.jit(jax.grad(lambda x: jnp.sum(
-        scaled_masked_softmax(x, mask, 1.0).astype(jnp.float32) ** 2)))
-    row("scaled_masked_softmax f+b", timeit(g, x), 4 * nbytes)
+    g = jax.grad(lambda x: jnp.sum(
+        scaled_masked_softmax(x, mask, 1.0).astype(jnp.float32) ** 2))
+    sec = dev_time(g, x, iters)
+    row("scaled_masked_softmax f+b", sec, 4 * nbytes)
 
     xt = jax.random.normal(jax.random.PRNGKey(2), (B * H, S, S), jnp.bfloat16)
-    f = jax.jit(lambda x: scaled_upper_triang_masked_softmax(x, 1.0))
-    row("upper_triang_softmax fwd", timeit(f, xt), 2 * xt.size * 2)
+    sec = dev_time(lambda x: scaled_upper_triang_masked_softmax(x, 1.0),
+                   xt, iters)
+    row("upper_triang_softmax fwd", sec, 2 * xt.size * 2)
 
     # ---- RoPE ----
     cos, sin = rope_frequencies(64, S)
     q = jax.random.normal(jax.random.PRNGKey(3), (B, H, S, 64), jnp.bfloat16)
-    f = jax.jit(lambda q: apply_rope(q, cos, sin))
-    row("rope fwd", timeit(f, q), 2 * q.size * 2)
-    g = jax.jit(jax.grad(lambda q: jnp.sum(
-        apply_rope(q, cos, sin).astype(jnp.float32) ** 2)))
-    row("rope f+b", timeit(g, q), 4 * q.size * 2)
+    sec = dev_time(lambda q: apply_rope(q, cos, sin), q, iters)
+    row("rope fwd", sec, 2 * q.size * 2)
+    g = jax.grad(lambda q: jnp.sum(
+        apply_rope(q, cos, sin).astype(jnp.float32) ** 2))
+    sec = dev_time(g, q, iters)
+    row("rope f+b", sec, 4 * q.size * 2)
 
     # ---- vocab cross-entropy (BERT-large head shape) ----
+    # fwd produces a scalar, so chain through the GRADIENT (same-shape
+    # dlogits) for both rows; the fwd runs inside the grad anyway
     logits = jax.random.normal(jax.random.PRNGKey(4), (B * S, 30528),
                                jnp.bfloat16)
     labels = jax.random.randint(jax.random.PRNGKey(5), (B * S,), 0, 30528)
-    f = jax.jit(lambda lg: jnp.mean(softmax_cross_entropy(lg, labels, 0.1)))
-    row("xentropy fwd", timeit(f, logits), logits.size * 2)
-    g = jax.jit(jax.grad(lambda lg: jnp.mean(
-        softmax_cross_entropy(lg, labels, 0.1))))
+    g = jax.grad(lambda lg: jnp.mean(softmax_cross_entropy(lg, labels, 0.1)))
     # recompute-bwd reads logits twice, writes dlogits once
-    row("xentropy f+b", timeit(g, logits), 3 * logits.size * 2)
+    sec = dev_time(g, logits, iters)
+    row("xentropy f+b", sec, 3 * logits.size * 2)
 
 
 if __name__ == "__main__":

@@ -7,6 +7,12 @@ down) and prints a table; the winner becomes the platform default
 (``DistributedFusedAdam(use_pallas=...)``, ops/_utils.default_use_pallas).
 Record results in BASELINE.md.
 
+Timing runs all iterations inside one jitted lax.scan dispatch
+(benchmarks/_timing.py): the adam rows chain the full (p, m, v) state so
+neither implementation can dead-code the moment updates; the l2 rows
+chain ``x + norm*tiny`` (same small overhead on both sides, so the
+jit-vs-pallas comparison stays fair).
+
 Usage:  python benchmarks/bench_optim_kernels.py          (real device)
         BENCH_CPU=1 python benchmarks/bench_optim_kernels.py   (debug)
 """
@@ -15,7 +21,6 @@ from __future__ import annotations
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -25,15 +30,7 @@ import jax.numpy as jnp
 if os.environ.get("BENCH_CPU") == "1":
     jax.config.update("jax_platforms", "cpu")
 
-
-def timeit(fn, *args, iters=20):
-    out = fn(*args)  # compile
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+from benchmarks._timing import dev_time
 
 
 def main():
@@ -43,8 +40,10 @@ def main():
     dev = jax.devices()[0]
     print(f"device: {dev} ({dev.device_kind})", file=sys.stderr)
     sizes = [2**20, 2**24, 42_553_344]  # 1M, 16M, BERT-large/8 fp32
+    iters = 16
     if os.environ.get("BENCH_CPU") == "1":
         sizes = [2**16, 2**18]
+        iters = 2
 
     kw = dict(lr=1e-3, beta1=0.9, beta2=0.95, eps=1e-8, step=7,
               bias_correction=True, weight_decay=0.01)
@@ -58,22 +57,31 @@ def main():
         m = jnp.zeros((n,), jnp.float32)
         v = jnp.zeros((n,), jnp.float32)
 
-        jit_adam = jax.jit(lambda g, p, m, v: F.multi_tensor_adam(
-            jnp.bool_(False), [[g], [p], [m], [v]],
-            kw["lr"], kw["beta1"], kw["beta2"], kw["eps"], kw["step"],
-            PK.ADAM_MODE_ADAMW, kw["bias_correction"], kw["weight_decay"],
-        )[0])
-        pallas_adam = jax.jit(lambda g, p, m, v: PK.adam_flat(
-            g, p, m, v, mode=PK.ADAM_MODE_ADAMW, **kw)[0])
-        jit_l2 = jax.jit(lambda x: jnp.sqrt(jnp.sum(
-            x.astype(jnp.float32) ** 2)))
+        def jit_adam(c):
+            p, m, v = c
+            out = F.multi_tensor_adam(
+                jnp.bool_(False), [[g], [p], [m], [v]],
+                kw["lr"], kw["beta1"], kw["beta2"], kw["eps"], kw["step"],
+                PK.ADAM_MODE_ADAMW, kw["bias_correction"],
+                kw["weight_decay"])
+            return out[0][0], out[1][0], out[2][0]
 
-        t_aj = timeit(jit_adam, g, p, m, v)
-        t_ap = timeit(pallas_adam, g, p, m, v)
-        t_lj = timeit(jit_l2, g)
-        t_lp = timeit(PK.l2norm_flat, g)
+        def pallas_adam(c):
+            p, m, v = c
+            return PK.adam_flat(g, p, m, v, mode=PK.ADAM_MODE_ADAMW, **kw)
+
+        def jit_l2(x):
+            return x + jnp.sqrt(jnp.sum(x.astype(jnp.float32) ** 2)) * 1e-30
+
+        def pallas_l2(x):
+            return x + PK.l2norm_flat(x) * 1e-30
+
+        t_aj = dev_time(jit_adam, (p, m, v), iters)
+        t_ap = dev_time(pallas_adam, (p, m, v), iters)
+        t_lj = dev_time(jit_l2, g, iters)
+        t_lp = dev_time(pallas_l2, g, iters)
         print(f"{n:>12} {t_aj*1e3:>12.3f} {t_ap*1e3:>15.3f} "
-              f"{t_lj*1e3:>10.3f} {t_lp*1e3:>13.3f}")
+              f"{t_lj*1e3:>10.3f} {t_lp*1e3:>13.3f}", flush=True)
 
     # HBM roofline context: adam touches 4 reads + 3 writes of n fp32
     bw = 7 * sizes[-1] * 4

@@ -1,0 +1,62 @@
+#!/bin/bash
+# Round-4 phase-2 battery: the MFU hunt + reruns of items phase 1 lost.
+#
+# Fixes over run_battery3.sh (round-4 review findings):
+#  - `timeout -k 10`: a probe hung inside C-level TPU device init defers
+#    SIGTERM forever; the follow-up KILL actually reaps it so an orphan
+#    can't wedge the tunnel for every later item.
+#  - One exhausted wait_tunnel ABORTS the whole battery instead of
+#    re-polling ~3.5 h per remaining item.
+set -u
+cd "$(dirname "$0")/.."
+LOGDIR="${1:-benchmarks/logs_r4e}"
+mkdir -p "$LOGDIR"
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}"
+
+log() { echo "[battery4 $(date -u +%H:%M:%S)] $*" | tee -a "$LOGDIR/battery.log"; }
+
+probe_ok() {
+  timeout -k 10 90 python -c "
+import jax
+d = jax.devices()
+assert d and d[0].platform == 'tpu', d
+" > /dev/null 2>&1
+}
+
+wait_tunnel() {  # poll up to ~1 h; caller aborts on failure
+  for i in $(seq 1 20); do
+    if probe_ok; then return 0; fi
+    log "tunnel probe $i failed; sleeping 120s"
+    sleep 120
+  done
+  return 1
+}
+
+run() {  # run <name> <timeout_s> <cmd...> — probe-gated, abort-on-dead-tunnel
+  local name="$1" t="$2"; shift 2
+  if ! wait_tunnel; then
+    log "ABORT battery: tunnel never answered before $name"
+    exit 1
+  fi
+  log "START $name: $*"
+  ( timeout -k 10 "$t" "$@" ) > "$LOGDIR/$name.log" 2>&1
+  local rc=$?
+  log "END   $name rc=$rc (tail: $(tail -1 "$LOGDIR/$name.log" 2>/dev/null | cut -c1-120))"
+}
+
+# -- the MFU hunt: remat-free operating points at small-mid batch ---------
+run noremat_b32   2400 python benchmarks/bench_step_variants.py 32 \
+                       pallas pallas_noremat pallas_dots
+run noremat_b64   2400 python benchmarks/bench_step_variants.py 64 \
+                       pallas pallas_noremat pallas_dots
+run noremat_b96   2400 python benchmarks/bench_step_variants.py 96 \
+                       pallas pallas_noremat
+# -- reruns: optim kernel table (VMEM fix) + the retuned LAMB test --------
+run optim_kernels 1800 python benchmarks/bench_optim_kernels.py
+# scan-dispatch timing harness (phase-1 rows measured tunnel RPC behavior)
+run ops_gbps2     1800 python benchmarks/bench_ops.py
+run components2   2400 python benchmarks/bench_components.py
+run tpu_lamb      1800 env APEX_TPU_HW=1 python -m pytest \
+                       tests/tpu/test_kernels_compiled.py \
+                       -k "lamb_phase1 or adam_flat or l2norm" -v
+log "battery4 complete"

