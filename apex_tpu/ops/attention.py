@@ -51,7 +51,7 @@ _VALID_THRESHOLD = -5e29  # scores below this are treated as masked-out
 _HIGHEST = jax.lax.Precision.HIGHEST
 
 
-def _block_size(s: int) -> int:
+def _block_size(s: int, streaming: bool = False) -> int:
     """Block sizes must be multiples of 128 so every dynamic slice is
     provably lane-aligned for Mosaic. ``APEX_TPU_FLASH_BLOCK`` overrides
     the default (tuning knob for benchmarks/bench_step_variants.py); the
@@ -64,13 +64,22 @@ def _block_size(s: int) -> int:
                 f"APEX_TPU_FLASH_BLOCK={b} must be a positive multiple of 128"
             )
         return min(b, max(128, -(-s // 128) * 128))
+    if streaming:
+        # measured on v5e (bench_long_context, 2026-07-31): block 512 runs
+        # the streaming grids 2.1-2.2x faster than 256 (s=16384: 62.0 vs
+        # 129.7 ms f+b; s=32768: 234.0 vs 508.5 ms, 28.2 TFLOP/s) — bigger
+        # tiles amortize the per-grid-step DMA of the O(block) scratch
+        return min(512, max(128, -(-s // 128) * 128))
     if s <= 2048:
         # measured on v5e (BASELINE.md variants table, 2026-07-30): block 512
         # beats 256 by 1.12x at BERT-large b128 s512 (1712 vs 1922 ms/step)
         # and 128 loses (2514 ms); larger tiles amortize the grid/fetch
         # overhead while the fp32 score tile (512x512 = 1 MB) stays tiny in
-        # VMEM. Long/streaming sequences keep 256 until measured.
+        # VMEM.
         return min(512, max(128, -(-s // 128) * 128))
+    # resident family above 2048: the fp32 score tile is [bq, bk] but the
+    # whole K/V stays in VMEM too — 256 measured best (s=4096: 8.9 ms vs
+    # 15.1 ms at 512)
     return 256
 
 
@@ -213,7 +222,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, offset, scale, block_k,
 # pl.when (their DMA still runs — acceptable 2x bandwidth on causal).
 # ---------------------------------------------------------------------------
 
-_STREAM_SEQ = 8192  # switch point: max(sq, sk) strictly greater -> streaming
+# Switch point: max(sq, sk) strictly greater -> streaming. Measured on
+# v5e (bench_long_context, 2026-07-31): the resident family compiles and
+# sustains 11.6 TFLOP/s f+b at s=4096 but FAILS to compile at s=8192
+# (scoped-VMEM class, via the remote compile helper), while the streaming
+# grids sustain 12.7 TFLOP/s at s=16384 — so hand 8192 to streaming.
+_STREAM_SEQ = 4096
 
 try:
     from jax.experimental.pallas import tpu as _pltpu
@@ -306,8 +320,8 @@ def _fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, causal, offset, scale, nk,
 def _fwd_stream_pallas(q, k, v, bias, causal, scale, drop=None, group=1):
     b, sq, d = q.shape                    # b = batch * QUERY heads
     sk = k.shape[1]
-    bq = _block_size(sq)
-    bk = _block_size(sk)
+    bq = _block_size(sq, streaming=True)
+    bk = _block_size(sk, streaming=True)
     qp = _pad_seq(q, bq, 1)
     kp = _pad_seq(k, bk, 1)
     vp = _pad_seq(v, bk, 1)
@@ -891,8 +905,9 @@ def _bwd_prologue(q, k, v, bias, o, lse, do, dlse):
     padded-K-column mask bias."""
     b, sq, d = q.shape
     sk = k.shape[1]
-    bq = _block_size(sq)
-    bk = _block_size(sk)
+    strm = _use_streaming(sq, sk)
+    bq = _block_size(sq, streaming=strm)
+    bk = _block_size(sk, streaming=strm)
     qp = _pad_seq(q, bq, 1)
     kp = _pad_seq(k, bk, 1)
     vp = _pad_seq(v, bk, 1)
@@ -1138,7 +1153,10 @@ def _check_dbias_seq(q, k):
         f"sk={k.shape[1]} > {_STREAM_SEQ}) would materialize the full "
         "score matrix; pass a non-learned bias as `mask` (no gradient), "
         "stop_gradient the bias, or force the resident kernels with "
-        "APEX_TPU_FLASH_STREAM=0 if you accept the memory cost"
+        "APEX_TPU_FLASH_STREAM=0 if you accept the memory cost (the "
+        "resident family compiled to seq 4096 and failed scoped-VMEM at "
+        "8192 in v5e measurements — in between, forcing it may work for "
+        "your geometry)"
     )
 
 

@@ -171,7 +171,7 @@ class TransformerConfig:
 
     def __post_init__(self):
         assert self.remat_policy in (
-            "full", "dots", "flash", "flash_offload", "none"
+            "full", "dots", "flash", "dots_flash", "flash_offload", "none"
         ), f"unknown remat_policy {self.remat_policy!r}"
         assert self.moe_experts >= 0
         assert self.norm in ("layernorm", "rmsnorm"), self.norm
@@ -554,6 +554,22 @@ def _forward_hidden(params, tokens, cfg: TransformerConfig, *,
                 block,
                 policy=jax.checkpoint_policies.save_only_these_names(
                     "flash_out", "flash_lse"
+                ),
+            )
+        elif cfg.remat_policy == "dots_flash":
+            # matmul outputs AND the flash kernel's (o, lse) residuals:
+            # the backward recomputes only LN/elementwise — no MXU work
+            # and no attention forward. Memory sits between "dots" and
+            # "none"; measured v5e 2026-07-31: "dots" fits (and beats
+            # full remat) at b32 with flash block 512, so this is the
+            # next rung on the same ladder.
+            block = jax.checkpoint(
+                block,
+                policy=jax.checkpoint_policies.save_from_both_policies(
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                    jax.checkpoint_policies.save_only_these_names(
+                        "flash_out", "flash_lse"
+                    ),
                 ),
             )
         elif cfg.remat_policy == "flash_offload":

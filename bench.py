@@ -288,38 +288,44 @@ def main():
     _SO_FAR["kernels"] = kernel_report
 
     if on_cpu:
-        cfg = TransformerConfig(
+        plan = [(4, TransformerConfig(
             vocab_size=512, seq_len=128, hidden=128, layers=2, heads=4,
             causal=False, dtype=jnp.bfloat16, scan_layers=True, remat=True,
-        )
-        batches = [4]
+        ))]
     else:
         # BERT-large: 24 x 1024 x 16 heads, seq 512, vocab 30528 (padded)
-        # default stays on the measured-good config; flip after
-        # bench_step_variants.py proves a better remat policy on hardware
         from apex_tpu.models import bert_large
 
-        remat_mode = os.environ.get("BENCH_REMAT", "full")
+        default_remat = os.environ.get("BENCH_REMAT", "full")
         loss_chunk = int(os.environ.get("BENCH_LOSS_CHUNK", "0")) or None
-        # the north-star geometry lives in ONE place: models.bert_large
-        cfg = bert_large(
-            remat=remat_mode != "none", remat_policy=remat_mode,
-            loss_chunk=loss_chunk,
-        )
-        # 144 refines the sweep near the measured peak (128 best, 160
-        # worse on v5e — BASELINE.md); the sweep reports every row, so
-        # extra points only sharpen the "best" pick
-        batches = [int(b) for b in os.environ.get(
-            "BENCH_BATCHES", "32,64,96,128,144").split(",")]
 
-    def model_fn(p, tokens, labels, loss_mask):
-        return bert_loss(p, tokens, labels, loss_mask, cfg)
+        def mk_cfg(policy):
+            # the north-star geometry lives in ONE place: models.bert_large
+            return bert_large(
+                remat=policy != "none", remat_policy=policy,
+                loss_chunk=loss_chunk,
+            )
+
+        # BENCH_BATCHES entries are "batch" or "batch@remat_policy" — the
+        # sweep can mix remat policies because the best operating point is
+        # policy-dependent: measured on v5e (BASELINE.md, 2026-07-31),
+        # dots remat fits ONLY at b<=32 where it beats full remat (415.8
+        # vs 431.8 ms), while b128 full remat is the best full-remat
+        # point; the sweep reports every row and "best" picks the winner
+        plan = []
+        for entry in os.environ.get(
+                "BENCH_BATCHES", "32@dots,64,96,128,144").split(","):
+            b, _, pol = entry.strip().partition("@")
+            plan.append((int(b), mk_cfg(pol or default_remat)))
 
     mesh = Mesh([dev], ("model",))
-    s = cfg.seq_len
     sweep = _SO_FAR["sweep"]  # shared: partial emitters see live appends
     best = None
-    for batch in batches:
+    for batch, cfg in plan:
+        s = cfg.seq_len
+
+        def model_fn(p, tokens, labels, loss_mask, cfg=cfg):
+            return bert_loss(p, tokens, labels, loss_mask, cfg)
         params = stack_layer_params(transformer_init(jax.random.PRNGKey(0), cfg))
         amp_fn, params, opt = amp.initialize(
             model_fn, params, fused_lamb(1e-3), opt_level="O2", verbosity=0
@@ -361,11 +367,15 @@ def main():
             # would hang behind it — emit what we have and stop
             print(f"bench: batch {batch} hung; truncating sweep",
                   file=sys.stderr)
-            sweep.append({"batch": batch, "error": "compile/measure hung"})
+            sweep.append({"batch": batch,
+                          "remat": cfg.remat_policy if cfg.remat else "none",
+                          "error": "compile/measure hung"})
             _emit_partial_and_exit(f"sweep truncated: batch {batch} hung")
         if err is not None:  # e.g. OOM at large batch
             print(f"bench: batch {batch} failed: {err}", file=sys.stderr)
-            sweep.append({"batch": batch, "error": str(err).splitlines()[0][:200]})
+            sweep.append({"batch": batch,
+                          "remat": cfg.remat_policy if cfg.remat else "none",
+                          "error": str(err).splitlines()[0][:200]})
             continue
         compile_s, dt, xla_flops = result
         flops = _hand_flops(cfg, batch)
@@ -382,6 +392,7 @@ def main():
         row["seq"] = s
         row["device"] = str(dev)
         row["config"] = "toy-cpu" if on_cpu else "bert-large"
+        row["remat"] = cfg.remat_policy if cfg.remat else "none"
         sweep.append(row)
         if best is None or row["samples_per_sec"] > best["samples_per_sec"]:
             best = row

@@ -28,20 +28,30 @@ from jax import lax
 def dev_time(step, x0, iters=32, reps=3):
     """Mean seconds per application of ``step`` (x -> same-shape x).
 
-    Compiles ``scan(step, x0, length=iters)`` once, then takes the best
-    of ``reps`` timed dispatches (best-of guards against tunnel hiccups;
-    within a dispatch the device runs back-to-back).
+    TWO-POINT measurement: even a single dispatch pays a fixed ~tens-of-ms
+    round trip on the remote tunnel (measured: every sub-ms optimizer row
+    reading exactly ~4 ms at iters=16 — pure overhead/iters). Timing a
+    short scan and a long scan and taking the slope
+    ``(T_long - T_short) / (n_long - n_short)`` cancels that fixed cost
+    exactly; best-of-``reps`` on each leg guards against tunnel jitter.
     """
 
     def body(c, _):
         return step(c), None
 
-    f = jax.jit(lambda x: lax.scan(body, x, None, length=iters)[0])
-    y = f(x0)
-    jax.block_until_ready(y)  # compile + warm
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        jax.block_until_ready(f(x0))
-        best = min(best, time.perf_counter() - t0)
-    return best / iters
+    n_short = max(1, iters // 4)
+    n_long = n_short + iters
+
+    def timed(n):
+        f = jax.jit(lambda x: lax.scan(body, x, None, length=n)[0])
+        jax.block_until_ready(f(x0))  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(x0))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_short = timed(n_short)
+    t_long = timed(n_long)
+    return max(t_long - t_short, 1e-9) / (n_long - n_short)

@@ -37,6 +37,11 @@ def timeit(fn, *args, iters=10, warmup=2):
 
 def main():
     B, S, H, NH, D = 128, 512, 1024, 16, 64
+    layers = 24
+    if os.environ.get("BENCH_COMP_SMALL") == "1":  # CPU smoke of the harness
+        jax.config.update("jax_platforms", "cpu")
+        B, S, H, NH, D = 2, 64, 64, 4, 16
+        layers = 2
     dt = jnp.bfloat16
     dev = jax.devices()[0]
     print(f"device: {dev}", flush=True)
@@ -100,14 +105,22 @@ def main():
               flush=True)
 
     # ---- full model: fwd vs fwd+bwd vs full step ----
+    # The standalone transformer's TP layers name a "model" axis, so the
+    # calls must run under shard_map over a 1-device model mesh (same
+    # wiring as bench_step_variants.build_step)
+    from jax.sharding import Mesh, PartitionSpec as P
+
     from apex_tpu import amp
     from apex_tpu.optimizers import fused_lamb
     from apex_tpu.testing import (
         TransformerConfig, bert_loss, stack_layer_params, transformer_init)
+    from apex_tpu.testing.commons import smap
+
+    mesh = Mesh([jax.devices()[0]], ("model",))
 
     for remat in (True, False):
         cfg = TransformerConfig(
-            vocab_size=30528, seq_len=S, hidden=H, layers=24, heads=NH,
+            vocab_size=30528, seq_len=S, hidden=H, layers=layers, heads=NH,
             causal=False, dtype=dt, scan_layers=True, remat=remat)
         params = stack_layer_params(transformer_init(jax.random.PRNGKey(0), cfg))
 
@@ -121,17 +134,23 @@ def main():
         labels = jax.random.randint(jax.random.PRNGKey(2), (B, S), 0, cfg.vocab_size)
         mask = jax.random.uniform(jax.random.PRNGKey(3), (B, S)) < 0.15
 
-        fwd = jax.jit(lambda p, s: amp_fn(p, tokens, labels, mask))
+        pspec = jax.tree.map(lambda _: P(), params)
+        sspec = jax.tree.map(lambda _: P(), state)
+        fwd = jax.jit(smap(
+            lambda p, s, t, l, mk: amp_fn(p, t, l, mk),
+            mesh, (pspec, sspec, P(), P(), P()), P()))
         try:
-            ms_f = timeit(fwd, params, state, iters=5)
+            ms_f = timeit(fwd, params, state, tokens, labels, mask, iters=5)
         except Exception as e:
             print(f"remat={remat} fwd FAILED: {str(e)[:120]}")
             continue
 
-        grad = jax.jit(lambda p, s: jax.grad(
-            lambda p: amp.scale_loss(amp_fn(p, tokens, labels, mask), s))(p))
+        grad = jax.jit(smap(
+            lambda p, s, t, l, mk: jax.grad(
+                lambda p: amp.scale_loss(amp_fn(p, t, l, mk), s))(p),
+            mesh, (pspec, sspec, P(), P(), P()), pspec))
         try:
-            ms_g = timeit(grad, params, state, iters=5)
+            ms_g = timeit(grad, params, state, tokens, labels, mask, iters=5)
         except Exception as e:
             print(f"remat={remat} fwd: {ms_f:.1f} ms; grad FAILED: {str(e)[:120]}")
             continue

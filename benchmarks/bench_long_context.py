@@ -69,12 +69,17 @@ def main():
         fl = 0.5 * 4 * h * s * s * d * 3
         # third leg: block 512 where it is NOT the default (s > 2048).
         # NOTE which family it measures: 2049..8192 runs the RESIDENT
-        # kernels, above _STREAM_SEQ=8192 the STREAMING grids — record the
+        # kernels, above _STREAM_SEQ the STREAMING grids — record the
         # rows accordingly (the resident 512-vs-256 win in BASELINE.md
         # need not carry to either).
         launch_block = os.environ.get("APEX_TPU_FLASH_BLOCK")
         legs = [(True, "flash   ", launch_block), (False, "unfused ", launch_block)]
-        if s > 2048 and launch_block is None:
+        from apex_tpu.ops.attention import _use_streaming
+
+        # A/B leg only where 512 is NOT already the default: the resident
+        # family above 2048 (streaming defaults to 512 since 2026-07-31)
+        if (s > 2048 and launch_block is None
+                and not _use_streaming(s, s)):
             legs.append((True, f"b512{_family(s)}", "512"))
         for use, name, block in legs:
             def g(q, k, v, use=use):

@@ -79,7 +79,11 @@ def main():
         "pallas_dots": ([], "dots"),
         "pallas_flashsave": ([], "flash"),  # save flash o/lse, skip its
                                             # fwd in the bwd recompute
+        "pallas_dotsflash": ([], "dots_flash"),  # dots + flash o/lse: bwd
+                                                 # recomputes only LN/
+                                                 # elementwise
         "flashsave_chunked": ([], "flash"),  # + fused linear+CE loss
+        "dots_chunked": ([], "dots"),        # dots remat + chunked loss
         "flash_offload": ([], "flash_offload"),  # flash o/lse to host mem
         "pallas_noremat": ([], "none"),
         "attn_dropout": ([], "full"),   # fused kernel dropout p=0.1 (the
@@ -117,7 +121,7 @@ def main():
         if name.startswith("flash_b"):
             _os.environ["APEX_TPU_FLASH_BLOCK"] = name[len("flash_b"):]
         cfg_over = {"fp32_logits": True} if name == "fp32_logits" else None
-        if name in ("chunked_loss", "flashsave_chunked"):
+        if name in ("chunked_loss", "flashsave_chunked", "dots_chunked"):
             cfg_over = {"loss_chunk": 8192}
         if name.startswith("attn_dropout"):
             cfg_over = {"attn_dropout_p": 0.1}

@@ -56,6 +56,15 @@ run optim_kernels 1800 python benchmarks/bench_optim_kernels.py
 # scan-dispatch timing harness (phase-1 rows measured tunnel RPC behavior)
 run ops_gbps2     1800 python benchmarks/bench_ops.py
 run components2   2400 python benchmarks/bench_components.py
+# long-context follow-ups: s=8192 now routes to the streaming grids
+# (_STREAM_SEQ 8192 -> 4096); A/B the 512-at-2048 block rule that measured
+# SLOWER than unfused in phase 1
+run lc8192        1800 python benchmarks/bench_long_context.py 8192
+run lc2048_b256   1800 env APEX_TPU_FLASH_BLOCK=256 python benchmarks/bench_long_context.py 2048
+run lc2048_b128   1800 env APEX_TPU_FLASH_BLOCK=128 python benchmarks/bench_long_context.py 2048
+run ex_gpt2tp2    2400 python examples/gpt2_tensor_parallel.py --bench
+run ex_main_amp2  1200 python examples/main_amp.py --bench
+run ex_moe2       2400 python examples/gpt_moe_ep.py --bench
 run tpu_lamb      1800 env APEX_TPU_HW=1 python -m pytest \
                        tests/tpu/test_kernels_compiled.py \
                        -k "lamb_phase1 or adam_flat or l2norm" -v

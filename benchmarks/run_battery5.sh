@@ -1,0 +1,62 @@
+#!/bin/bash
+# Round-4 phase-3 battery: the dots/dots_flash remat ladder (the measured
+# MFU levers from battery4's noremat probes) + the bench operating point.
+set -u
+cd "$(dirname "$0")/.."
+LOGDIR="${1:-benchmarks/logs_r4f}"
+mkdir -p "$LOGDIR"
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}"
+
+log() { echo "[battery5 $(date -u +%H:%M:%S)] $*" | tee -a "$LOGDIR/battery.log"; }
+
+probe_ok() {
+  timeout -k 10 90 python -c "
+import jax
+d = jax.devices()
+assert d and d[0].platform == 'tpu', d
+" > /dev/null 2>&1
+}
+
+wait_tunnel() {
+  for i in $(seq 1 20); do
+    if probe_ok; then return 0; fi
+    log "tunnel probe $i failed; sleeping 120s"
+    sleep 120
+  done
+  return 1
+}
+
+run() {
+  local name="$1" t="$2"; shift 2
+  if ! wait_tunnel; then
+    log "ABORT battery: tunnel never answered before $name"
+    exit 1
+  fi
+  log "START $name: $*"
+  ( timeout -k 10 "$t" "$@" ) > "$LOGDIR/$name.log" 2>&1
+  local rc=$?
+  log "END   $name rc=$rc (tail: $(tail -1 "$LOGDIR/$name.log" 2>/dev/null | cut -c1-120))"
+}
+
+# optimizer-kernel table rerun: the battery4 run's rows were a flat
+# ~4 ms dispatch-overhead floor; _timing.py now uses two-point slope
+run optim_kernels2 1800 python benchmarks/bench_optim_kernels.py
+run ops_gbps3      1800 python benchmarks/bench_ops.py
+# the remat ladder: dots beat full at b32 (415.8 vs 431.8 ms) but OOMs
+# at b64 (battery4) — probe the b48 rung, the dots_flash upgrade at b32,
+# and whether chunked loss (frees the b*s*25k-logit buffer) stretches
+# dots one rung further
+run dotsflash_b32  2400 python benchmarks/bench_step_variants.py 32 \
+                        pallas_dotsflash
+run dots_b48       2400 python benchmarks/bench_step_variants.py 48 \
+                        pallas_dots
+run dots_chunk48   2400 python benchmarks/bench_step_variants.py 48 \
+                        dots_chunked
+run dots_chunk64   2400 python benchmarks/bench_step_variants.py 64 \
+                        dots_chunked
+# XLA tuning probe: raise the scoped-VMEM budget (v5e has 128 MiB
+# physical; the 16 MiB default bounds fusion depth and is what the wide
+# optimizer kernels and resident-8k flash hit)
+run vmem64_b128    2400 env XLA_FLAGS=--xla_tpu_scoped_vmem_limit_kib=65536 \
+                        python benchmarks/bench_step_variants.py 128 pallas
+log "battery5 complete"

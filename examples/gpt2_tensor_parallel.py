@@ -43,7 +43,7 @@ def main():
     from apex_tpu.optimizers import fused_adam
     from apex_tpu.testing import (
         TransformerConfig, gpt_loss, param_specs, sp_grad_sync,
-        transformer_init)
+        stack_layer_params, transformer_init)
     from apex_tpu.testing.commons import smap
 
     devs = jax.devices()
@@ -59,12 +59,20 @@ def main():
             sequence_parallel=tp > 1)
         batch = args.batch or 16
     else:
+        # scan_layers matches the TPU config so the CI smoke exercises the
+        # same stacked-params path (an unstacked smoke hid a TPU-only
+        # stacking bug in round 4)
         cfg = TransformerConfig(
             vocab_size=512, seq_len=64, hidden=64, layers=2, heads=4,
-            causal=True, dtype=jnp.bfloat16, sequence_parallel=tp > 1)
+            causal=True, dtype=jnp.bfloat16, scan_layers=True,
+            sequence_parallel=tp > 1)
         batch = args.batch or 4
 
     params = transformer_init(jax.random.PRNGKey(0), cfg)
+    if cfg.scan_layers:
+        # scan-stacked layout: params["layers"] must be ONE [L, ...] pytree
+        # (param_specs returns the stacked spec when scan_layers is set)
+        params = stack_layer_params(params)
 
     def model_fn(p, tokens):
         return gpt_loss(p, tokens, cfg)

@@ -58,6 +58,8 @@ def main():
     ap.add_argument("--cpu", action="store_true",
                     help="pin the CPU backend BEFORE touching devices (the "
                          "remote-TPU plugin can hang at init)")
+    ap.add_argument("--bench", action="store_true",
+                    help="print the one-line JSON metric row (BASELINE.md)")
     args = ap.parse_args()
 
     if args.cpu:
@@ -108,16 +110,40 @@ def main():
         check_vma=False,
     ))
 
+    if args.bench and args.epochs < 1:
+        ap.error("--bench needs --epochs >= 1")
+    if args.bench:
+        # pay the jit compile OUTSIDE the timed epochs — one warmup step
+        # (a multi-second TPU compile averaged into 20 steps would
+        # understate samples/sec by an order of magnitude)
+        params, state, bn_state, loss = step(params, state, bn_state, x,
+                                             labels)
+        jax.block_until_ready(loss)
+
     steps_per_epoch = 20
+    dt = None
     for epoch in range(args.epochs):
         t0 = time.time()
         for _ in range(steps_per_epoch):
             params, state, bn_state, loss = step(params, state, bn_state, x,
                                                  labels)
         jax.block_until_ready(loss)
+        dt = (time.time() - t0) / steps_per_epoch
         print(f"epoch {epoch}: loss={float(loss):.4f} "
               f"scale={float(state.scaler.scale):.0f} "
               f"({time.time() - t0:.1f}s)")
+
+    if args.bench:
+        import json
+
+        print(json.dumps({
+            "metric": "main_amp_convnet_samples_per_sec",
+            "value": round(args.batch * n / dt, 1), "unit": "samples/sec",
+            "detail": {"opt_level": args.opt_level, "ddp": args.ddp,
+                       "batch": args.batch * n,
+                       "step_ms": round(dt * 1e3, 2),
+                       "loss_last": round(float(loss), 4),
+                       "device": str(jax.devices()[0])}}))
 
 
 if __name__ == "__main__":
