@@ -229,6 +229,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, offset, scale, block_k,
 # grids sustain 12.7 TFLOP/s at s=16384 — so hand 8192 to streaming.
 _STREAM_SEQ = 4096
 
+# Learned-bias gradients use an unfused [Sq, Sk] ds pass regardless of
+# kernel family — a MEMORY bound, independent of the resident/streaming
+# routing above. 8192 is the round-3 boundary (ds tiles stay HBM-feasible
+# at bench head counts); decoupled from _STREAM_SEQ so lowering the
+# routing switch to 4096 did not silently shrink dbias support in the
+# 4097-8192 range that previously worked.
+_DBIAS_SEQ = 8192
+
 try:
     from jax.experimental.pallas import tpu as _pltpu
 except Exception:  # pragma: no cover
@@ -1135,7 +1143,7 @@ def _check_dbias_seq(q, k):
     # But preflight auto-disabling the streaming family must NOT silently
     # reopen the O(sq*sk) pass — that run still fails loudly here rather
     # than as an opaque HBM OOM.
-    if max(q.shape[1], k.shape[1]) <= _STREAM_SEQ:
+    if max(q.shape[1], k.shape[1]) <= _DBIAS_SEQ:
         return
     if _pltpu is None:
         # streaming kernels were never available on this backend: the
@@ -1150,7 +1158,7 @@ def _check_dbias_seq(q, k):
         return
     raise NotImplementedError(
         f"bias gradients at streaming sequence lengths (sq={q.shape[1]}, "
-        f"sk={k.shape[1]} > {_STREAM_SEQ}) would materialize the full "
+        f"sk={k.shape[1]} > {_DBIAS_SEQ}) would materialize the full "
         "score matrix; pass a non-learned bias as `mask` (no gradient), "
         "stop_gradient the bias, or force the resident kernels with "
         "APEX_TPU_FLASH_STREAM=0 if you accept the memory cost (the "

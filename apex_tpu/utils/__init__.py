@@ -7,6 +7,10 @@ from apex_tpu.utils.pytree import (  # noqa: F401
     tree_size,
     tree_zeros_like,
 )
+from apex_tpu.utils.debug import (  # noqa: F401
+    check_numerics,
+    find_nonfinite,
+)
 from apex_tpu.utils.dtypes import (  # noqa: F401
     canonical_half_dtype,
     is_float,

@@ -323,6 +323,7 @@ def main():
     best = None
     for batch, cfg in plan:
         s = cfg.seq_len
+        remat_name = cfg.remat_policy if cfg.remat else "none"
 
         def model_fn(p, tokens, labels, loss_mask, cfg=cfg):
             return bert_loss(p, tokens, labels, loss_mask, cfg)
@@ -367,14 +368,12 @@ def main():
             # would hang behind it — emit what we have and stop
             print(f"bench: batch {batch} hung; truncating sweep",
                   file=sys.stderr)
-            sweep.append({"batch": batch,
-                          "remat": cfg.remat_policy if cfg.remat else "none",
+            sweep.append({"batch": batch, "remat": remat_name,
                           "error": "compile/measure hung"})
             _emit_partial_and_exit(f"sweep truncated: batch {batch} hung")
         if err is not None:  # e.g. OOM at large batch
             print(f"bench: batch {batch} failed: {err}", file=sys.stderr)
-            sweep.append({"batch": batch,
-                          "remat": cfg.remat_policy if cfg.remat else "none",
+            sweep.append({"batch": batch, "remat": remat_name,
                           "error": str(err).splitlines()[0][:200]})
             continue
         compile_s, dt, xla_flops = result
@@ -392,7 +391,7 @@ def main():
         row["seq"] = s
         row["device"] = str(dev)
         row["config"] = "toy-cpu" if on_cpu else "bert-large"
-        row["remat"] = cfg.remat_policy if cfg.remat else "none"
+        row["remat"] = remat_name
         sweep.append(row)
         if best is None or row["samples_per_sec"] > best["samples_per_sec"]:
             best = row

@@ -294,10 +294,10 @@ def test_dbias_guard_raises_even_when_stream_disabled(monkeypatch):
     import pytest as _pytest
 
     from apex_tpu.ops import _utils
-    from apex_tpu.ops.attention import _STREAM_SEQ, _check_dbias_seq
+    from apex_tpu.ops.attention import _DBIAS_SEQ, _check_dbias_seq
 
     short = jnp.zeros((1, 512, 64))
-    long = jnp.zeros((1, _STREAM_SEQ * 2, 64))
+    long = jnp.zeros((1, _DBIAS_SEQ * 2, 64))
     monkeypatch.delenv("APEX_TPU_FLASH_STREAM", raising=False)
 
     _check_dbias_seq(short, short)                    # resident length: fine
@@ -313,13 +313,27 @@ def test_dbias_guard_raises_even_when_stream_disabled(monkeypatch):
     _check_dbias_seq(long, long)
 
 
+def test_dbias_threshold_decoupled_from_stream_switch(monkeypatch):
+    """Lowering the resident->streaming routing switch (_STREAM_SEQ 8192
+    -> 4096, v5e measurement) must NOT shrink dbias support: learned-bias
+    gradients in the 4097..8192 range worked before the routing change
+    and must keep working (round-4 review finding)."""
+    from apex_tpu.ops.attention import (
+        _DBIAS_SEQ, _STREAM_SEQ, _check_dbias_seq)
+
+    assert _DBIAS_SEQ >= 8192 > _STREAM_SEQ
+    monkeypatch.delenv("APEX_TPU_FLASH_STREAM", raising=False)
+    mid = jnp.zeros((1, 6144, 64))   # streams by routing, dbias still OK
+    _check_dbias_seq(mid, mid)
+
+
 def test_dbias_guard_honors_any_forced_resident_value(monkeypatch):
     """_use_streaming treats any env value other than "1" as forced
     resident; the guard must use the same parse (a user who set
     APEX_TPU_FLASH_STREAM=off already owns the memory cost)."""
-    from apex_tpu.ops.attention import _STREAM_SEQ, _check_dbias_seq
+    from apex_tpu.ops.attention import _DBIAS_SEQ, _check_dbias_seq
 
-    long = jnp.zeros((1, _STREAM_SEQ * 2, 64))
+    long = jnp.zeros((1, _DBIAS_SEQ * 2, 64))
     monkeypatch.setenv("APEX_TPU_FLASH_STREAM", "off")
     _check_dbias_seq(long, long)
     monkeypatch.setenv("APEX_TPU_FLASH_STREAM", "1")
@@ -630,3 +644,29 @@ def test_gqa_shape_validation():
     k2 = v2 = jnp.zeros((2, 2, 32, 64))
     with pytest.raises(NotImplementedError, match="grouped-query"):
         flash_attention_with_lse(q[:, :4], k2, v2)
+
+
+def test_block_size_and_family_routing(monkeypatch):
+    """Pin the measured v5e routing defaults (BASELINE.md 2026-07-31):
+    resident family to 4096 (512-block <= 2048, 256 above), streaming
+    family above 4096 at 512-block; env override wins and is clamped."""
+    from apex_tpu.ops import attention as A
+
+    monkeypatch.delenv("APEX_TPU_FLASH_BLOCK", raising=False)
+    monkeypatch.delenv("APEX_TPU_FLASH_STREAM", raising=False)
+    assert A._block_size(512) == 512
+    assert A._block_size(2048) == 512
+    assert A._block_size(4096) == 256          # resident above 2048
+    assert A._block_size(16384, streaming=True) == 512
+    assert A._block_size(256, streaming=True) == 256  # clamp to padded seq
+    if A._pltpu is not None:
+        assert A._use_streaming(4096, 4096) is False
+        assert A._use_streaming(4097, 4097) is True
+        assert A._use_streaming(6144, 6144) is True
+
+    monkeypatch.setenv("APEX_TPU_FLASH_BLOCK", "300")
+    with pytest.raises(ValueError, match="multiple of 128"):
+        A._block_size(512)
+    monkeypatch.setenv("APEX_TPU_FLASH_BLOCK", "256")
+    assert A._block_size(512) == 256
+    assert A._block_size(16384, streaming=True) == 256  # override beats family

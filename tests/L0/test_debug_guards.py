@@ -1,0 +1,44 @@
+"""jit-safe NaN guards (utils.debug). Ref: SURVEY §6 sanitizer row —
+"jax.debug-based NaN guards" alongside the DDP ordering invariant tests."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.utils import check_numerics, find_nonfinite
+
+
+def test_check_numerics_passthrough_and_report(capfd):
+    tree = {"w": jnp.ones((4,)), "b": jnp.array([1.0, jnp.nan, jnp.inf]),
+            "i": jnp.arange(3)}  # int leaf must be ignored
+
+    @jax.jit
+    def f(t):
+        t = check_numerics(t, "state")
+        return jax.tree.map(lambda x: x * 1 if x.dtype == jnp.int32 else x * 2.0, t)
+
+    out = f(tree)
+    jax.block_until_ready(out)
+    err = capfd.readouterr().err
+    assert "check_numerics[state]" in err
+    assert "['b'] has 2/3 non-finite" in err
+    assert "['w']" not in err  # finite leaves stay silent
+    assert float(out["w"][0]) == 2.0  # identity semantics preserved
+
+
+def test_check_numerics_abort_raises():
+    @jax.jit
+    def f(x):
+        return check_numerics(x, "grads", abort=True) * 2.0
+
+    with pytest.raises(Exception, match="non-finite"):
+        jax.block_until_ready(f(jnp.array([jnp.nan])))
+
+
+def test_find_nonfinite_eager():
+    tree = {"a": jnp.zeros((2,)), "b": {"c": jnp.array([jnp.inf, 0.0])},
+            "n": jnp.arange(2)}
+    bad = find_nonfinite(tree)
+    assert list(bad) == ["['b']['c']"]
+    assert bad["['b']['c']"] == 1
+    assert find_nonfinite({"a": jnp.zeros(3)}) == {}
