@@ -1160,11 +1160,11 @@ def _check_dbias_seq(q, k):
         f"bias gradients at streaming sequence lengths (sq={q.shape[1]}, "
         f"sk={k.shape[1]} > {_DBIAS_SEQ}) would materialize the full "
         "score matrix; pass a non-learned bias as `mask` (no gradient), "
-        "stop_gradient the bias, or force the resident kernels with "
-        "APEX_TPU_FLASH_STREAM=0 if you accept the memory cost (the "
-        "resident family compiled to seq 4096 and failed scoped-VMEM at "
-        "8192 in v5e measurements — in between, forcing it may work for "
-        "your geometry)"
+        "or stop_gradient the bias; chunk/shard the sequence (context "
+        "parallelism) if the bias must stay learned at this length "
+        "(APEX_TPU_FLASH_STREAM=0 exists but the resident family itself "
+        "failed scoped-VMEM compile at 8192 in v5e measurements, so "
+        "forcing it above that is unlikely to help)"
     )
 
 

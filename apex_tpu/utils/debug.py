@@ -26,6 +26,8 @@ import sys
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.utils.dtypes import is_float
+
 __all__ = ["check_numerics", "find_nonfinite"]
 
 
@@ -51,7 +53,7 @@ def check_numerics(tree, label: str = "tree", *, abort: bool = False):
         print(msg, file=sys.stderr, flush=True)
 
     def guard(path, leaf):
-        if not jnp.issubdtype(jnp.result_type(leaf), jnp.floating):
+        if not is_float(leaf):
             return leaf
         x = jnp.asarray(leaf)
         # isfinite natively supports every float dtype — no f32 cast (a
@@ -73,7 +75,7 @@ def find_nonfinite(tree) -> dict:
     floating leaf that has any. Call OUTSIDE jit on concrete arrays."""
     out = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        if not jnp.issubdtype(jnp.result_type(leaf), jnp.floating):
+        if not is_float(leaf):
             continue
         n = int(jnp.sum(~jnp.isfinite(jnp.asarray(leaf))))
         if n:

@@ -14,7 +14,7 @@ export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}"
 log() { echo "[battery3 $(date -u +%H:%M:%S)] $*" | tee -a "$LOGDIR/battery.log"; }
 
 probe_ok() {
-  timeout 90 python -c "
+  timeout -k 10 90 python -c "
 import jax
 d = jax.devices()
 assert d and d[0].platform == 'tpu', d
@@ -30,11 +30,14 @@ wait_tunnel() {  # poll up to ~2 h
   return 1
 }
 
-run() {  # run <name> <timeout_s> <cmd...> — probe-gated
+run() {  # run <name> <timeout_s> <cmd...> — probe-gated, abort-on-dead
   local name="$1" t="$2"; shift 2
-  if ! wait_tunnel; then log "SKIP $name (tunnel never answered)"; return; fi
+  if ! wait_tunnel; then
+    log "ABORT battery: tunnel never answered before $name"
+    exit 1
+  fi
   log "START $name: $*"
-  ( timeout "$t" "$@" ) > "$LOGDIR/$name.log" 2>&1
+  ( timeout -k 10 "$t" "$@" ) > "$LOGDIR/$name.log" 2>&1
   local rc=$?
   log "END   $name rc=$rc (tail: $(tail -1 "$LOGDIR/$name.log" 2>/dev/null | cut -c1-120))"
 }

@@ -59,4 +59,17 @@ run dots_chunk64   2400 python benchmarks/bench_step_variants.py 64 \
 # optimizer kernels and resident-8k flash hit)
 run vmem64_b128    2400 env XLA_FLAGS=--xla_tpu_scoped_vmem_limit_kib=65536 \
                         python benchmarks/bench_step_variants.py 128 pallas
+# streaming block curve: 512 beat 256 by 2.1-2.2x; probe the next rung
+run lc16k_b1024    1800 env APEX_TPU_FLASH_BLOCK=1024 python benchmarks/bench_long_context.py 16384
+# items inherited from battery4 in case its tunnel-wedge abort killed them
+run components3    2400 python benchmarks/bench_components.py
+run lc8192b        1800 python benchmarks/bench_long_context.py 8192
+run lc2048_b256b   1800 env APEX_TPU_FLASH_BLOCK=256 python benchmarks/bench_long_context.py 2048
+run lc2048_b128b   1800 env APEX_TPU_FLASH_BLOCK=128 python benchmarks/bench_long_context.py 2048
+run ex_gpt2tp3     2400 python examples/gpt2_tensor_parallel.py --bench
+run ex_main_amp3   1200 python examples/main_amp.py --bench
+run ex_moe3        2400 python examples/gpt_moe_ep.py --bench
+run tpu_lamb2      1800 env APEX_TPU_HW=1 python -m pytest \
+                        tests/tpu/test_kernels_compiled.py \
+                        -k "lamb_phase1 or adam_flat or l2norm" -v
 log "battery5 complete"

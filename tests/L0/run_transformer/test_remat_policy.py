@@ -236,3 +236,44 @@ def test_flash_policy_composes_with_fused_attn_dropout():
     for a, b in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_flash)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_dots_flash_policy_numerics_and_structure():
+    """remat_policy="dots_flash" (matmul outputs + flash o/lse): numerics
+    identical to full remat, and the backward recompute drops BOTH the
+    attention replay (fewer exp than "dots") and the matmul replay (fewer
+    dot_general than "full") — the policy union actually composes."""
+    params = transformer_init(jax.random.PRNGKey(0), TransformerConfig(**CFG))
+    tokens = _tokens()
+    loss_full, g_full = _grad_fn(
+        TransformerConfig(**CFG, remat=True, remat_policy="full")
+    )(params, tokens)
+    loss_df, g_df = _grad_fn(
+        TransformerConfig(**CFG, remat=True, remat_policy="dots_flash")
+    )(params, tokens)
+    np.testing.assert_allclose(float(loss_df), float(loss_full), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_df)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    def count_ops(policy):
+        cfg = TransformerConfig(**CFG, remat=True, remat_policy=policy,
+                                scan_layers=True)
+        mesh = cpu_mesh({"model": 2})
+        specs = param_specs(cfg)
+        from apex_tpu.testing import stack_layer_params
+
+        stacked = stack_layer_params(params)
+        fn = smap(
+            lambda p, t: jax.grad(lambda q: gpt_loss(q, t, cfg))(p),
+            mesh, (specs, P()), specs,
+        )
+        txt = str(jax.make_jaxpr(fn)(stacked, tokens))
+        return txt.count(" exp "), txt.count("dot_general")
+
+    exp_full, dot_full = count_ops("full")
+    exp_dots, dot_dots = count_ops("dots")
+    exp_df, dot_df = count_ops("dots_flash")
+    assert exp_df < exp_dots, (exp_df, exp_dots)   # attention replay gone
+    assert dot_df < dot_full, (dot_df, dot_full)   # matmul replay gone
+    assert dot_df <= dot_dots, (dot_df, dot_dots)
