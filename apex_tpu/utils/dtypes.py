@@ -33,4 +33,6 @@ def canonical_half_dtype(dtype_or_name):
 
 
 def is_float(x) -> bool:
-    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+    # result_type is pure dtype metadata — jnp.asarray(x) would materialize
+    # (and device-transfer) the value just to read its dtype
+    return jnp.issubdtype(jnp.result_type(x), jnp.floating)

@@ -10,9 +10,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-
-def is_float(x) -> bool:
-    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+from apex_tpu.utils.dtypes import is_float  # noqa: F401  (re-exported)
 
 
 def tree_cast(tree, dtype):

@@ -25,6 +25,51 @@ import jax
 from jax import lax
 
 
+def iters_for(traffic_bytes, smoke_iters=None):
+    """Roofline-scaled iteration count so the two-point slope below
+    accumulates ~0.5 s of device work per leg delta. A flat iters=16
+    (2026-07-31 run) left small rows dispatch-bound: ~tens of ms of work
+    never cleared the remote tunnel's jitter on its ~65 ms floor.
+
+    ``smoke_iters``: pass a small constant to short-circuit scaling on
+    CPU / smoke runs, where the roofline model is meaningless and 8192
+    iterations of a CPU op would take minutes.
+    """
+    if smoke_iters is not None:
+        return smoke_iters
+    est = traffic_bytes / 8.1e11  # v5e HBM ~810 GB/s
+    return max(32, min(8192, int(0.5 / est)))
+
+
+def _warm_with_retry(f, x0, attempts=3):
+    """The remote-compile tunnel intermittently drops mid-transfer
+    (``INTERNAL: .../remote_compile: read body: response body closed``,
+    observed 2026-07-31 killing a whole battery item on its first
+    kernel). The failure is transport-level and transient — the same
+    compile succeeds seconds later — so retry the compile+warm call a
+    few times before letting the bench die."""
+    transient = ("read body", "response body", "connection reset",
+                 "broken pipe", "socket closed")
+    for attempt in range(attempts):
+        try:
+            return jax.block_until_ready(f(x0))
+        except jax.errors.JaxRuntimeError as e:
+            # Only transport-level drops are worth retrying; deterministic
+            # failures (VMEM/HBM OOM, HTTP 500 tpu_compile_helper) would
+            # just recompile twice and die identically 40 s later.
+            msg = str(e).lower()
+            if not any(t in msg for t in transient):
+                raise
+            if attempt == attempts - 1:
+                raise
+            import sys
+
+            print(f"_timing: transient runtime error on warm "
+                  f"(attempt {attempt + 1}/{attempts}); retrying in 20s",
+                  file=sys.stderr, flush=True)
+            time.sleep(20)
+
+
 def dev_time(step, x0, iters=32, reps=3):
     """Mean seconds per application of ``step`` (x -> same-shape x).
 
@@ -44,7 +89,7 @@ def dev_time(step, x0, iters=32, reps=3):
 
     def timed(n):
         f = jax.jit(lambda x: lax.scan(body, x, None, length=n)[0])
-        jax.block_until_ready(f(x0))  # compile + warm
+        _warm_with_retry(f, x0)  # compile + warm
         best = float("inf")
         for _ in range(reps):
             t0 = time.perf_counter()

@@ -21,7 +21,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
-from benchmarks._timing import dev_time
+from benchmarks._timing import dev_time, iters_for
 
 
 def timeit(fn, *args, iters=10, warmup=2):
@@ -45,6 +45,12 @@ def main():
     dt = jnp.bfloat16
     dev = jax.devices()[0]
     print(f"device: {dev}", flush=True)
+    smoke = 4 if os.environ.get("BENCH_COMP_SMALL") == "1" else None
+
+    def flop_iters(flops):
+        # iters_for thinks in HBM bytes; convert an MXU-bound estimate
+        # (v5e ~197 TFLOP/s bf16) into equivalent-traffic bytes
+        return iters_for(int(flops / 1.97e14 * 8.1e11), smoke_iters=smoke)
 
     # ---- flash attention pallas vs jnp ----
     from apex_tpu.ops.attention import flash_attention
@@ -56,12 +62,12 @@ def main():
 
     for use in (True, False):
         # chain q through the kernel output (same shape); k, v ride as consts
+        # fwd attention matmul FLOPs: 2 matmuls x 2*S*S*D MACs per (B,NH)
+        fl = 2 * 2 * B * NH * S * S * D
         ms = dev_time(
             lambda q, use=use: flash_attention(q, k, v, causal=False,
                                                use_pallas=use),
-            q, iters=8) * 1e3
-        # fwd attention matmul FLOPs: 2 matmuls x 2*S*S*D MACs per (B,NH)
-        fl = 2 * 2 * B * NH * S * S * D
+            q, iters=flop_iters(fl)) * 1e3
         print(f"flash fwd   pallas={use}: {ms:8.2f} ms  {fl/ms/1e9:7.1f} GFLOP/s",
               flush=True)
 
@@ -73,10 +79,10 @@ def main():
         # sum all three grads into the q-shaped carry so none of dk/dv can
         # be dead-coded out of the jnp path (3 extra elementwise adds ~1%
         # of attention compute at these shapes)
+        fl = 3 * 2 * 2 * B * NH * S * S * D
         ms = dev_time(
             lambda q, g=g: (lambda t: t[0] + t[1] + t[2])(g(q, k, v)),
-            q, iters=8) * 1e3
-        fl = 3 * 2 * 2 * B * NH * S * S * D
+            q, iters=flop_iters(fl)) * 1e3
         print(f"flash f+b   pallas={use}: {ms:8.2f} ms  {fl/ms/1e9:7.1f} GFLOP/s",
               flush=True)
 
@@ -90,7 +96,8 @@ def main():
     for use in (True, False):
         ms = dev_time(
             lambda x, use=use: layer_norm_affine(x, gm, bt, 1e-5, use),
-            x, iters=16) * 1e3
+            x, iters=iters_for(2 * x.size * x.dtype.itemsize,
+                               smoke_iters=smoke)) * 1e3
         gb = 2 * x.size * x.dtype.itemsize / 1e9
         print(f"LN fwd      pallas={use}: {ms:8.2f} ms  {gb/ms*1e3:7.1f} GB/s",
               flush=True)
@@ -99,7 +106,9 @@ def main():
             return jnp.vdot(layer_norm_affine(x, gm, bt, 1e-5, use).astype(jnp.float32),
                             dy.astype(jnp.float32))
 
-        ms = dev_time(jax.grad(loss), x, iters=16) * 1e3
+        ms = dev_time(jax.grad(loss), x,
+                      iters=iters_for(4 * x.size * x.dtype.itemsize,
+                                      smoke_iters=smoke)) * 1e3
         gb = 4 * x.size * x.dtype.itemsize / 1e9
         print(f"LN f+b      pallas={use}: {ms:8.2f} ms  {gb/ms*1e3:7.1f} GB/s",
               flush=True)

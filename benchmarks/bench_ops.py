@@ -27,7 +27,7 @@ import jax.numpy as jnp
 if os.environ.get("BENCH_CPU") == "1":
     jax.config.update("jax_platforms", "cpu")
 
-from benchmarks._timing import dev_time
+from benchmarks._timing import dev_time, iters_for as _iters_for
 
 
 def row(name, sec, traffic_bytes):
@@ -45,7 +45,15 @@ def main():
     B, H, S = 16, 16, 512  # BERT-large attention shapes
     if os.environ.get("BENCH_OPS_SMALL") == "1":  # CPU smoke of the harness
         B, H, S = 2, 2, 64
-    iters = int(os.environ.get("BENCH_OPS_ITERS", "16"))
+    env_iters = os.environ.get("BENCH_OPS_ITERS")
+    # smoke/CPU runs must not get roofline-scaled counts (hour-class on CPU)
+    smoke = 16 if (os.environ.get("BENCH_OPS_SMALL") == "1"
+                   or os.environ.get("BENCH_CPU") == "1") else None
+
+    def iters_for(traffic_bytes):
+        if env_iters is not None:
+            return int(env_iters)
+        return _iters_for(traffic_bytes, smoke_iters=smoke)
 
     # ---- fused softmax family (fwd and grad) ----
     # chain: softmax output is same-shape and stays finite under iteration
@@ -53,27 +61,29 @@ def main():
     mask = jax.random.uniform(jax.random.PRNGKey(1), (B, 1, S, S)) < 0.1
     nbytes = x.size * 2
 
-    sec = dev_time(lambda x: scaled_masked_softmax(x, mask, 1.0), x, iters)
+    sec = dev_time(lambda x: scaled_masked_softmax(x, mask, 1.0), x,
+                   iters_for(2 * nbytes))
     row("scaled_masked_softmax fwd", sec, 2 * nbytes)
 
     g = jax.grad(lambda x: jnp.sum(
         scaled_masked_softmax(x, mask, 1.0).astype(jnp.float32) ** 2))
-    sec = dev_time(g, x, iters)
+    sec = dev_time(g, x, iters_for(4 * nbytes))
     row("scaled_masked_softmax f+b", sec, 4 * nbytes)
 
     xt = jax.random.normal(jax.random.PRNGKey(2), (B * H, S, S), jnp.bfloat16)
     sec = dev_time(lambda x: scaled_upper_triang_masked_softmax(x, 1.0),
-                   xt, iters)
+                   xt, iters_for(2 * xt.size * 2))
     row("upper_triang_softmax fwd", sec, 2 * xt.size * 2)
 
     # ---- RoPE ----
     cos, sin = rope_frequencies(64, S)
     q = jax.random.normal(jax.random.PRNGKey(3), (B, H, S, 64), jnp.bfloat16)
-    sec = dev_time(lambda q: apply_rope(q, cos, sin), q, iters)
+    sec = dev_time(lambda q: apply_rope(q, cos, sin), q,
+                   iters_for(2 * q.size * 2))
     row("rope fwd", sec, 2 * q.size * 2)
     g = jax.grad(lambda q: jnp.sum(
         apply_rope(q, cos, sin).astype(jnp.float32) ** 2))
-    sec = dev_time(g, q, iters)
+    sec = dev_time(g, q, iters_for(4 * q.size * 2))
     row("rope f+b", sec, 4 * q.size * 2)
 
     # ---- vocab cross-entropy (BERT-large head shape) ----
@@ -84,7 +94,7 @@ def main():
     labels = jax.random.randint(jax.random.PRNGKey(5), (B * S,), 0, 30528)
     g = jax.grad(lambda lg: jnp.mean(softmax_cross_entropy(lg, labels, 0.1)))
     # recompute-bwd reads logits twice, writes dlogits once
-    sec = dev_time(g, logits, iters)
+    sec = dev_time(g, logits, iters_for(3 * logits.size * 2))
     row("xentropy f+b", sec, 3 * logits.size * 2)
 
 

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 if os.environ.get("BENCH_CPU") == "1":
     jax.config.update("jax_platforms", "cpu")
 
-from benchmarks._timing import dev_time
+from benchmarks._timing import dev_time, iters_for as _iters_for
 
 
 def main():
@@ -40,10 +40,12 @@ def main():
     dev = jax.devices()[0]
     print(f"device: {dev} ({dev.device_kind})", file=sys.stderr)
     sizes = [2**20, 2**24, 42_553_344]  # 1M, 16M, BERT-large/8 fp32
-    iters = 16
-    if os.environ.get("BENCH_CPU") == "1":
+    on_cpu = os.environ.get("BENCH_CPU") == "1"
+    if on_cpu:
         sizes = [2**16, 2**18]
-        iters = 2
+
+    def iters_for(traffic_bytes):
+        return _iters_for(traffic_bytes, smoke_iters=2 if on_cpu else None)
 
     kw = dict(lr=1e-3, beta1=0.9, beta2=0.95, eps=1e-8, step=7,
               bias_correction=True, weight_decay=0.01)
@@ -76,10 +78,12 @@ def main():
         def pallas_l2(x):
             return x + PK.l2norm_flat(x) * 1e-30
 
-        t_aj = dev_time(jit_adam, (p, m, v), iters)
-        t_ap = dev_time(pallas_adam, (p, m, v), iters)
-        t_lj = dev_time(jit_l2, g, iters)
-        t_lp = dev_time(pallas_l2, g, iters)
+        adam_iters = iters_for(7 * n * 4)  # 4 reads + 3 writes, fp32
+        l2_iters = iters_for(2 * n * 4)    # read + write
+        t_aj = dev_time(jit_adam, (p, m, v), adam_iters)
+        t_ap = dev_time(pallas_adam, (p, m, v), adam_iters)
+        t_lj = dev_time(jit_l2, g, l2_iters)
+        t_lp = dev_time(pallas_l2, g, l2_iters)
         print(f"{n:>12} {t_aj*1e3:>12.3f} {t_ap*1e3:>15.3f} "
               f"{t_lj*1e3:>10.3f} {t_lp*1e3:>13.3f}", flush=True)
 

@@ -36,6 +36,23 @@ def _tokens(b=8, s=16, seed=0):
     return jax.random.randint(jax.random.PRNGKey(seed), (b, s), 0, 96)
 
 
+def _count_grad_ops(policy, params, tokens, scan_layers=False):
+    """(exp, dot_general) counts in the grad jaxpr — the shared
+    backward-recompute structure probe. ``params`` must be stacked when
+    ``scan_layers=True``. The " exp " substring match is the fragile bit;
+    it lives only here."""
+    cfg = TransformerConfig(**CFG, remat=True, remat_policy=policy,
+                            scan_layers=scan_layers)
+    mesh = cpu_mesh({"model": 2})
+    specs = param_specs(cfg)
+    fn = smap(
+        lambda p, t: jax.grad(lambda q: gpt_loss(q, t, cfg))(p),
+        mesh, (specs, P()), specs,
+    )
+    txt = str(jax.make_jaxpr(fn)(params, tokens))
+    return txt.count(" exp "), txt.count("dot_general")
+
+
 def _grad_fn(cfg, tp=2):
     mesh = cpu_mesh({"model": tp})
     specs = param_specs(cfg)
@@ -71,19 +88,8 @@ def test_flash_policy_skips_attention_forward_recompute():
     params = transformer_init(jax.random.PRNGKey(0), TransformerConfig(**CFG))
     tokens = _tokens()
 
-    def count_ops(policy):
-        cfg = TransformerConfig(**CFG, remat=True, remat_policy=policy)
-        mesh = cpu_mesh({"model": 2})
-        specs = param_specs(cfg)
-        fn = smap(
-            lambda p, t: jax.grad(lambda q: gpt_loss(q, t, cfg))(p),
-            mesh, (specs, P()), specs,
-        )
-        txt = str(jax.make_jaxpr(fn)(params, tokens))
-        return txt.count(" exp "), txt.count("dot_general")
-
-    exp_full, dot_full = count_ops("full")
-    exp_flash, dot_flash = count_ops("flash")
+    exp_full, dot_full = _count_grad_ops("full", params, tokens)
+    exp_flash, dot_flash = _count_grad_ops("flash", params, tokens)
     assert exp_flash < exp_full, (exp_flash, exp_full)
     assert dot_flash < dot_full, (dot_flash, dot_full)
 
@@ -150,20 +156,10 @@ def test_flash_policy_effective_under_scan_layers():
         transformer_init(jax.random.PRNGKey(0), TransformerConfig(**CFG)))
     tokens = _tokens()
 
-    def count_ops(policy):
-        cfg = TransformerConfig(**CFG, remat=True, remat_policy=policy,
-                                scan_layers=True)
-        mesh = cpu_mesh({"model": 2})
-        specs = param_specs(cfg)
-        fn = smap(
-            lambda p, t: jax.grad(lambda q: gpt_loss(q, t, cfg))(p),
-            mesh, (specs, P()), specs,
-        )
-        txt = str(jax.make_jaxpr(fn)(params, tokens))
-        return txt.count(" exp "), txt.count("dot_general")
-
-    exp_full, dot_full = count_ops("full")
-    exp_flash, dot_flash = count_ops("flash")
+    exp_full, dot_full = _count_grad_ops("full", params, tokens,
+                                         scan_layers=True)
+    exp_flash, dot_flash = _count_grad_ops("flash", params, tokens,
+                                           scan_layers=True)
     assert exp_flash < exp_full, (exp_flash, exp_full)
     assert dot_flash < dot_full, (dot_flash, dot_full)
 
@@ -256,24 +252,15 @@ def test_dots_flash_policy_numerics_and_structure():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
 
-    def count_ops(policy):
-        cfg = TransformerConfig(**CFG, remat=True, remat_policy=policy,
-                                scan_layers=True)
-        mesh = cpu_mesh({"model": 2})
-        specs = param_specs(cfg)
-        from apex_tpu.testing import stack_layer_params
+    from apex_tpu.testing import stack_layer_params
 
-        stacked = stack_layer_params(params)
-        fn = smap(
-            lambda p, t: jax.grad(lambda q: gpt_loss(q, t, cfg))(p),
-            mesh, (specs, P()), specs,
-        )
-        txt = str(jax.make_jaxpr(fn)(stacked, tokens))
-        return txt.count(" exp "), txt.count("dot_general")
-
-    exp_full, dot_full = count_ops("full")
-    exp_dots, dot_dots = count_ops("dots")
-    exp_df, dot_df = count_ops("dots_flash")
+    stacked = stack_layer_params(params)
+    exp_full, dot_full = _count_grad_ops("full", stacked, tokens,
+                                         scan_layers=True)
+    exp_dots, dot_dots = _count_grad_ops("dots", stacked, tokens,
+                                         scan_layers=True)
+    exp_df, dot_df = _count_grad_ops("dots_flash", stacked, tokens,
+                                     scan_layers=True)
     assert exp_df < exp_dots, (exp_df, exp_dots)   # attention replay gone
     assert dot_df < dot_full, (dot_df, dot_full)   # matmul replay gone
     assert dot_df <= dot_dots, (dot_df, dot_dots)
