@@ -1,0 +1,81 @@
+#!/bin/bash
+# Round-4 phase-4 battery: pick up whatever the tunnel outage (began
+# ~04:05 2026-07-31, mid-battery5) killed. Differences from battery5:
+#  - the FIRST gate waits up to ~6 h (the 07-30 outage lasted hours);
+#    per-item gates stay at ~40 min with abort, as before.
+#  - each item is SKIPPED if a battery5 log already shows it succeeded,
+#    so re-running after a partial battery5 never duplicates work.
+#  - optim kernels / ops / components now use the roofline-scaled
+#    two-point timing (benchmarks/_timing.py::iters_for) + transient
+#    remote_compile retry, so their rows should finally be
+#    decision-grade instead of dispatch-floor artifacts.
+set -u
+cd "$(dirname "$0")/.."
+LOGDIR="${1:-benchmarks/logs_r4g}"
+PREV="${2:-benchmarks/logs_r4f}"
+mkdir -p "$LOGDIR"
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}"
+
+log() { echo "[battery6 $(date -u +%H:%M:%S)] $*" | tee -a "$LOGDIR/battery.log"; }
+
+probe_ok() {
+  timeout -k 10 90 python -c "
+import jax
+d = jax.devices()
+assert d and d[0].platform == 'tpu', d
+" > /dev/null 2>&1
+}
+
+wait_tunnel() {  # arg: max polls (120 s apart)
+  local polls="${1:-20}"
+  for i in $(seq 1 "$polls"); do
+    if probe_ok; then return 0; fi
+    log "tunnel probe $i/$polls failed; sleeping 120s"
+    sleep 120
+  done
+  return 1
+}
+
+# run <name> <prev_success_pattern> <timeout_s> <cmd...>
+# Skips when a battery5 log for the same work already contains the
+# success pattern; otherwise probe-gates and runs.
+run() {
+  local name="$1" pat="$2" t="$3"; shift 3
+  if [ -n "$pat" ] && grep -lq "$pat" "$PREV"/*.log 2>/dev/null; then
+    log "SKIP  $name: battery5 already measured it ($pat)"
+    return 0
+  fi
+  if ! wait_tunnel 20; then
+    log "ABORT battery: tunnel never answered before $name"
+    exit 1
+  fi
+  log "START $name: $*"
+  ( timeout -k 10 "$t" "$@" ) > "$LOGDIR/$name.log" 2>&1
+  local rc=$?
+  log "END   $name rc=$rc (tail: $(tail -1 "$LOGDIR/$name.log" 2>/dev/null | cut -c1-120))"
+}
+
+log "waiting for tunnel (outage gate: up to ~6 h)"
+if ! wait_tunnel 180; then
+  log "ABORT battery: tunnel never returned"
+  exit 1
+fi
+log "tunnel is back"
+
+# decision-grade kernel tables (battery5's run died on the transient)
+run optim_kernels3 "# adam @ n=" 2400 python benchmarks/bench_optim_kernels.py
+run ops_gbps4      ""         2400 python benchmarks/bench_ops.py
+run components4    "model remat=False" 3000 python benchmarks/bench_components.py
+# long-context follow-ups battery5 didn't reach
+run lc8192c        "s=  8192 .*ms"  1800 python benchmarks/bench_long_context.py 8192
+run lc2048_b256c   ""         1800 env APEX_TPU_FLASH_BLOCK=256 python benchmarks/bench_long_context.py 2048
+run lc2048_b128c   ""         1800 env APEX_TPU_FLASH_BLOCK=128 python benchmarks/bench_long_context.py 2048
+# example rows (BASELINE configs 4 + MoE + the L1 cross-product analog)
+run ex_gpt2tp4     "steps/sec" 2400 python examples/gpt2_tensor_parallel.py --bench
+run ex_main_amp4   ""          1200 python examples/main_amp.py --bench
+run ex_moe4        ""          2400 python examples/gpt_moe_ep.py --bench
+# the retuned LAMB tolerance + flat-kernel compiled tier
+run tpu_lamb3      "" 1800 env APEX_TPU_HW=1 python -m pytest \
+                       tests/tpu/test_kernels_compiled.py \
+                       -k "lamb_phase1 or adam_flat or l2norm" -v
+log "battery6 complete"
