@@ -3,6 +3,10 @@
 
 from apex_tpu.parallel import collectives, mesh  # noqa: F401
 from apex_tpu.parallel.ddp import DistributedDataParallel  # noqa: F401
+from apex_tpu.parallel.grad_accum import (  # noqa: F401
+    accumulate_gradients,
+    split_microbatches,
+)
 # the reference exposes LARC under apex.parallel as well as its module
 from apex_tpu.optimizers.larc import LARC  # noqa: F401
 from apex_tpu.parallel.sync_batchnorm import sync_batch_stats  # noqa: F401

@@ -1,0 +1,87 @@
+"""Gradient accumulation over microbatches with fp32 accumulation.
+
+The reference exposes this capability twice: DistributedDataParallel's
+``delay_allreduce`` lets users run several backwards before the bucketed
+allreduce fires (apex/parallel/distributed.py::DistributedDataParallel),
+and the Megatron path accumulates weight gradients into an fp32
+``main_grad`` buffer across microbatches
+(csrc/megatron/fused_weight_gradient_dense.cpp, SURVEY §3.13 #7; the
+pipeline schedules drive one backward per microbatch). The TPU analog is
+a ``lax.scan`` over microbatches whose carry is the fp32 grad
+accumulator — one compiled program, no per-microbatch dispatch.
+
+Why it is a *performance* feature here and not just a memory one: the
+activation-memory footprint is set by the MICRO batch, so a remat policy
+that only fits at small batch (measured on v5e: ``dots`` fits BERT-large
+only at b <= 32, where it beats full remat — BASELINE.md remat ladder)
+can be combined with a large effective batch. b128 as 4 x b32(dots)
+executes ~1/3 fewer matmul FLOPs than b128 full remat (no forward
+replay in the backward), trading them for one fp32 accumulator
+(params-sized, ~1.3 GB at BERT-large) and a few grad-add passes.
+
+Loss-scaling composition: scaling is linear, so accumulating SCALED
+grads and unscaling the mean once (``amp.apply_gradients``) is exact;
+any microbatch overflow survives into the mean and still trips the
+scaler's found_inf check.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def split_microbatches(batch, n_micro: int):
+    """Reshape every leaf's leading dim ``B`` to ``[n_micro, B/n_micro]``.
+
+    Raises if any leaf's leading dim is not divisible — silent padding
+    would change the loss mean.
+    """
+    def _split(x):
+        x = jnp.asarray(x)
+        if x.shape[0] % n_micro:
+            raise ValueError(
+                f"leading dim {x.shape[0]} not divisible by "
+                f"n_micro={n_micro}")
+        return x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])
+
+    return jax.tree.map(_split, batch)
+
+
+def accumulate_gradients(loss_fn, params, batch, n_micro: int,
+                         accum_dtype=jnp.float32):
+    """Mean loss and mean gradients of ``loss_fn`` over ``n_micro``
+    microbatches, accumulated in ``accum_dtype``.
+
+    ``loss_fn(params, microbatch) -> scalar`` where ``microbatch`` has
+    the same pytree structure as ``batch`` with leading dim
+    ``B / n_micro``. Because every microbatch is the same size and
+    ``loss_fn`` returns a per-microbatch mean, the mean of the per-micro
+    gradients equals the full-batch gradient exactly (up to summation
+    order in ``accum_dtype``).
+
+    jit/shard_map-compatible: the microbatch loop is a ``lax.scan`` whose
+    carry is the fp32 accumulator, so XLA compiles ONE microbatch body.
+    ``n_micro=1`` degenerates to a plain ``value_and_grad`` call (plus a
+    dtype cast of the grads).
+    """
+    batches = split_microbatches(batch, n_micro)
+    vg = jax.value_and_grad(loss_fn)
+
+    first = jax.tree.map(lambda x: x[0], batches)
+    g_shape = jax.eval_shape(vg, params, first)[1]
+    zeros = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, accum_dtype), g_shape)
+
+    def body(carry, micro):
+        loss_acc, g_acc = carry
+        loss, g = vg(params, micro)
+        g_acc = jax.tree.map(
+            lambda a, x: a + x.astype(accum_dtype), g_acc, g)
+        return (loss_acc + loss.astype(jnp.float32), g_acc), None
+
+    (loss_sum, g_sum), _ = lax.scan(
+        body, (jnp.float32(0.0), zeros), batches)
+    inv = 1.0 / n_micro
+    return loss_sum * inv, jax.tree.map(lambda g: g * inv, g_sum)

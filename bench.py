@@ -311,19 +311,31 @@ def main():
         # policy-dependent: measured on v5e (BASELINE.md, 2026-07-31),
         # dots remat fits ONLY at b<=32 where it beats full remat (415.8
         # vs 431.8 ms), while b128 full remat is the best full-remat
-        # point; the sweep reports every row and "best" picks the winner
+        # point; the sweep reports every row and "best" picks the winner.
+        # "batch@dots_accumN" runs the batch as N microbatches under dots
+        # remat with fp32 grad accumulation (parallel/grad_accum.py):
+        # micro-batch memory footprint, full-batch optimizer amortization.
         plan = []
         for entry in os.environ.get(
                 "BENCH_BATCHES", "32@dots,64,96,128,144").split(","):
             b, _, pol = entry.strip().partition("@")
-            plan.append((int(b), mk_cfg(pol or default_remat)))
+            pol = pol or default_remat
+            n_accum = None
+            if "accum" in pol:
+                pol, _, n = pol.rpartition("accum")
+                pol = pol.rstrip("_")
+                n_accum = int(n)
+            plan.append((int(b), mk_cfg(pol), n_accum))
 
+    plan = [p if len(p) == 3 else (*p, None) for p in plan]
     mesh = Mesh([dev], ("model",))
     sweep = _SO_FAR["sweep"]  # shared: partial emitters see live appends
     best = None
-    for batch, cfg in plan:
+    for batch, cfg, n_accum in plan:
         s = cfg.seq_len
         remat_name = cfg.remat_policy if cfg.remat else "none"
+        if n_accum:
+            remat_name += f"_accum{n_accum}"
 
         def model_fn(p, tokens, labels, loss_mask, cfg=cfg):
             return bert_loss(p, tokens, labels, loss_mask, cfg)
@@ -342,12 +354,22 @@ def main():
             jax.random.uniform(jax.random.PRNGKey(3), (batch, s)) < 0.15
         )
 
-        def step_body(params, state, tokens, labels, loss_mask):
-            def loss_fn(p):
-                loss = amp_fn(p, tokens, labels, loss_mask)
-                return amp.scale_loss(loss, state)
+        def step_body(params, state, tokens, labels, loss_mask,
+                      n_accum=n_accum):
+            if n_accum:
+                from apex_tpu.parallel import accumulate_gradients
 
-            grads = jax.grad(loss_fn)(params)
+                _, grads = accumulate_gradients(
+                    lambda p, mb: amp.scale_loss(
+                        amp_fn(p, mb["t"], mb["l"], mb["m"]), state),
+                    params,
+                    {"t": tokens, "l": labels, "m": loss_mask}, n_accum)
+            else:
+                def loss_fn(p):
+                    loss = amp_fn(p, tokens, labels, loss_mask)
+                    return amp.scale_loss(loss, state)
+
+                grads = jax.grad(loss_fn)(params)
             return opt.apply_gradients(grads, state, params)
 
         specs = jax.tree.map(lambda _: P(), params)

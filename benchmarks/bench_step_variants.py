@@ -17,7 +17,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def build_step(batch, remat, remat_policy="full", cfg_over=None):
+def build_step(batch, remat, remat_policy="full", cfg_over=None,
+               n_accum=None):
     from apex_tpu import amp
     from apex_tpu.optimizers import fused_lamb
     from apex_tpu.testing import (
@@ -36,14 +37,26 @@ def build_step(batch, remat, remat_policy="full", cfg_over=None):
     amp_fn, params, opt = amp.initialize(
         model_fn, params, fused_lamb(1e-3), opt_level="O2", verbosity=0)
     state = opt.init(params)
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, 512), 0, cfg.vocab_size)
-    labels = jax.random.randint(jax.random.PRNGKey(2), (batch, 512), 0, cfg.vocab_size)
-    mask = jax.random.uniform(jax.random.PRNGKey(3), (batch, 512)) < 0.15
+    s_len = cfg.seq_len
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, s_len), 0, cfg.vocab_size)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (batch, s_len), 0, cfg.vocab_size)
+    mask = jax.random.uniform(jax.random.PRNGKey(3), (batch, s_len)) < 0.15
 
     def step_body(params, state, tokens, labels, loss_mask):
-        def loss_fn(p):
-            return amp.scale_loss(amp_fn(p, tokens, labels, loss_mask), state)
-        grads = jax.grad(loss_fn)(params)
+        if n_accum:
+            # grad accumulation: micro-batch remat footprint + one step
+            # (parallel/grad_accum.py — the dots-at-large-batch lever)
+            from apex_tpu.parallel import accumulate_gradients
+
+            _, grads = accumulate_gradients(
+                lambda p, mb: amp.scale_loss(
+                    amp_fn(p, mb["t"], mb["l"], mb["m"]), state),
+                params, {"t": tokens, "l": labels, "m": loss_mask}, n_accum)
+        else:
+            def loss_fn(p):
+                return amp.scale_loss(
+                    amp_fn(p, tokens, labels, loss_mask), state)
+            grads = jax.grad(loss_fn)(params)
         return opt.apply_gradients(grads, state, params)
 
     mesh = Mesh([jax.devices()[0]], ("model",))
@@ -84,6 +97,12 @@ def main():
                                                  # elementwise
         "flashsave_chunked": ([], "flash"),  # + fused linear+CE loss
         "dots_chunked": ([], "dots"),        # dots remat + chunked loss
+        # grad accumulation: batch/N microbatches under dots remat (which
+        # fits only at micro b<=32) accumulated in fp32, one LAMB step —
+        # b128 as 4 x b32(dots) drops the full-remat forward replay
+        "dots_accum2": ([], "dots"),
+        "dots_accum4": ([], "dots"),
+        "full_accum4": ([], "full"),  # isolates the accumulation overhead
         "flash_offload": ([], "flash_offload"),  # flash o/lse to host mem
         "pallas_noremat": ([], "none"),
         "attn_dropout": ([], "full"),   # fused kernel dropout p=0.1 (the
@@ -106,8 +125,15 @@ def main():
         "flash_b256": ([], "full"),
         "flash_b512": ([], "full"),
     }
+    import re
     for name in which:
-        disable, remat_mode = variants[name]
+        # any "<policy>_accumN" (N arbitrary) resolves generically so the
+        # batteries can probe accumulation factors without a dict edit
+        m = re.fullmatch(r"(dots|full|flash)_accum(\d+)", name)
+        if m:
+            disable, remat_mode = [], m.group(1)
+        else:
+            disable, remat_mode = variants[name]
         for k in ("layer_norm", "rms_norm", "flash_attention",
                   "flash_attention_dropout", "optim_flat"):
             _utils.enable_kernel(k)
@@ -125,10 +151,12 @@ def main():
             cfg_over = {"loss_chunk": 8192}
         if name.startswith("attn_dropout"):
             cfg_over = {"attn_dropout_p": 0.1}
+        n_accum = (int(name.rsplit("accum", 1)[1])
+                   if "accum" in name else None)
         try:
             step, args = build_step(batch, remat=remat_mode != "none",
                                     remat_policy=remat_mode,
-                                    cfg_over=cfg_over)
+                                    cfg_over=cfg_over, n_accum=n_accum)
             ms = run(step, args)
             print(f"{name:14s} remat={remat_mode:5s}: {ms:8.1f} ms/step  "
                   f"{batch/ms*1e3:6.1f} samples/s", flush=True)

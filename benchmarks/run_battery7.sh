@@ -1,0 +1,63 @@
+#!/bin/bash
+# Round-4 phase-5 battery: driver-path validation + last ladder probe.
+#
+# Item 1 runs bench.py EXACTLY as the driver will at round end. That (a)
+# validates the ok:true JSON path end-to-end on hardware, and (b)
+# pre-warms the persistent compilation cache (/tmp/jax_cache) for every
+# sweep config, so the driver's own run compiles nothing cold — the
+# round-3 lesson being that short tunnel windows are the scarce resource.
+set -u
+cd "$(dirname "$0")/.."
+LOGDIR="${1:-benchmarks/logs_r4h}"
+mkdir -p "$LOGDIR"
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}"
+
+log() { echo "[battery7 $(date -u +%H:%M:%S)] $*" | tee -a "$LOGDIR/battery.log"; }
+
+probe_ok() {
+  timeout -k 10 90 python -c "
+import jax
+d = jax.devices()
+assert d and d[0].platform == 'tpu', d
+" > /dev/null 2>&1
+}
+
+wait_tunnel() {
+  local polls="${1:-20}"
+  for i in $(seq 1 "$polls"); do
+    if probe_ok; then return 0; fi
+    log "tunnel probe $i/$polls failed; sleeping 120s"
+    sleep 120
+  done
+  return 1
+}
+
+run() {
+  local name="$1" t="$2"; shift 2
+  if ! wait_tunnel 20; then
+    log "ABORT battery: tunnel never answered before $name"
+    exit 1
+  fi
+  log "START $name: $*"
+  ( timeout -k 10 "$t" "$@" ) > "$LOGDIR/$name.log" 2>&1
+  local rc=$?
+  log "END   $name rc=$rc (tail: $(tail -1 "$LOGDIR/$name.log" 2>/dev/null | cut -c1-120))"
+}
+
+# grad-accumulation probes: b128 as 4 x b32 under dots remat (fp32
+# accumulator) vs the accumulation-overhead control; projected from the
+# measured ladder (b32 dots = 77.0 samples/s) to land ~79-81 samples/s
+# at b128 if the optimizer amortization holds
+run accum_b128 3000 python benchmarks/bench_step_variants.py 128 \
+                    dots_accum4 full_accum4
+run accum_b160 2400 python benchmarks/bench_step_variants.py 160 dots_accum5
+run accum_b64  2400 python benchmarks/bench_step_variants.py 64 dots_accum2
+# the driver path, verbatim, with the sweep EXTENDED by the accum
+# candidates — validates ok:true end-to-end AND pre-warms the persistent
+# cache for whichever default sweep the accum results pick
+run bench_dryrun 7200 env BENCH_BATCHES=32@dots,64,96,128,144,128@dots_accum4,160@dots_accum5 \
+                    python bench.py
+# last remat-ladder rung: does freeing the b32 logits buffer (chunked
+# loss) buy dots anything at its one viable batch?
+run dots_chunk32 2400 python benchmarks/bench_step_variants.py 32 dots_chunked
+log "battery7 complete"
