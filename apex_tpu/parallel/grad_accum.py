@@ -50,7 +50,7 @@ def split_microbatches(batch, n_micro: int):
 
 
 def accumulate_gradients(loss_fn, params, batch, n_micro: int,
-                         accum_dtype=jnp.float32):
+                         accum_dtype=jnp.float32, with_index: bool = False):
     """Mean loss and mean gradients of ``loss_fn`` over ``n_micro``
     microbatches, accumulated in ``accum_dtype``.
 
@@ -61,27 +61,37 @@ def accumulate_gradients(loss_fn, params, batch, n_micro: int,
     gradients equals the full-batch gradient exactly (up to summation
     order in ``accum_dtype``).
 
+    ``with_index=True`` calls ``loss_fn(params, microbatch, i)`` with the
+    traced microbatch index instead. A loss with dropout MUST use this
+    (fold ``i`` into its PRNG key): a key closed over in ``loss_fn`` is
+    constant across the scan, so all microbatches would draw the SAME
+    dropout mask — correlated in exactly the way accumulation is meant
+    to average away.
+
     jit/shard_map-compatible: the microbatch loop is a ``lax.scan`` whose
     carry is the fp32 accumulator, so XLA compiles ONE microbatch body.
     ``n_micro=1`` degenerates to a plain ``value_and_grad`` call (plus a
     dtype cast of the grads).
     """
     batches = split_microbatches(batch, n_micro)
-    vg = jax.value_and_grad(loss_fn)
+    fn = loss_fn if with_index else (lambda p, mb, i: loss_fn(p, mb))
+    vg = jax.value_and_grad(fn)
 
     first = jax.tree.map(lambda x: x[0], batches)
-    g_shape = jax.eval_shape(vg, params, first)[1]
+    g_shape = jax.eval_shape(vg, params, first, jnp.int32(0))[1]
     zeros = jax.tree.map(
         lambda s: jnp.zeros(s.shape, accum_dtype), g_shape)
 
-    def body(carry, micro):
+    def body(carry, micro_i):
         loss_acc, g_acc = carry
-        loss, g = vg(params, micro)
+        micro, i = micro_i
+        loss, g = vg(params, micro, i)
         g_acc = jax.tree.map(
             lambda a, x: a + x.astype(accum_dtype), g_acc, g)
         return (loss_acc + loss.astype(jnp.float32), g_acc), None
 
     (loss_sum, g_sum), _ = lax.scan(
-        body, (jnp.float32(0.0), zeros), batches)
+        body, (jnp.float32(0.0), zeros),
+        (batches, jnp.arange(n_micro, dtype=jnp.int32)))
     inv = 1.0 / n_micro
     return loss_sum * inv, jax.tree.map(lambda g: g * inv, g_sum)

@@ -119,6 +119,78 @@ def test_inf_microbatch_trips_step_skip():
                                       np.asarray(b_, np.float32))
 
 
+def test_with_index_gives_distinct_microbatch_rng():
+    """with_index=True passes the traced micro index so dropout draws a
+    DIFFERENT mask per microbatch; without it, a closed-over key repeats
+    the same mask (the failure mode the docstring warns about)."""
+    params, batch = _setup()
+    key = jax.random.PRNGKey(7)
+
+    def loss_indexed(p, mb, i):
+        k = jax.random.fold_in(key, i)
+        keep = jax.random.bernoulli(k, 0.5, mb["x"].shape)
+        return _loss(p, {"x": mb["x"] * keep, "y": mb["y"]})
+
+    def loss_fixed(p, mb):
+        keep = jax.random.bernoulli(key, 0.5, mb["x"].shape)
+        return _loss(p, {"x": mb["x"] * keep, "y": mb["y"]})
+
+    _, g_idx = jax.jit(lambda p, b: accumulate_gradients(
+        loss_indexed, p, b, 4, with_index=True))(params, batch)
+    _, g_fix = jax.jit(lambda p, b: accumulate_gradients(
+        loss_fixed, p, b, 4))(params, batch)
+    # identical data, only the per-micro RNG differs -> grads must differ
+    diffs = [float(jnp.max(jnp.abs(a - b)))
+             for a, b in zip(jax.tree.leaves(g_idx), jax.tree.leaves(g_fix))]
+    assert max(diffs) > 1e-6, diffs
+
+    # exact oracle: mean over i of grad(loss_indexed)(p, mb_i, i) — catches
+    # a stuck-at-0 scan index (which the inequality above would miss)
+    mbs = split_microbatches(batch, 4)
+    g_oracle = None
+    for i in range(4):
+        mb = jax.tree.map(lambda x: x[i], mbs)
+        gi = jax.grad(loss_indexed)(params, mb, jnp.int32(i))
+        g_oracle = gi if g_oracle is None else jax.tree.map(
+            jnp.add, g_oracle, gi)
+    g_oracle = jax.tree.map(lambda g: g / 4.0, g_oracle)
+    for a, r in zip(jax.tree.leaves(g_idx), jax.tree.leaves(g_oracle)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_ddp_composition_one_psum_per_step():
+    """Accumulate inside shard_map, DDP-reduce the MEAN once: equals the
+    full-batch DDP grads (dp=2), i.e. accumulation composes with the
+    bucketed psum at one collective per step, not one per microbatch."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel import DistributedDataParallel
+    from apex_tpu.parallel.mesh import cpu_mesh
+    from apex_tpu.testing.commons import smap
+
+    params, batch = _setup(b=16)
+    mesh = cpu_mesh({"data": 2})
+    ddp = DistributedDataParallel(axis_name="data")
+
+    def full(p, b):
+        g = jax.grad(_loss)(p, b)
+        return ddp.allreduce_gradients(g)
+
+    def accum(p, b):
+        _, g = accumulate_gradients(_loss, p, b, 2)
+        return ddp.allreduce_gradients(g)
+
+    pspec = jax.tree.map(lambda _: P(), params)
+    g_full = jax.jit(smap(full, mesh, (pspec, P("data")), pspec))(
+        params, batch)
+    g_acc = jax.jit(smap(accum, mesh, (pspec, P("data")), pspec))(
+        params, batch)
+    for a, r in zip(jax.tree.leaves(g_acc), jax.tree.leaves(g_full)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_transformer_dots_accum_matches_full_remat_grads():
     """The production composition: standalone transformer, dots remat per
     microbatch, 2 x b4 accumulation == b8 one-shot full-remat grads.
