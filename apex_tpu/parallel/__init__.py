@@ -26,6 +26,7 @@ from apex_tpu.parallel.mesh import (  # noqa: F401
     data_parallel_mesh,
     default_mesh,
     get_default_mesh,
+    hybrid_mesh,
     make_mesh,
     set_default_mesh,
 )

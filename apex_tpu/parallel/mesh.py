@@ -39,6 +39,26 @@ DEFAULT_AXIS_ORDER = (STAGE_AXIS, DATA_AXIS, MODEL_AXIS)
 _default_mesh: Optional[Mesh] = None
 
 
+def _resolve_axes(axes, n_devices, axis_order):
+    """Shared make_mesh/hybrid_mesh resolution: infer one -1 size from the
+    device count and order axes major→minor per ``axis_order`` (unknown
+    axes appended in insertion order). Returns (names, shape)."""
+    axes = dict(axes)
+    known = math.prod(s for s in axes.values() if s != -1)
+    infer = [k for k, s in axes.items() if s == -1]
+    if len(infer) > 1:
+        raise ValueError("at most one axis size may be -1")
+    if infer:
+        if n_devices % known:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed axes "
+                f"product {known}")
+        axes[infer[0]] = n_devices // known
+    names = [a for a in axis_order if a in axes]
+    names += [a for a in axes if a not in names]
+    return names, [axes[n] for n in names]
+
+
 def make_mesh(
     axes: Mapping[str, int],
     *,
@@ -52,29 +72,101 @@ def make_mesh(
     appended in insertion order.
     """
     devices = list(devices if devices is not None else jax.devices())
-    axes = dict(axes)
-
-    known = math.prod(s for s in axes.values() if s != -1)
-    infer = [k for k, s in axes.items() if s == -1]
-    if len(infer) > 1:
-        raise ValueError("at most one axis size may be -1")
-    if infer:
-        if len(devices) % known:
-            raise ValueError(
-                f"{len(devices)} devices not divisible by fixed axes product {known}"
-            )
-        axes[infer[0]] = len(devices) // known
-
-    total = math.prod(axes.values())
+    names, shape = _resolve_axes(axes, len(devices), axis_order)
+    total = math.prod(shape)
     if total > len(devices):
         raise ValueError(f"mesh needs {total} devices, have {len(devices)}")
     devices = devices[:total]
-
-    names = [a for a in axis_order if a in axes]
-    names += [a for a in axes if a not in names]
-    shape = tuple(axes[n] for n in names)
     dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, tuple(names))
+
+
+def hybrid_mesh(
+    axes: Mapping[str, int],
+    *,
+    dcn_axes: Sequence[str] = (STAGE_AXIS, DATA_AXIS),
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_order: Sequence[str] = DEFAULT_AXIS_ORDER,
+    slice_map: Optional[Sequence[int]] = None,
+) -> Mesh:
+    """Multi-slice mesh: axes named in ``dcn_axes`` span slices (DCN),
+    everything else stays within a slice (ICI).
+
+    The reference scales across hosts by giving every process group an
+    NCCL communicator regardless of topology; on multi-slice TPU the
+    topology is two-tier — fast ICI within a slice, slow DCN between —
+    so the mesh must be laid out so that the chatty axes (tensor
+    parallel) never cross DCN (SURVEY §6 "Distributed communication
+    backend"; cf. the scaling-book recipe). ``hybrid_mesh`` walks
+    ``dcn_axes`` major-to-minor, factoring the slice count into those
+    axes (an axis may span BOTH tiers, e.g. dp=16 over 4 slices = 4 DCN
+    x 4 ICI); the device array is ordered so each axis's DCN extent is
+    major over its ICI extent.
+
+    ``slice_map`` overrides slice assignment (one slice id per device) —
+    used by tests and by CPU rehearsal of a pod layout. Without it,
+    devices are grouped by ``slice_index`` when present (multi-slice TPU)
+    falling back to ``process_index``, and a single group degenerates to
+    ``make_mesh`` exactly.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    names, shape = _resolve_axes(axes, len(devices), axis_order)
+    axes = dict(zip(names, shape))
+    if math.prod(shape) != len(devices):
+        # unlike make_mesh, hybrid layout must use ALL devices — a surplus
+        # would leave partial slices
+        raise ValueError(
+            f"mesh {dict(zip(names, shape))} needs {math.prod(shape)} "
+            f"devices, have {len(devices)} (hybrid_mesh uses all devices)")
+
+    if slice_map is not None and len(slice_map) != len(devices):
+        raise ValueError(
+            f"slice_map has {len(slice_map)} entries for "
+            f"{len(devices)} devices")
+    if slice_map is None:
+        def _slice_of(d):
+            s = getattr(d, "slice_index", None)
+            return s if s is not None else d.process_index
+        slice_map = [_slice_of(d) for d in devices]
+    by_slice: dict = {}
+    for d, s in zip(devices, slice_map):
+        by_slice.setdefault(s, []).append(d)
+    slice_groups = [by_slice[k] for k in sorted(by_slice)]
+    n_slices = len(slice_groups)
+    per_slice = len(devices) // n_slices
+    if any(len(g) != per_slice for g in slice_groups):
+        raise ValueError(
+            f"uneven slices: {[len(g) for g in slice_groups]}")
+
+    # factor n_slices into the dcn axes, major to minor
+    dcn_part = {n: 1 for n in names}
+    remaining = n_slices
+    for a in dcn_axes:
+        if a not in axes or remaining == 1:
+            continue
+        d = math.gcd(axes[a], remaining)
+        dcn_part[a] = d
+        remaining //= d
+    if remaining != 1:
+        raise ValueError(
+            f"cannot factor {n_slices} slices into dcn_axes={dcn_axes} "
+            f"sizes {[axes.get(a) for a in dcn_axes]}")
+    # dcn_part[n] is 1 or gcd(axes[n], ...), so it always divides axes[n]
+    ici_part = {n: axes[n] // dcn_part[n] for n in names}
+    if math.prod(ici_part.values()) != per_slice:
+        raise ValueError(
+            f"ICI extents {ici_part} need {math.prod(ici_part.values())} "
+            f"devices/slice, have {per_slice}")
+
+    # [n_slices, per_slice] -> (dcn_0..dcn_k, ici_0..ici_k) ->
+    # interleave (dcn_i, ici_i) pairs -> merge to the global shape
+    arr = np.asarray(
+        [d for g in slice_groups for d in g], dtype=object
+    ).reshape([dcn_part[n] for n in names] + [ici_part[n] for n in names])
+    k = len(names)
+    arr = arr.transpose(
+        [i for pair in zip(range(k), range(k, 2 * k)) for i in pair])
+    return Mesh(arr.reshape(shape), tuple(names))
 
 
 def data_parallel_mesh(n: Optional[int] = None, **kw) -> Mesh:

@@ -1,0 +1,84 @@
+"""hybrid_mesh — two-tier (ICI within slice / DCN across slices) layout.
+
+Contract (SURVEY §6 distributed-backend row): axes named in ``dcn_axes``
+may span slices; every OTHER axis must be wholly within one slice, so
+tensor-parallel collectives never cross DCN. Tested on the CPU mesh with
+an explicit ``slice_map`` standing in for multi-slice topology.
+"""
+
+import numpy as np
+import pytest
+
+from apex_tpu.parallel.mesh import cpu_devices, hybrid_mesh, make_mesh
+
+
+def _slice_of(mesh, slice_map, devs):
+    """Map each mesh position to its device's slice id."""
+    ids = {id(d): s for d, s in zip(devs, slice_map)}
+    return np.vectorize(lambda d: ids[id(d)])(mesh.devices)
+
+
+def test_single_slice_degenerates_to_make_mesh():
+    devs = cpu_devices(8)
+    m_h = hybrid_mesh({"data": 2, "model": 4}, devices=devs,
+                      slice_map=[0] * 8)
+    m_p = make_mesh({"data": 2, "model": 4}, devices=devs)
+    assert m_h.axis_names == m_p.axis_names
+    assert (np.vectorize(id)(m_h.devices)
+            == np.vectorize(id)(m_p.devices)).all()
+
+
+def test_dcn_axis_spans_slices_ici_axis_stays_within():
+    devs = cpu_devices(8)
+    slice_map = [0, 0, 0, 0, 1, 1, 1, 1]
+    m = hybrid_mesh({"data": 2, "model": 4}, devices=devs,
+                    dcn_axes=("data",), slice_map=slice_map)
+    s = _slice_of(m, slice_map, devs)  # shape [data=2, model=4]
+    # each data row is one slice; the model axis never crosses a slice
+    for i in range(2):
+        assert len(set(s[i])) == 1, s
+    assert set(s[:, 0]) == {0, 1}
+
+
+def test_axis_spanning_both_tiers():
+    """dp=4 over 2 slices: 2 DCN x 2 ICI — the dp axis's major half
+    crosses slices, its minor half stays local; model stays local."""
+    devs = cpu_devices(8)
+    slice_map = [0, 0, 0, 0, 1, 1, 1, 1]
+    m = hybrid_mesh({"data": 4, "model": 2}, devices=devs,
+                    dcn_axes=("data",), slice_map=slice_map)
+    s = _slice_of(m, slice_map, devs)  # [data=4, model=2]
+    # model axis within slice at every data index
+    for i in range(4):
+        assert len(set(s[i])) == 1, s
+    # dp major half: indices 0-1 on slice 0, 2-3 on slice 1
+    assert list(s[:, 0]) == [0, 0, 1, 1], s
+
+
+def test_stage_then_data_factorization():
+    """4 slices over stage=2 x data=2 dcn axes: stage takes 2, data 2."""
+    devs = cpu_devices(8)
+    slice_map = [0, 0, 1, 1, 2, 2, 3, 3]
+    m = hybrid_mesh({"stage": 2, "data": 2, "model": 2}, devices=devs,
+                    slice_map=slice_map)
+    s = _slice_of(m, slice_map, devs)  # [stage=2, data=2, model=2]
+    for i in range(2):
+        for j in range(2):
+            assert len(set(s[i, j])) == 1, s  # model within slice
+    assert len({s[i, j, 0] for i in range(2) for j in range(2)}) == 4
+
+
+def test_unfactorable_slices_raise():
+    # 4 slices but the only DCN-eligible axis has size 2 -> 2 left over
+    devs = cpu_devices(8)
+    with pytest.raises(ValueError, match="cannot factor"):
+        hybrid_mesh({"data": 2, "model": 4}, devices=devs,
+                    dcn_axes=("data",),
+                    slice_map=[0, 0, 1, 1, 2, 2, 3, 3])
+
+
+def test_uneven_slices_raise():
+    devs = cpu_devices(8)
+    with pytest.raises(ValueError, match="uneven"):
+        hybrid_mesh({"data": 2, "model": 4}, devices=devs,
+                    slice_map=[0, 0, 0, 1, 1, 1, 1, 1])
