@@ -18,6 +18,10 @@ from apex_tpu.parallel import DistributedDataParallel
 
 
 def main():
+    if "--cpu" in sys.argv:
+        # must be a config update, not an env var — this container's
+        # sitecustomize force-latches the TPU plugin at interpreter start
+        jax.config.update("jax_platforms", "cpu")
     devs = jax.devices()
     n = min(len(devs), 8)
     mesh = Mesh(devs[:n], ("data",))
