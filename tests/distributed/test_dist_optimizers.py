@@ -295,3 +295,48 @@ def test_dist_optimizers_clip_norm_overflow_skips_not_zeroes():
                                     if cls is DistributedFusedLAMB else {}))
         assert s1 == 0, f"{cls.__name__}: norm-overflow step was applied"
         assert s2 == 0, f"{cls.__name__}: inf grads not skipped"
+
+
+def test_zero_step_on_accumulated_gradients():
+    """The MLPerf-BERT composition (ref: DistributedFusedLAMB is driven by
+    accumulated gradients): accumulate_gradients' fp32 mean feeding the
+    ZeRO-sharded step == the same step on the one-shot full-batch grads.
+    The data-parallel mean happens inside opt.step's mean-reducing
+    reduce-scatter (grad_averaging default) — the accumulated per-device
+    mean feeds it directly, no extra collective."""
+    from apex_tpu.parallel import accumulate_gradients
+
+    mesh = _mesh()
+    params = _params()
+
+    def loss_fn(p, mb):
+        h = jnp.tanh(mb["x"] @ p["dense"]["kernel"] + p["dense"]["bias"])
+        return jnp.mean((h @ p["out"] - mb["y"]) ** 2)
+
+    kx = jax.random.PRNGKey(3)
+    batch = {"x": jax.random.normal(kx, (8 * N, 13)),
+             "y": jax.random.normal(jax.random.PRNGKey(4), (8 * N, 3))}
+
+    opt = DistributedFusedAdam(learning_rate=1e-2, axis_name="data")
+    opt.prepare(params, N)
+
+    def train(params, batch, n_micro):
+        state = opt.init_shard(params)
+        if n_micro:
+            _, grads = accumulate_gradients(loss_fn, params, batch, n_micro)
+        else:
+            grads = jax.grad(loss_fn)(params, batch)
+        params, state = opt.step(params, grads, state)
+        return params
+
+    for n_micro in (None, 4):
+        fn = shard_map(
+            functools.partial(train, n_micro=n_micro), mesh=mesh,
+            in_specs=(P(), P("data")), out_specs=P())
+        out = jax.jit(fn)(params, batch)
+        if n_micro is None:
+            ref = out
+        else:
+            for a, r in zip(jax.tree.leaves(out), jax.tree.leaves(ref)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                           rtol=1e-6, atol=1e-7)
