@@ -288,10 +288,13 @@ def main():
     _SO_FAR["kernels"] = kernel_report
 
     if on_cpu:
-        plan = [(4, TransformerConfig(
+        toy = TransformerConfig(
             vocab_size=512, seq_len=128, hidden=128, layers=2, heads=4,
             causal=False, dtype=jnp.bfloat16, scan_layers=True, remat=True,
-        ))]
+        )
+        # second row exercises the grad-accumulation step path on CPU so
+        # the debug smoke covers both step_body branches
+        plan = [(4, toy, None), (4, toy, 2)]
     else:
         # BERT-large: 24 x 1024 x 16 heads, seq 512, vocab 30528 (padded)
         from apex_tpu.models import bert_large
@@ -315,9 +318,15 @@ def main():
         # "batch@dots_accumN" runs the batch as N microbatches under dots
         # remat with fp32 grad accumulation (parallel/grad_accum.py):
         # micro-batch memory footprint, full-batch optimizer amortization.
+        # default sweep: 32@dots first (best-known per-sample point — a
+        # truncated sweep still reports it), then the full-remat curve,
+        # and LAST the unproven grad-accumulation candidate (4 x b32(dots)
+        # at b128, projected to beat b128 full remat) so a hang on it
+        # cannot truncate the established rows
         plan = []
         for entry in os.environ.get(
-                "BENCH_BATCHES", "32@dots,64,96,128,144").split(","):
+                "BENCH_BATCHES",
+                "32@dots,64,96,128,144,128@dots_accum4").split(","):
             b, _, pol = entry.strip().partition("@")
             pol = pol or default_remat
             n_accum = None
@@ -327,7 +336,6 @@ def main():
                 n_accum = int(n)
             plan.append((int(b), mk_cfg(pol), n_accum))
 
-    plan = [p if len(p) == 3 else (*p, None) for p in plan]
     mesh = Mesh([dev], ("model",))
     sweep = _SO_FAR["sweep"]  # shared: partial emitters see live appends
     best = None
