@@ -71,7 +71,7 @@ run lc8192c        "s=  8192 .*ms"  1800 python benchmarks/bench_long_context.py
 run lc2048_b256c   ""         1800 env APEX_TPU_FLASH_BLOCK=256 python benchmarks/bench_long_context.py 2048
 run lc2048_b128c   ""         1800 env APEX_TPU_FLASH_BLOCK=128 python benchmarks/bench_long_context.py 2048
 # example rows (BASELINE configs 4 + MoE + the L1 cross-product analog)
-run ex_gpt2tp4     "steps/sec" 2400 python examples/gpt2_tensor_parallel.py --bench
+run ex_gpt2tp4     "gpt2_medium_tp_tokens_per_sec" 2400 python examples/gpt2_tensor_parallel.py --bench
 run ex_main_amp4   ""          1200 python examples/main_amp.py --bench
 run ex_moe4        ""          2400 python examples/gpt_moe_ep.py --bench
 # the retuned LAMB tolerance + flat-kernel compiled tier

@@ -1,0 +1,81 @@
+#!/bin/bash
+# Round-4 phase-6 battery: everything still unmeasured, in VERDICT-value
+# order — written for a potentially SHORT tunnel window after the 04:05
+# outage (batteries 6/7 ordered reruns first; this one leads with the
+# round's headline levers so a brief window still captures them):
+#   1. grad-accumulation probes (the last single-chip MFU lever)
+#   2. bench.py driver dry-run (ok:true validation + cache pre-warm of
+#      the EXACT default sweep the driver will run at round end)
+#   3. kernel decision tables (optim/ops — VERDICT Next #4)
+#   4. example rows (BASELINE config 4 + MoE)
+#   5. components split, long-context A/Bs, TPU LAMB tier rerun
+set -u
+cd "$(dirname "$0")/.."
+LOGDIR="${1:-benchmarks/logs_r4i}"
+mkdir -p "$LOGDIR"
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}"
+
+log() { echo "[battery8 $(date -u +%H:%M:%S)] $*" | tee -a "$LOGDIR/battery.log"; }
+
+probe_ok() {
+  timeout -k 10 90 python -c "
+import jax
+d = jax.devices()
+assert d and d[0].platform == 'tpu', d
+" > /dev/null 2>&1
+}
+
+wait_tunnel() {
+  local polls="${1:-20}"
+  for i in $(seq 1 "$polls"); do
+    if probe_ok; then return 0; fi
+    log "tunnel probe $i/$polls failed; sleeping 120s"
+    sleep 120
+  done
+  return 1
+}
+
+run() {
+  local name="$1" t="$2"; shift 2
+  if ! wait_tunnel 20; then
+    log "ABORT battery: tunnel never answered before $name"
+    exit 1
+  fi
+  log "START $name: $*"
+  ( timeout -k 10 "$t" "$@" ) > "$LOGDIR/$name.log" 2>&1
+  local rc=$?
+  log "END   $name rc=$rc (tail: $(tail -1 "$LOGDIR/$name.log" 2>/dev/null | cut -c1-120))"
+}
+
+log "waiting for tunnel (outage gate: up to ~6 h)"
+if ! wait_tunnel 180; then
+  log "ABORT battery: tunnel never returned"
+  exit 1
+fi
+log "tunnel is back"
+
+# 1 — the MFU lever: b128 as 4 x b32(dots) + the accumulation-overhead
+#     control; then the neighboring operating points
+run accum_b128   3000 python benchmarks/bench_step_variants.py 128 \
+                      dots_accum4 full_accum4
+run accum_b160   2400 python benchmarks/bench_step_variants.py 160 dots_accum5
+run accum_b64    2400 python benchmarks/bench_step_variants.py 64 dots_accum2
+# 2 — the driver path verbatim (default sweep now includes the accum row)
+run bench_dryrun 7200 python bench.py
+# 3 — kernel decision tables (roofline-scaled timing + transient retry)
+run optim_kernels3 2400 python benchmarks/bench_optim_kernels.py
+run ops_gbps4      2400 python benchmarks/bench_ops.py
+# 4 — example rows
+run ex_gpt2tp4     2400 python examples/gpt2_tensor_parallel.py --bench
+run ex_moe4        2400 python examples/gpt_moe_ep.py --bench
+run ex_main_amp4   1200 python examples/main_amp.py --bench
+# 5 — the rest
+run components4    3000 python benchmarks/bench_components.py
+run lc8192c        1800 python benchmarks/bench_long_context.py 8192
+run lc2048_b256c   1800 env APEX_TPU_FLASH_BLOCK=256 python benchmarks/bench_long_context.py 2048
+run lc2048_b128c   1800 env APEX_TPU_FLASH_BLOCK=128 python benchmarks/bench_long_context.py 2048
+run dots_chunk32   2400 python benchmarks/bench_step_variants.py 32 dots_chunked
+run tpu_lamb3      1800 env APEX_TPU_HW=1 python -m pytest \
+                       tests/tpu/test_kernels_compiled.py \
+                       -k "lamb_phase1 or adam_flat or l2norm" -v
+log "battery8 complete"
