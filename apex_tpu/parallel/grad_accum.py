@@ -40,6 +40,12 @@ def split_microbatches(batch, n_micro: int):
     """
     def _split(x):
         x = jnp.asarray(x)
+        if x.ndim == 0:
+            raise ValueError(
+                "batch pytree contains a 0-d (scalar) leaf; every leaf "
+                "must carry a leading batch dimension to split into "
+                "microbatches (hoist per-batch constants out of the "
+                "batch pytree, e.g. close over them in loss_fn)")
         if x.shape[0] % n_micro:
             raise ValueError(
                 f"leading dim {x.shape[0]} not divisible by "

@@ -15,6 +15,7 @@ BENCH_CPU=1 runs a toy config on CPU (debug escape hatch).
 
 import json
 import os
+import re
 import subprocess
 import sys
 import threading
@@ -329,11 +330,14 @@ def main():
                 "32@dots,64,96,128,144,128@dots_accum4").split(","):
             b, _, pol = entry.strip().partition("@")
             pol = pol or default_remat
+            # "<policy>_accumN" only when N is a real integer suffix — a
+            # malformed "dots_accum" falls through as a plain policy name
+            # and fails with TransformerConfig's own "unknown
+            # remat_policy" assertion (round-4 advisor finding)
+            m = re.fullmatch(r"(.+)_accum(\d+)", pol)
             n_accum = None
-            if "accum" in pol:
-                pol, _, n = pol.rpartition("accum")
-                pol = pol.rstrip("_")
-                n_accum = int(n)
+            if m:
+                pol, n_accum = m.group(1), int(m.group(2))
             plan.append((int(b), mk_cfg(pol), n_accum))
 
     mesh = Mesh([dev], ("model",))

@@ -1,0 +1,70 @@
+# Shared battery machinery (sourced by run_battery8b.sh / run_battery9.sh).
+#
+# Design (round-4 lessons, VERDICT Weak #6 + tunnel playbook):
+# - every item is gated on a tunnel probe; a dead tunnel aborts the
+#   battery and the round-5 supervisor relaunches it later;
+# - resume skips an item only on a SUCCESS MARKER in its own log (a
+#   measurement row), never on process rc: bench scripts catch
+#   per-variant exceptions and exit 0, so rc=0 does not mean measured;
+# - two attempts max per item: deterministic failures (OOM-class) must
+#   not re-burn the window on every relaunch (playbook: HTTP 500
+#   compile failures are deterministic).
+#
+# Expects: $LOGDIR set, cwd = repo root. Provides: log, probe_ok,
+# wait_tunnel, run NAME TIMEOUT OK_PATTERN CMD...
+
+log() { echo "[${BATTERY_NAME:-battery} $(date -u +%H:%M:%S)] $*" | tee -a "$LOGDIR/battery.log"; }
+
+probe_ok() {
+  timeout -k 10 90 python -c "
+import jax
+d = jax.devices()
+assert d and d[0].platform == 'tpu', d
+" > /dev/null 2>&1
+}
+
+wait_tunnel() {
+  local polls="${1:-20}"
+  for i in $(seq 1 "$polls"); do
+    if probe_ok; then return 0; fi
+    log "tunnel probe $i/$polls failed; sleeping 120s"
+    sleep 120
+  done
+  return 1
+}
+
+# Success = the item's log holds at least one measurement row (OK_PATTERN)
+# and no failure row. The failure grep covers the bench scripts' "FAILED"
+# rows and pytest's "N failed" summary.
+ok_marker() {
+  local name="$1" pat="$2"
+  [ -f "$LOGDIR/$name.log" ] || return 1
+  grep -qE "$pat" "$LOGDIR/$name.log" || return 1
+  if grep -qE '(^|[^A-Za-z])FAILED|[0-9]+ failed' "$LOGDIR/$name.log"; then
+    return 1
+  fi
+  return 0
+}
+
+run() {
+  local name="$1" t="$2" pat="$3"; shift 3
+  if ok_marker "$name" "$pat"; then
+    log "SKIP  $name (success marker '$pat' present)"
+    return 0
+  fi
+  local attempts
+  attempts=$(grep -c "START $name:" "$LOGDIR/battery.log" 2>/dev/null || true)
+  if [ "${attempts:-0}" -ge 2 ]; then
+    log "SKIP  $name (${attempts} attempts without a clean success marker; "\
+"log kept for analysis, not re-burning the window)"
+    return 0
+  fi
+  if ! wait_tunnel 20; then
+    log "ABORT battery: tunnel never answered before $name"
+    exit 1
+  fi
+  log "START $name: $*"
+  ( timeout -k 10 "$t" "$@" ) > "$LOGDIR/$name.log" 2>&1
+  local rc=$?
+  log "END   $name rc=$rc (tail: $(tail -1 "$LOGDIR/$name.log" 2>/dev/null | cut -c1-120))"
+}

@@ -37,6 +37,10 @@ def iters_for(traffic_bytes, smoke_iters=None):
     """
     if smoke_iters is not None:
         return smoke_iters
+    if traffic_bytes <= 0:
+        raise ValueError(
+            f"traffic_bytes must be positive, got {traffic_bytes}; the "
+            "roofline iteration model needs a real HBM-traffic estimate")
     est = traffic_bytes / 8.1e11  # v5e HBM ~810 GB/s
     return max(32, min(8192, int(0.5 / est)))
 

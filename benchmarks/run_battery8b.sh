@@ -1,0 +1,46 @@
+#!/bin/bash
+# Resume-capable battery8 for the round-5 supervisor: same queue as
+# run_battery8.sh (which stays byte-frozen while its round-4 instance is
+# still executing — editing a running bash script corrupts it; once that
+# instance exits, THIS file is the single live copy of the queue).
+# Items resume on success markers, not rc — see _battery_lib.sh.
+set -u
+cd "$(dirname "$0")/.."
+LOGDIR="${1:-benchmarks/logs_r4i}"
+mkdir -p "$LOGDIR"
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}"
+BATTERY_NAME=battery8b
+. benchmarks/_battery_lib.sh
+
+log "waiting for tunnel (outage gate: up to ~6 h)"
+if ! wait_tunnel 180; then
+  log "ABORT battery: tunnel never returned"
+  exit 1
+fi
+log "tunnel is back"
+
+# 1 — the MFU lever: b128 as 4 x b32(dots) + the accumulation-overhead
+#     control; then the neighboring operating points
+run accum_b128   3000 'samples/s' python benchmarks/bench_step_variants.py 128 \
+                      dots_accum4 full_accum4
+run accum_b160   2400 'samples/s' python benchmarks/bench_step_variants.py 160 dots_accum5
+run accum_b64    2400 'samples/s' python benchmarks/bench_step_variants.py 64 dots_accum2
+# 2 — the driver path verbatim (default sweep now includes the accum row)
+run bench_dryrun 7200 '"ok": true' python bench.py
+# 3 — kernel decision tables (roofline-scaled timing + transient retry)
+run optim_kernels3 2400 'GB HBM traffic/step' python benchmarks/bench_optim_kernels.py
+run ops_gbps4      2400 'GB/s' python benchmarks/bench_ops.py
+# 4 — example rows
+run ex_gpt2tp4     2400 '"metric":' python examples/gpt2_tensor_parallel.py --bench
+run ex_moe4        2400 '"metric":' python examples/gpt_moe_ep.py --bench
+run ex_main_amp4   1200 '"metric":' python examples/main_amp.py --bench
+# 5 — the rest
+run components4    3000 'model remat=' python benchmarks/bench_components.py
+run lc8192c        1800 'TFLOP/s' python benchmarks/bench_long_context.py 8192
+run lc2048_b256c   1800 'TFLOP/s' env APEX_TPU_FLASH_BLOCK=256 python benchmarks/bench_long_context.py 2048
+run lc2048_b128c   1800 'TFLOP/s' env APEX_TPU_FLASH_BLOCK=128 python benchmarks/bench_long_context.py 2048
+run dots_chunk32   2400 'samples/s' python benchmarks/bench_step_variants.py 32 dots_chunked
+run tpu_lamb3      1800 ' passed' env APEX_TPU_HW=1 python -m pytest \
+                       tests/tpu/test_kernels_compiled.py \
+                       -k "lamb_phase1 or adam_flat or l2norm" -v
+log "battery8 complete"

@@ -1,0 +1,63 @@
+#!/bin/bash
+# Round-5 measurement supervisor. The round-4 lesson (VERDICT Weak #6):
+# batteries abort when the tunnel outage outlasts their gate, and nobody
+# relaunches them — the round's tail is lost. This loop owns the whole
+# round: it keeps exactly ONE battery running at a time (single-claim
+# tunnel), relaunches the resume-capable battery8b whenever the queue is
+# incomplete, then chains battery9 (round-5 ladder extensions) the same
+# way. Launch with: setsid nohup bash benchmarks/run_supervisor_r5.sh &
+set -u
+cd "$(dirname "$0")/.."
+SLOG=benchmarks/logs_r5_supervisor.log
+log() { echo "[sup $(date -u +%H:%M:%S)] $*" >> "$SLOG"; }
+
+# Single-instance lock: a second launch (e.g. the original presumed dead
+# mid-sleep) must not race the check-then-launch window into two
+# concurrent batteries on the single-claim tunnel.
+exec 9>/tmp/apex_tpu_r5_supervisor.lock
+if ! flock -n 9; then
+  log "another supervisor holds the lock; exiting"
+  exit 0
+fi
+
+wait_for_pid() {
+  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+}
+
+# Phase 1: battery8 queue to completion (the original instance from
+# round 4 may still be in its outage gate — let it finish first).
+B8LOG=benchmarks/logs_r4i/battery.log
+while ! grep -q "battery8 complete" "$B8LOG" 2>/dev/null; do
+  pid=$(pgrep -f "run_battery8b?.sh" | head -1)
+  if [ -n "${pid:-}" ]; then
+    log "battery8 instance running (pid $pid); waiting"
+    wait_for_pid "$pid"
+  else
+    log "battery8 queue incomplete and no instance running; relaunching battery8b"
+    bash benchmarks/run_battery8b.sh benchmarks/logs_r4i \
+      >> benchmarks/logs_r4i_nohup.log 2>&1 || true
+    sleep 30
+  fi
+done
+log "battery8 queue complete"
+
+# Phase 2: battery9 (written during round 5; wait for it to appear).
+B9LOG=benchmarks/logs_r5/battery.log
+while ! grep -q "battery9 complete" "$B9LOG" 2>/dev/null; do
+  if [ ! -f benchmarks/run_battery9.sh ]; then
+    log "battery9 not written yet; sleeping"
+    sleep 300
+    continue
+  fi
+  pid=$(pgrep -f "run_battery9.sh" | head -1)
+  if [ -n "${pid:-}" ]; then
+    log "battery9 running (pid $pid); waiting"
+    wait_for_pid "$pid"
+  else
+    log "battery9 queue incomplete and no instance running; (re)launching"
+    bash benchmarks/run_battery9.sh benchmarks/logs_r5 \
+      >> benchmarks/logs_r5_nohup.log 2>&1 || true
+    sleep 30
+  fi
+done
+log "battery9 queue complete; supervisor done"
