@@ -4,6 +4,7 @@
 from apex_tpu.parallel import collectives, mesh  # noqa: F401
 from apex_tpu.parallel.ddp import DistributedDataParallel  # noqa: F401
 from apex_tpu.parallel.grad_accum import (  # noqa: F401
+    accumulate_and_step,
     accumulate_gradients,
     split_microbatches,
 )

@@ -79,14 +79,8 @@ def accumulate_gradients(loss_fn, params, batch, n_micro: int,
     ``n_micro=1`` degenerates to a plain ``value_and_grad`` call (plus a
     dtype cast of the grads).
     """
-    batches = split_microbatches(batch, n_micro)
-    fn = loss_fn if with_index else (lambda p, mb, i: loss_fn(p, mb))
-    vg = jax.value_and_grad(fn)
-
-    first = jax.tree.map(lambda x: x[0], batches)
-    g_shape = jax.eval_shape(vg, params, first, jnp.int32(0))[1]
-    zeros = jax.tree.map(
-        lambda s: jnp.zeros(s.shape, accum_dtype), g_shape)
+    batches, vg, zeros, inv = _accum_prologue(
+        loss_fn, params, batch, n_micro, accum_dtype, with_index)
 
     def body(carry, micro_i):
         loss_acc, g_acc = carry
@@ -99,5 +93,64 @@ def accumulate_gradients(loss_fn, params, batch, n_micro: int,
     (loss_sum, g_sum), _ = lax.scan(
         body, (jnp.float32(0.0), zeros),
         (batches, jnp.arange(n_micro, dtype=jnp.int32)))
-    inv = 1.0 / n_micro
     return loss_sum * inv, jax.tree.map(lambda g: g * inv, g_sum)
+
+
+def _accum_prologue(loss_fn, params, batch, n_micro, accum_dtype,
+                    with_index):
+    """Shared setup for both accumulation forms: split the batch, wrap the
+    loss, and build the fp32 accumulator skeleton from an eval_shape."""
+    batches = split_microbatches(batch, n_micro)
+    fn = loss_fn if with_index else (lambda p, mb, i: loss_fn(p, mb))
+    vg = jax.value_and_grad(fn)
+    first = jax.tree.map(lambda x: x[0], batches)
+    g_shape = jax.eval_shape(vg, params, first, jnp.int32(0))[1]
+    zeros = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, accum_dtype), g_shape)
+    return batches, vg, zeros, 1.0 / n_micro
+
+
+def accumulate_and_step(loss_fn, params, state, batch, n_micro: int,
+                        apply_fn, accum_dtype=jnp.float32,
+                        with_index: bool = False):
+    """``accumulate_gradients`` with the optimizer update executed INSIDE
+    the scan's final iteration (``lax.cond`` on the microbatch index).
+
+    Why: with the plain form, the fp32 accumulator (params-sized, ~1.3 GB
+    at BERT-large) leaves the scan, crosses an XLA region boundary, and
+    re-enters the optimizer epilogue — an HBM round-trip between two
+    separately-scheduled programs. Folding the update into the loop body
+    lets XLA schedule the last microbatch's backward and the parameter
+    update as one region. A/B'd against the plain form in
+    benchmarks/bench_step_variants.py (``*_optscanN`` variants).
+
+    ``apply_fn(mean_grads, state, params) -> (params, state)`` — the
+    optimizer/amp apply_gradients signature. ``loss_fn`` as in
+    ``accumulate_gradients`` (use ``with_index=True`` for dropout).
+    Returns ``(mean_loss, new_params, new_state)``; every microbatch's
+    gradient is taken at the PRE-update parameters, so the result is
+    step-equivalent to accumulate-then-apply (up to fusion/scheduling).
+    """
+    batches, vg, zeros, inv = _accum_prologue(
+        loss_fn, params, batch, n_micro, accum_dtype, with_index)
+
+    def body(carry, micro_i):
+        params_c, state_c, loss_acc, g_acc = carry
+        micro, i = micro_i
+        loss, g = vg(params_c, micro, i)
+        g_acc = jax.tree.map(
+            lambda a, x: a + x.astype(accum_dtype), g_acc, g)
+
+        def update(_):
+            mean = jax.tree.map(lambda g: g * inv, g_acc)
+            return apply_fn(mean, state_c, params_c)
+
+        params_n, state_n = lax.cond(
+            i == n_micro - 1, update, lambda _: (params_c, state_c), None)
+        return (params_n, state_n,
+                loss_acc + loss.astype(jnp.float32), g_acc), None
+
+    (params, state, loss_sum, _), _ = lax.scan(
+        body, (params, state, jnp.float32(0.0), zeros),
+        (batches, jnp.arange(n_micro, dtype=jnp.int32)))
+    return loss_sum * inv, params, state

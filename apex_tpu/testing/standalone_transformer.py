@@ -182,9 +182,9 @@ class TransformerConfig:
             assert self.heads % self.kv_heads == 0, (
                 f"heads={self.heads} not a multiple of "
                 f"kv_heads={self.kv_heads}")
-            assert self.context_axis is None, (
-                "GQA + ring context parallelism is unsupported "
-                "(flash_attention_with_lse rejects grouped kv)")
+            # GQA + context_axis composes since round 5:
+            # flash_attention_with_lse threads grouped KV through the
+            # kernels' index maps, so the ring path needs no repeated KV
         assert self.loss_chunk is None or (
             isinstance(self.loss_chunk, int)
             and not isinstance(self.loss_chunk, bool)

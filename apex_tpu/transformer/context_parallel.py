@@ -126,6 +126,14 @@ def ulysses_attention(q, k, v, axis: str, *, causal: bool = False,
     c = lax.axis_size(axis)
     assert q.shape[1] % c == 0, (
         f"heads {q.shape[1]} not divisible by context axis size {c}")
+    # GQA passes through (flash_attention shares KV across the group),
+    # but the all-to-all must still split the KV head axis evenly. When
+    # it can't (hkv < ring size, e.g. llama3 8 KV heads on cp=16), use
+    # ring_attention, whose KV rotation never splits the head axis.
+    assert k.shape[1] % c == 0, (
+        f"kv heads {k.shape[1]} not divisible by context axis size {c}; "
+        f"use ring_attention for GQA shapes with fewer kv heads than the "
+        f"context axis")
 
     def to_seq(x):  # [b, h, s_loc, d] -> [b, h/c, s_glob, d]
         return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,

@@ -293,9 +293,11 @@ def main():
             vocab_size=512, seq_len=128, hidden=128, layers=2, heads=4,
             causal=False, dtype=jnp.bfloat16, scan_layers=True, remat=True,
         )
-        # second row exercises the grad-accumulation step path on CPU so
-        # the debug smoke covers both step_body branches
-        plan = [(4, toy, None), (4, toy, 2)]
+        # second/third rows exercise the grad-accumulation and fused
+        # optimizer-in-scan step paths on CPU so the debug smoke covers
+        # all three step_body branches
+        plan = [(4, toy, None, False), (4, toy, 2, False),
+                (4, toy, 2, True)]
     else:
         # BERT-large: 24 x 1024 x 16 heads, seq 512, vocab 30528 (padded)
         from apex_tpu.models import bert_large
@@ -330,24 +332,28 @@ def main():
                 "32@dots,64,96,128,144,128@dots_accum4").split(","):
             b, _, pol = entry.strip().partition("@")
             pol = pol or default_remat
-            # "<policy>_accumN" only when N is a real integer suffix — a
-            # malformed "dots_accum" falls through as a plain policy name
-            # and fails with TransformerConfig's own "unknown
-            # remat_policy" assertion (round-4 advisor finding)
-            m = re.fullmatch(r"(.+)_accum(\d+)", pol)
-            n_accum = None
+            # "<policy>_accumN" / "<policy>_optscanN" only when N is a
+            # real integer suffix — a malformed "dots_accum" falls
+            # through as a plain policy name and fails with
+            # TransformerConfig's own "unknown remat_policy" assertion
+            # (round-4 advisor finding). optscan = accumulation with the
+            # optimizer update fused into the scan's last iteration
+            # (parallel/grad_accum.py::accumulate_and_step)
+            m = re.fullmatch(r"(.+)_(accum|optscan)(\d+)", pol)
+            n_accum, opt_in_scan = None, False
             if m:
-                pol, n_accum = m.group(1), int(m.group(2))
-            plan.append((int(b), mk_cfg(pol), n_accum))
+                pol, n_accum = m.group(1), int(m.group(3))
+                opt_in_scan = m.group(2) == "optscan"
+            plan.append((int(b), mk_cfg(pol), n_accum, opt_in_scan))
 
     mesh = Mesh([dev], ("model",))
     sweep = _SO_FAR["sweep"]  # shared: partial emitters see live appends
     best = None
-    for batch, cfg, n_accum in plan:
+    for batch, cfg, n_accum, opt_in_scan in plan:
         s = cfg.seq_len
         remat_name = cfg.remat_policy if cfg.remat else "none"
         if n_accum:
-            remat_name += f"_accum{n_accum}"
+            remat_name += f"_{'optscan' if opt_in_scan else 'accum'}{n_accum}"
 
         def model_fn(p, tokens, labels, loss_mask, cfg=cfg):
             return bert_loss(p, tokens, labels, loss_mask, cfg)
@@ -367,7 +373,17 @@ def main():
         )
 
         def step_body(params, state, tokens, labels, loss_mask,
-                      n_accum=n_accum):
+                      n_accum=n_accum, opt_in_scan=opt_in_scan):
+            if n_accum and opt_in_scan:
+                from apex_tpu.parallel import accumulate_and_step
+
+                _, params, state = accumulate_and_step(
+                    lambda p, mb: amp.scale_loss(
+                        amp_fn(p, mb["t"], mb["l"], mb["m"]), state),
+                    params, state,
+                    {"t": tokens, "l": labels, "m": loss_mask}, n_accum,
+                    opt.apply_gradients)
+                return params, state
             if n_accum:
                 from apex_tpu.parallel import accumulate_gradients
 

@@ -81,7 +81,16 @@ def main():
         if (s > 2048 and launch_block is None
                 and not _use_streaming(s, s)):
             legs.append((True, f"b512{_family(s)}", "512"))
+        # GQA leg (llama3-style 4:1 grouping): same q geometry, h/4 KV
+        # heads shared via the kernels' index maps. FLOPs are unchanged
+        # (every q head still attends); what this measures is the KV HBM
+        # traffic saving at long context vs the full-head flash row.
+        legs.append((True, "flash-gqa4", launch_block))
         for use, name, block in legs:
+            kk, vv = k, v
+            if name == "flash-gqa4":
+                kk, vv = k[:, : h // 4], v[:, : h // 4]
+
             def g(q, k, v, use=use):
                 def loss(q, k, v):
                     o = flash_attention(q, k, v, causal=True, use_pallas=use)
@@ -91,7 +100,7 @@ def main():
 
             with _pinned_env("APEX_TPU_FLASH_BLOCK", block):
                 try:
-                    sec = timeit(jax.jit(g), q, k, v)
+                    sec = timeit(jax.jit(g), q, kk, vv)
                     print(f"s={s:6d} {name}: {sec*1e3:9.2f} ms  "
                           f"{fl/sec/1e12:6.2f} TFLOP/s", flush=True)
                 except Exception as e:

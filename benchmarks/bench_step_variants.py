@@ -7,6 +7,7 @@ remat policy should be (round-2 verdict items 4/5/7).
 Usage: python benchmarks/bench_step_variants.py [batch] [variants...]
 """
 
+import os
 import sys
 import time
 
@@ -18,7 +19,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def build_step(batch, remat, remat_policy="full", cfg_over=None,
-               n_accum=None):
+               n_accum=None, opt_in_scan=False):
     from apex_tpu import amp
     from apex_tpu.optimizers import fused_lamb
     from apex_tpu.testing import (
@@ -43,6 +44,19 @@ def build_step(batch, remat, remat_policy="full", cfg_over=None,
     mask = jax.random.uniform(jax.random.PRNGKey(3), (batch, s_len)) < 0.15
 
     def step_body(params, state, tokens, labels, loss_mask):
+        if n_accum and opt_in_scan:
+            # optimizer update fused into the accumulation scan's last
+            # iteration (grad_accum.py::accumulate_and_step — A/B of the
+            # region-boundary HBM round-trip vs the plain form)
+            from apex_tpu.parallel import accumulate_and_step
+
+            _, params, state = accumulate_and_step(
+                lambda p, mb: amp.scale_loss(
+                    amp_fn(p, mb["t"], mb["l"], mb["m"]), state),
+                params, state,
+                {"t": tokens, "l": labels, "m": loss_mask}, n_accum,
+                opt.apply_gradients)
+            return params, state
         if n_accum:
             # grad accumulation: micro-batch remat footprint + one step
             # (parallel/grad_accum.py — the dots-at-large-batch lever)
@@ -124,12 +138,25 @@ def main():
         "flash_b128": ([], "full"),
         "flash_b256": ([], "full"),
         "flash_b512": ([], "full"),
+        # backward-ONLY block A/B (APEX_TPU_FLASH_BLOCK_BWD): the fused
+        # bwd holds dq + dk/dv accumulators + the recomputed score tile
+        # per grid step, so its VMEM-optimal block can differ from the
+        # forward's 512 default (round-4 verdict Weak #1 ladder rung)
+        "bwd_b128": ([], "full"),
+        "bwd_b256": ([], "full"),
+        "bwd_b384": ([], "full"),
     }
     import re
+    ambient_bwd_block = os.environ.get("APEX_TPU_FLASH_BLOCK_BWD")
     for name in which:
-        # any "<policy>_accumN" (N arbitrary) resolves generically so the
-        # batteries can probe accumulation factors without a dict edit
-        m = re.fullmatch(r"(dots|full|flash)_accum(\d+)", name)
+        # any "<policy>_accumN" / "<policy>_optscanN" (N arbitrary)
+        # resolves generically so the batteries can probe accumulation
+        # factors and the fused-optimizer-in-scan A/B without dict edits;
+        # "none" = no remat at the micro batch (fits only at tiny micros,
+        # but under accumulation that's exactly the point)
+        m = re.fullmatch(
+            r"(dots|full|flash|none|dots_flash|flash_offload)"
+            r"_(accum|optscan)(\d+)", name)
         if m:
             disable, remat_mode = [], m.group(1)
         else:
@@ -139,24 +166,32 @@ def main():
             _utils.enable_kernel(k)
         for k in disable:
             _utils.disable_kernel(k)
-        import os as _os
-        _os.environ.pop("APEX_TPU_FLASH_SPLIT_BWD", None)
-        _os.environ.pop("APEX_TPU_FLASH_BLOCK", None)
+        os.environ.pop("APEX_TPU_FLASH_SPLIT_BWD", None)
+        os.environ.pop("APEX_TPU_FLASH_BLOCK", None)
+        # restore (not pop) the ambient bwd-block so batteries can pin it
+        # process-wide: env APEX_TPU_FLASH_BLOCK_BWD=256 ... dots_accum4
+        if ambient_bwd_block is None:
+            os.environ.pop("APEX_TPU_FLASH_BLOCK_BWD", None)
+        else:
+            os.environ["APEX_TPU_FLASH_BLOCK_BWD"] = ambient_bwd_block
         if name == "split_bwd":
-            _os.environ["APEX_TPU_FLASH_SPLIT_BWD"] = "1"
-        if name.startswith("flash_b"):
-            _os.environ["APEX_TPU_FLASH_BLOCK"] = name[len("flash_b"):]
+            os.environ["APEX_TPU_FLASH_SPLIT_BWD"] = "1"
+        if name.startswith("bwd_b"):  # backward-only block A/B
+            os.environ["APEX_TPU_FLASH_BLOCK_BWD"] = name[len("bwd_b"):]
+        elif name.startswith("flash_b"):
+            os.environ["APEX_TPU_FLASH_BLOCK"] = name[len("flash_b"):]
         cfg_over = {"fp32_logits": True} if name == "fp32_logits" else None
         if name in ("chunked_loss", "flashsave_chunked", "dots_chunked"):
             cfg_over = {"loss_chunk": 8192}
         if name.startswith("attn_dropout"):
             cfg_over = {"attn_dropout_p": 0.1}
-        n_accum = (int(name.rsplit("accum", 1)[1])
-                   if "accum" in name else None)
+        n_accum = int(m.group(3)) if m else None
+        opt_in_scan = bool(m and m.group(2) == "optscan")
         try:
             step, args = build_step(batch, remat=remat_mode != "none",
                                     remat_policy=remat_mode,
-                                    cfg_over=cfg_over, n_accum=n_accum)
+                                    cfg_over=cfg_over, n_accum=n_accum,
+                                    opt_in_scan=opt_in_scan)
             ms = run(step, args)
             print(f"{name:14s} remat={remat_mode:5s}: {ms:8.1f} ms/step  "
                   f"{batch/ms*1e3:6.1f} samples/s", flush=True)

@@ -1,0 +1,45 @@
+#!/bin/bash
+# Round-5 battery: the MFU ladder PAST accum4 (round-4 verdict Next #2 /
+# Weak #1 — "arrive at the window with the whole ladder scripted"), in
+# value order after battery8's queue:
+#   1. accumulation-factor sweep at effective b128 (8 x b16, 2 x b64,
+#      no-remat micros under accumulation)
+#   2. optimizer-in-scan A/B (accumulate_and_step vs plain accum)
+#   3. backward-only flash block A/B, alone and composed with accum
+#   4. GQA long-context rows (the new flash-gqa4 leg) + the standalone-
+#      shape 512-vs-256 rule check at s=2048
+#   5. full tests/tpu tier to all-green in ONE session (verdict Next #5)
+#   6. a final bench.py dry-run so the driver's round-end invocation hits
+#      a warm cache whatever ran last
+# Chained by run_supervisor_r5.sh after battery8 completes; resume-safe
+# via success markers (_battery_lib.sh).
+set -u
+cd "$(dirname "$0")/.."
+LOGDIR="${1:-benchmarks/logs_r5}"
+mkdir -p "$LOGDIR"
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}"
+BATTERY_NAME=battery9
+. benchmarks/_battery_lib.sh
+
+log "battery9 queue starting (tunnel gate per item)"
+
+# 1 — accumulation factors at effective batch 128
+run accumfac_b128 3600 'samples/s' python benchmarks/bench_step_variants.py 128 \
+                       dots_accum8 dots_accum2 none_accum8 none_accum4
+# 2 — optimizer fused into the scan's last iteration, A/B'd in-session
+#     against the plain form at the same operating point
+run optscan_b128  3000 'samples/s' python benchmarks/bench_step_variants.py 128 \
+                       dots_optscan4 dots_accum4
+# 3 — backward-only block tuning (fwd keeps the measured 512 default)
+run bwdblock_b128 3600 'samples/s' python benchmarks/bench_step_variants.py 128 \
+                       bwd_b256 bwd_b128 bwd_b384
+#     ... composed with the accum candidate
+run accum_bwd256  2400 'samples/s' env APEX_TPU_FLASH_BLOCK_BWD=256 \
+                       python benchmarks/bench_step_variants.py 128 dots_accum4
+# 4 — GQA long-context rows + the suspect s=2048 block rule
+run lc_gqa        2400 'TFLOP/s' python benchmarks/bench_long_context.py 2048 8192
+# 5 — the WHOLE tpu tier in one invocation (19/19 + 5/5 goal)
+run tpu_full      3600 ' passed' env APEX_TPU_HW=1 python -m pytest tests/tpu -v
+# 6 — warm the driver's exact path last
+run bench_warm    7200 '"ok": true' python bench.py
+log "battery9 complete"

@@ -144,10 +144,10 @@ def test_llama_presets_exposed():
     assert c2.kv_heads == 0 and c2.hidden == 4096
     c3 = llama3_8b()
     assert c3.kv_heads == 8 and c3.vocab_size == 128256
-    # GQA + CP is rejected at config time
-    import pytest
-    with pytest.raises(AssertionError, match="ring context"):
-        llama3_8b(context_axis="context")
+    # GQA + CP composes since round 5 (the preset's actual long-context
+    # deployment shape): the config accepts a context axis with grouped KV
+    c3cp = llama3_8b(context_axis="context")
+    assert c3cp.kv_heads == 8 and c3cp.context_axis == "context"
 
 
 def test_gqa_tp_wider_than_kv_heads_fails_loudly():

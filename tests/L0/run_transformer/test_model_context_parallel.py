@@ -79,6 +79,42 @@ def test_gpt_cp_loss_and_grad_parity(eight_cpu_devices):
         grads_cp, grads_ref)
 
 
+def test_gpt_gqa_cp_loss_and_grad_parity(eight_cpu_devices):
+    """GQA + ring context parallelism at the MODEL level (the llama3-
+    family shape, unblocked round 5): grouped-KV GPT with the sequence
+    ring-sharded must match the single-device grouped-KV model, loss and
+    grads."""
+    mesh = _mesh(eight_cpu_devices)
+    cfg_cp = _cfg(causal=True, context_axis="context", kv_heads=2)
+    cfg_ref = _cfg(causal=True, kv_heads=2)
+    params = transformer_init(jax.random.PRNGKey(0), cfg_ref)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, 128)
+
+    def body(params, tokens):
+        loss = gpt_loss(params, tokens, cfg_cp)
+        grads = jax.grad(lambda p: gpt_loss(p, tokens, cfg_cp))(params)
+        grads = jax.tree.map(lambda g: jax.lax.pmean(g, "context"), grads)
+        return loss, grads
+
+    pspec = jax.tree.map(lambda _: P(), params)
+    loss_cp, grads_cp = jax.jit(smap(
+        body, mesh, (pspec, P(None, "context")), (P(), pspec)))(
+            params, tokens)
+
+    ref_mesh = Mesh(np.array(eight_cpu_devices[:1]), ("model",))
+    loss_ref, grads_ref = jax.jit(smap(
+        lambda p, t: (gpt_loss(p, t, cfg_ref),
+                      jax.grad(lambda q: gpt_loss(q, t, cfg_ref))(p)),
+        ref_mesh, (pspec, P()), (P(), pspec)))(params, tokens)
+
+    np.testing.assert_allclose(float(loss_cp), float(loss_ref),
+                               rtol=1e-5, atol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5),
+        grads_cp, grads_ref)
+
+
 def test_bert_cp_loss_parity(eight_cpu_devices):
     mesh = _mesh(eight_cpu_devices)
     cfg_cp = _cfg(causal=False, context_axis="context")

@@ -92,6 +92,75 @@ def test_amp_o2_accumulated_step_matches_oneshot():
     assert int(s1.skipped_steps) == int(s2.skipped_steps) == 0
 
 
+def test_optimizer_in_scan_matches_accumulate_then_apply():
+    """accumulate_and_step (optimizer update fused into the scan's final
+    iteration — the region-boundary lever for the accum ladder) must be
+    step-equivalent to accumulate_gradients + apply_gradients: identical
+    params, optimizer state, and scaler transitions."""
+    from apex_tpu import amp
+    from apex_tpu.optimizers import fused_lamb
+    from apex_tpu.parallel import accumulate_and_step
+
+    params, batch = _setup()
+
+    def model_fn(p, b):
+        return _loss(p, b)
+
+    amp_fn, aparams, opt = amp.initialize(
+        model_fn, params, fused_lamb(0.1), opt_level="O2", verbosity=0)
+    state = opt.init(aparams)
+
+    def plain(p, s, b):
+        loss, g = accumulate_gradients(
+            lambda q, mb: amp.scale_loss(amp_fn(q, mb), s), p, b, 4)
+        p2, s2 = opt.apply_gradients(g, s, p)
+        return loss, p2, s2
+
+    def fused(p, s, b):
+        return accumulate_and_step(
+            lambda q, mb: amp.scale_loss(amp_fn(q, mb), s), p, s, b, 4,
+            opt.apply_gradients)
+
+    l1, p1, s1 = jax.jit(plain)(aparams, state, batch)
+    l2, p2, s2 = jax.jit(fused)(aparams, state, batch)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b_ in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b_, np.float32),
+                                   rtol=1e-6, atol=1e-7)
+    assert int(s1.skipped_steps) == int(s2.skipped_steps) == 0
+
+
+def test_optimizer_in_scan_preserves_step_skip():
+    """The scaler's found-inf skip must survive the cond-fused update: a
+    poisoned microbatch leaves params untouched and counts one skip."""
+    from apex_tpu import amp
+    from apex_tpu.optimizers import fused_sgd
+    from apex_tpu.parallel import accumulate_and_step
+
+    params, batch = _setup()
+    bad = dict(batch)
+    bad["x"] = batch["x"].at[5].set(jnp.inf)
+
+    def model_fn(p, b):
+        return _loss(p, b)
+
+    amp_fn, aparams, opt = amp.initialize(
+        model_fn, params, fused_sgd(0.1), opt_level="O2", verbosity=0)
+    state = opt.init(aparams)
+
+    def fused(p, s, b):
+        return accumulate_and_step(
+            lambda q, mb: amp.scale_loss(amp_fn(q, mb), s), p, s, b, 4,
+            opt.apply_gradients)
+
+    _, p2, s2 = jax.jit(fused)(aparams, state, bad)
+    assert int(s2.skipped_steps) == 1
+    for a, b_ in zip(jax.tree.leaves(p2), jax.tree.leaves(aparams)):
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b_, np.float32))
+
+
 def test_inf_microbatch_trips_step_skip():
     from apex_tpu import amp
     from apex_tpu.optimizers import fused_sgd
