@@ -323,13 +323,15 @@ def main():
         # micro-batch memory footprint, full-batch optimizer amortization.
         # default sweep: 32@dots first (best-known per-sample point — a
         # truncated sweep still reports it), then the full-remat curve,
-        # and LAST the unproven grad-accumulation candidate (4 x b32(dots)
-        # at b128, projected to beat b128 full remat) so a hang on it
-        # cannot truncate the established rows
+        # and LAST the unproven candidates (grad accumulation 4 x
+        # b32(dots) at b128, projected to beat b128 full remat, then its
+        # optimizer-in-scan variant) so a hang on either cannot truncate
+        # the established rows
         plan = []
         for entry in os.environ.get(
                 "BENCH_BATCHES",
-                "32@dots,64,96,128,144,128@dots_accum4").split(","):
+                "32@dots,64,96,128,144,128@dots_accum4,"
+                "128@dots_optscan4").split(","):
             b, _, pol = entry.strip().partition("@")
             pol = pol or default_remat
             # "<policy>_accumN" / "<policy>_optscanN" only when N is a

@@ -33,13 +33,20 @@ wait_tunnel() {
   return 1
 }
 
-# Success = the item's log holds at least one measurement row (OK_PATTERN)
-# and no failure row. The failure grep covers the bench scripts' "FAILED"
-# rows and pytest's "N failed" summary.
+# Success = the item's log holds enough measurement rows (OK_PATTERN,
+# optionally "N:pattern" to require >= N rows — a multi-variant item
+# killed by its timeout mid-list must not read as measured off its
+# earlier variants' rows) and no failure row. The failure grep covers
+# the bench scripts' "FAILED" rows and pytest's "N failed" summary.
 ok_marker() {
-  local name="$1" pat="$2"
+  local name="$1" pat="$2" want=1
+  case "$pat" in
+    [0-9]*:*) want="${pat%%:*}"; pat="${pat#*:}" ;;
+  esac
   [ -f "$LOGDIR/$name.log" ] || return 1
-  grep -qE "$pat" "$LOGDIR/$name.log" || return 1
+  local got
+  got=$(grep -cE "$pat" "$LOGDIR/$name.log" 2>/dev/null || true)
+  [ "${got:-0}" -ge "$want" ] || return 1
   if grep -qE '(^|[^A-Za-z])FAILED|[0-9]+ failed' "$LOGDIR/$name.log"; then
     return 1
   fi

@@ -156,7 +156,7 @@ def main():
         # but under accumulation that's exactly the point)
         m = re.fullmatch(
             r"(dots|full|flash|none|dots_flash|flash_offload)"
-            r"_(accum|optscan)(\d+)", name)
+            r"(_chunked)?_(accum|optscan)(\d+)", name)
         if m:
             disable, remat_mode = [], m.group(1)
         else:
@@ -181,12 +181,13 @@ def main():
         elif name.startswith("flash_b"):
             os.environ["APEX_TPU_FLASH_BLOCK"] = name[len("flash_b"):]
         cfg_over = {"fp32_logits": True} if name == "fp32_logits" else None
-        if name in ("chunked_loss", "flashsave_chunked", "dots_chunked"):
+        if name in ("chunked_loss", "flashsave_chunked", "dots_chunked") \
+                or (m and m.group(2)):  # "<policy>_chunked_accumN" combos
             cfg_over = {"loss_chunk": 8192}
         if name.startswith("attn_dropout"):
             cfg_over = {"attn_dropout_p": 0.1}
-        n_accum = int(m.group(3)) if m else None
-        opt_in_scan = bool(m and m.group(2) == "optscan")
+        n_accum = int(m.group(4)) if m else None
+        opt_in_scan = bool(m and m.group(3) == "optscan")
         try:
             step, args = build_step(batch, remat=remat_mode != "none",
                                     remat_policy=remat_mode,

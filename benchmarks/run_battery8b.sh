@@ -21,7 +21,7 @@ log "tunnel is back"
 
 # 1 — the MFU lever: b128 as 4 x b32(dots) + the accumulation-overhead
 #     control; then the neighboring operating points
-run accum_b128   3000 'samples/s' python benchmarks/bench_step_variants.py 128 \
+run accum_b128   3000 '2:samples/s' python benchmarks/bench_step_variants.py 128 \
                       dots_accum4 full_accum4
 run accum_b160   2400 'samples/s' python benchmarks/bench_step_variants.py 160 dots_accum5
 run accum_b64    2400 'samples/s' python benchmarks/bench_step_variants.py 64 dots_accum2

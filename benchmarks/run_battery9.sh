@@ -24,14 +24,18 @@ BATTERY_NAME=battery9
 log "battery9 queue starting (tunnel gate per item)"
 
 # 1 — accumulation factors at effective batch 128
-run accumfac_b128 3600 'samples/s' python benchmarks/bench_step_variants.py 128 \
+run accumfac_b128 3600 '4:samples/s' python benchmarks/bench_step_variants.py 128 \
                        dots_accum8 dots_accum2 none_accum8 none_accum4
+#     ... chunked-loss composition as its OWN item (own success marker:
+#     a timeout mid-item must not read as measured via earlier variants)
+run accumchunk_b128 1800 'samples/s' python benchmarks/bench_step_variants.py 128 \
+                       dots_chunked_accum4
 # 2 — optimizer fused into the scan's last iteration, A/B'd in-session
 #     against the plain form at the same operating point
-run optscan_b128  3000 'samples/s' python benchmarks/bench_step_variants.py 128 \
+run optscan_b128  3000 '2:samples/s' python benchmarks/bench_step_variants.py 128 \
                        dots_optscan4 dots_accum4
 # 3 — backward-only block tuning (fwd keeps the measured 512 default)
-run bwdblock_b128 3600 'samples/s' python benchmarks/bench_step_variants.py 128 \
+run bwdblock_b128 3600 '3:samples/s' python benchmarks/bench_step_variants.py 128 \
                        bwd_b256 bwd_b128 bwd_b384
 #     ... composed with the accum candidate
 run accum_bwd256  2400 'samples/s' env APEX_TPU_FLASH_BLOCK_BWD=256 \

@@ -165,6 +165,24 @@ def test_ring_gqa_parity(eight_cpu_devices, causal):
                                    atol=3e-5, rtol=3e-5)
 
 
+def test_ulysses_gqa_parity_when_kv_heads_divide(eight_cpu_devices):
+    """GQA passes through Ulysses when the KV head axis splits over the
+    context axis (8 q heads, 4 kv heads, axis 4 — group 2 survives the
+    all_to_all re-shard): parity vs single-device GQA."""
+    hq, hkv = 8, 4
+    mesh = _mesh(eight_cpu_devices)
+    ks = jax.random.split(jax.random.PRNGKey(13), 3)
+    q = jax.random.normal(ks[0], (B, hq, S, D))
+    k = jax.random.normal(ks[1], (B, hkv, S, D))
+    v = jax.random.normal(ks[2], (B, hkv, S, D))
+    got = _run_sharded(
+        lambda q, k, v: ulysses_attention(q, k, v, "context", causal=True),
+        mesh, q, k, v)
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_ulysses_rejects_indivisible_kv_heads(eight_cpu_devices):
     """Ulysses must fail loudly (not read garbage) when the KV head axis
     cannot split over the context axis — the documented boundary where
