@@ -42,6 +42,8 @@ run accum_bwd256  2400 'samples/s' env APEX_TPU_FLASH_BLOCK_BWD=256 \
                        python benchmarks/bench_step_variants.py 128 dots_accum4
 # 4 — GQA long-context rows + the suspect s=2048 block rule
 run lc_gqa        2400 'TFLOP/s' python benchmarks/bench_long_context.py 2048 8192
+#     ... and the llama-style GQA long-context model step (new example)
+run ex_llama_gqa  2400 '"metric":' python examples/llama_gqa_cp.py --bench
 # 5 — the WHOLE tpu tier in one invocation (19/19 + 5/5 goal)
 run tpu_full      3600 ' passed' env APEX_TPU_HW=1 python -m pytest tests/tpu -v
 # 6 — warm the driver's exact path last

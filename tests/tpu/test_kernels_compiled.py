@@ -265,6 +265,40 @@ def test_flash_gqa_compiled(dtype):
     assert _md(g[1], rdk) < 0.1
 
 
+@pytest.mark.parametrize("dtype", [jnp.bfloat16])
+def test_flash_gqa_lse_compiled(dtype):
+    """GQA through flash_attention_with_lse compiled by Mosaic (round 5:
+    the ring/context-parallel building block with grouped KV — the
+    llama3 long-context shape). o, lse, and grads incl. the lse
+    cotangent must match the repeated-KV computation."""
+    from apex_tpu.ops.attention import flash_attention_with_lse
+
+    b, hq, hkv, s, d = 1, 8, 2, 512, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, hq, s, d), dtype)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, hkv, s, d), dtype)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, hkv, s, d), dtype)
+    do = jax.random.normal(jax.random.PRNGKey(3), (b, hq, s, d), dtype)
+    w = jax.random.normal(jax.random.PRNGKey(4), (b, hq, s), jnp.float32)
+    k_rep = jnp.repeat(k, hq // hkv, axis=1)
+    v_rep = jnp.repeat(v, hq // hkv, axis=1)
+
+    def f(q, k, v):
+        o, lse = flash_attention_with_lse(q, k, v, causal=True,
+                                          use_pallas=True)
+        return jnp.vdot(lse, w) + jnp.vdot(o.astype(jnp.float32),
+                                           do.astype(jnp.float32))
+
+    val, g = jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2)))(q, k, v)
+    rval, rg = jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2)))(
+        q, k_rep, v_rep)
+    assert abs(float(val) - float(rval)) < 0.5
+    assert _md(g[0], rg[0]) < 0.05
+    rdk = rg[1].reshape(b, hkv, hq // hkv, s, d).sum(2)
+    rdv = rg[2].reshape(b, hkv, hq // hkv, s, d).sum(2)
+    assert _md(g[1], rdk) < 0.1
+    assert _md(g[2], rdv) < 0.1
+
+
 def test_preflight_all_green():
     """On hardware every family must pass its probe; this is the regression
     gate for 'a kernel that lowers today keeps lowering tomorrow'."""
