@@ -44,6 +44,11 @@ run accum_bwd256  2400 'samples/s' env APEX_TPU_FLASH_BLOCK_BWD=256 \
 run lc_gqa        2400 'TFLOP/s' python benchmarks/bench_long_context.py 2048 8192
 #     ... and the llama-style GQA long-context model step (new example)
 run ex_llama_gqa  2400 '"metric":' python examples/llama_gqa_cp.py --bench
+#     ... s=2048 is the ONE shape where flash loses to unfused (1.92 vs
+#     3.01 TFLOP/s, BASELINE.md) — try the streaming family there, which
+#     the router never picks below 4096
+run lc2048_stream 1800 'TFLOP/s' env APEX_TPU_FLASH_STREAM=1 \
+                       python benchmarks/bench_long_context.py 2048
 # 5 — the WHOLE tpu tier in one invocation (19/19 + 5/5 goal)
 run tpu_full      3600 ' passed' env APEX_TPU_HW=1 python -m pytest tests/tpu -v
 # 6 — warm the driver's exact path last
