@@ -260,6 +260,46 @@ def test_ddp_composition_one_psum_per_step():
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_optscan_composes_with_ddp_psum():
+    """accumulate_and_step inside shard_map with a DDP-reducing apply_fn
+    (the multi-chip shape of the optscan bench candidate): the psum runs
+    inside the scan's lax.cond, which is safe because the predicate is
+    the trace-uniform microbatch index — result equals accumulate +
+    reduce + apply outside the cond."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel import (
+        DistributedDataParallel, accumulate_and_step)
+    from apex_tpu.parallel.mesh import cpu_mesh
+    from apex_tpu.testing.commons import smap
+
+    params, batch = _setup(b=16)
+    mesh = cpu_mesh({"data": 2})
+    ddp = DistributedDataParallel(axis_name="data")
+
+    def sgd_apply(grads, state, p):
+        g = ddp.allreduce_gradients(grads)   # collective inside the cond
+        return jax.tree.map(lambda w, gg: w - 0.1 * gg, p, g), state
+
+    def fused(p, b):
+        _, p2, _ = accumulate_and_step(_loss, p, None, b, 2, sgd_apply)
+        return p2
+
+    def plain(p, b):
+        _, g = accumulate_gradients(_loss, p, b, 2)
+        g = ddp.allreduce_gradients(g)
+        return jax.tree.map(lambda w, gg: w - 0.1 * gg, p, g)
+
+    pspec = jax.tree.map(lambda _: P(), params)
+    p_f = jax.jit(smap(fused, mesh, (pspec, P("data")), pspec))(
+        params, batch)
+    p_p = jax.jit(smap(plain, mesh, (pspec, P("data")), pspec))(
+        params, batch)
+    for a, r in zip(jax.tree.leaves(p_f), jax.tree.leaves(p_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-6, atol=1e-7)
+
+
 def test_transformer_dots_accum_matches_full_remat_grads():
     """The production composition: standalone transformer, dots remat per
     microbatch, 2 x b4 accumulation == b8 one-shot full-remat grads.
