@@ -49,6 +49,9 @@ run ex_llama_gqa  2400 '"metric":' python examples/llama_gqa_cp.py --bench
 #     the router never picks below 4096
 run lc2048_stream 1800 'TFLOP/s' env APEX_TPU_FLASH_STREAM=1 \
                        python benchmarks/bench_long_context.py 2048
+# (NO XLA_FLAGS vmem probe: --xla_tpu_scoped_vmem_limit_kib is NOT a
+#  client-side flag in this stack — battery5 already hit the
+#  parse-error, BASELINE.md kernel-decisions note; don't re-burn it.)
 # 5 — the WHOLE tpu tier in one invocation (19/19 + 5/5 goal)
 run tpu_full      3600 ' passed' env APEX_TPU_HW=1 python -m pytest tests/tpu -v
 # 6 — warm the driver's exact path last

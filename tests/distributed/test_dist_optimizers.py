@@ -38,6 +38,17 @@ def _grads(seed=2):
     )
 
 
+def _mlp_loss(p, mb):
+    """Shared batch-loss fixture for the accumulation-composition tests."""
+    h = jnp.tanh(mb["x"] @ p["dense"]["kernel"] + p["dense"]["bias"])
+    return jnp.mean((h @ p["out"] - mb["y"]) ** 2)
+
+
+def _mlp_batch():
+    return {"x": jax.random.normal(jax.random.PRNGKey(3), (8 * N, 13)),
+            "y": jax.random.normal(jax.random.PRNGKey(4), (8 * N, 3))}
+
+
 def _run_dist(opt_cls, steps=3, **kw):
     mesh = _mesh()
     params = _params()
@@ -308,14 +319,7 @@ def test_zero_step_on_accumulated_gradients():
 
     mesh = _mesh()
     params = _params()
-
-    def loss_fn(p, mb):
-        h = jnp.tanh(mb["x"] @ p["dense"]["kernel"] + p["dense"]["bias"])
-        return jnp.mean((h @ p["out"] - mb["y"]) ** 2)
-
-    kx = jax.random.PRNGKey(3)
-    batch = {"x": jax.random.normal(kx, (8 * N, 13)),
-             "y": jax.random.normal(jax.random.PRNGKey(4), (8 * N, 3))}
+    loss_fn, batch = _mlp_loss, _mlp_batch()
 
     opt = DistributedFusedAdam(learning_rate=1e-2, axis_name="data")
     opt.prepare(params, N)
@@ -340,3 +344,39 @@ def test_zero_step_on_accumulated_gradients():
             for a, r in zip(jax.tree.leaves(out), jax.tree.leaves(ref)):
                 np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                            rtol=1e-6, atol=1e-7)
+
+
+def test_zero_step_inside_accumulation_scan():
+    """accumulate_and_step with the ZeRO-2 step as its apply_fn: the
+    optimizer's reduce-scatter + allgather run inside the scan's final
+    lax.cond (trace-uniform predicate, so the collectives stay uniform
+    across ranks) — result equals accumulate_gradients + opt.step."""
+    from apex_tpu.parallel import accumulate_and_step, accumulate_gradients
+
+    mesh = _mesh()
+    params = _params()
+    loss_fn, batch = _mlp_loss, _mlp_batch()
+
+    opt = DistributedFusedAdam(learning_rate=1e-2, axis_name="data")
+    opt.prepare(params, N)
+
+    def fused(params, batch):
+        state = opt.init_shard(params)
+        _, p2, _ = accumulate_and_step(
+            loss_fn, params, state, batch, 4,
+            lambda g, s, p: opt.step(p, g, s))
+        return p2
+
+    def plain(params, batch):
+        state = opt.init_shard(params)
+        _, grads = accumulate_gradients(loss_fn, params, batch, 4)
+        p2, _ = opt.step(params, grads, state)
+        return p2
+
+    p_f = jax.jit(shard_map(fused, mesh=mesh, in_specs=(P(), P("data")),
+                            out_specs=P()))(params, batch)
+    p_p = jax.jit(shard_map(plain, mesh=mesh, in_specs=(P(), P("data")),
+                            out_specs=P()))(params, batch)
+    for a, r in zip(jax.tree.leaves(p_f), jax.tree.leaves(p_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-6, atol=1e-7)
