@@ -23,9 +23,19 @@ assert d and d[0].platform == 'tpu', d
 " > /dev/null 2>&1
 }
 
+# Round-end stand-down: when this file exists, batteries stop taking new
+# items and their tunnel gates exit — the single-claim tunnel must be
+# FREE for the driver's round-end bench.py run (a battery mid-item would
+# starve it into ok:false, the exact failure four rounds running).
+STOP_FILE="benchmarks/STOP_BATTERIES"
+
 wait_tunnel() {
   local polls="${1:-20}"
   for i in $(seq 1 "$polls"); do
+    if [ -f "$STOP_FILE" ]; then
+      log "STOP_BATTERIES present; standing down for the driver"
+      exit 0
+    fi
     if probe_ok; then return 0; fi
     log "tunnel probe $i/$polls failed; sleeping 120s"
     sleep 120
@@ -55,6 +65,10 @@ ok_marker() {
 
 run() {
   local name="$1" t="$2" pat="$3"; shift 3
+  if [ -f "$STOP_FILE" ]; then
+    log "STOP_BATTERIES present; standing down before $name"
+    exit 0
+  fi
   if ok_marker "$name" "$pat"; then
     log "SKIP  $name (success marker '$pat' present)"
     return 0

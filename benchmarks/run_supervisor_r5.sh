@@ -5,11 +5,29 @@
 # round: it keeps exactly ONE battery running at a time (single-claim
 # tunnel), relaunches the resume-capable battery8b whenever the queue is
 # incomplete, then chains battery9 (round-5 ladder extensions) the same
-# way. Launch with: setsid nohup bash benchmarks/run_supervisor_r5.sh &
+# way. At DEADLINE it stands every battery down so the driver's
+# round-end bench.py owns the tunnel.
+# Launch with: setsid nohup bash benchmarks/run_supervisor_r5.sh &
 set -u
 cd "$(dirname "$0")/.."
 SLOG=benchmarks/logs_r5_supervisor.log
 log() { echo "[sup $(date -u +%H:%M:%S)] $*" >> "$SLOG"; }
+
+STOP_FILE="benchmarks/STOP_BATTERIES"
+# 2026-08-01 03:25 UTC — ~20-55 min before the driver's round-end bench
+DEADLINE=1785554700
+
+# A supervisor started at/after the deadline has nothing to supervise —
+# and must NOT fire the stand-down pkills (the driver's own bench.py may
+# be the very process a post-deadline pkill would hit).
+if [ "$(date -u +%s)" -ge "$DEADLINE" ]; then
+  touch "$STOP_FILE"
+  log "started past DEADLINE; wrote STOP file and exiting (no pkills)"
+  exit 0
+fi
+# pre-deadline start: clear any stale stand-down from a previous run so
+# batteries are not silently no-op'd for the whole round
+rm -f "$STOP_FILE"
 
 # Single-instance lock: a second launch (e.g. the original presumed dead
 # mid-sleep) must not race the check-then-launch window into two
@@ -20,14 +38,46 @@ if ! flock -n 9; then
   exit 0
 fi
 
+# Round-end stand-down watchdog. Runs with the lock fd CLOSED (an
+# orphaned watchdog must never hold the supervisor lock). Only a
+# watchdog born BEFORE the deadline fires the pkills, and it fires once.
+(
+  exec 9>&-
+  while :; do
+    if [ "$(date -u +%s)" -ge "$DEADLINE" ]; then
+      touch "$STOP_FILE"
+      pkill -f "run_battery8b.sh" 2>/dev/null
+      pkill -f "run_battery8.sh" 2>/dev/null
+      pkill -f "run_battery9.sh" 2>/dev/null
+      # every battery item class: bench drivers, examples, the battery's
+      # own bench.py dryrun/warm legs, the tpu pytest tier
+      pkill -f "bench_step_variants|bench_long_context|bench_optim_kernels|bench_ops|bench_components" 2>/dev/null
+      pkill -f "python examples/" 2>/dev/null
+      pkill -f "python bench.py" 2>/dev/null
+      pkill -f "pytest tests/tpu" 2>/dev/null
+      echo "[sup $(date -u +%H:%M:%S)] DEADLINE: batteries stood down, tunnel freed for the driver" >> "$SLOG"
+      exit 0
+    fi
+    sleep 60
+  done
+) &
+WATCHDOG=$!
+trap 'kill "$WATCHDOG" 2>/dev/null' EXIT
+
+stood_down() { [ -f "$STOP_FILE" ] || [ "$(date -u +%s)" -ge "$DEADLINE" ]; }
+
 wait_for_pid() {
-  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+  while kill -0 "$1" 2>/dev/null; do
+    sleep 60
+    if stood_down; then return 0; fi
+  done
 }
 
 # Phase 1: battery8 queue to completion (the original instance from
 # round 4 may still be in its outage gate — let it finish first).
 B8LOG=benchmarks/logs_r4i/battery.log
 while ! grep -q "battery8 complete" "$B8LOG" 2>/dev/null; do
+  if stood_down; then log "stand-down active; supervisor exiting"; exit 0; fi
   pid=$(pgrep -f "run_battery8b?.sh" | head -1)
   if [ -n "${pid:-}" ]; then
     log "battery8 instance running (pid $pid); waiting"
@@ -35,20 +85,16 @@ while ! grep -q "battery8 complete" "$B8LOG" 2>/dev/null; do
   else
     log "battery8 queue incomplete and no instance running; relaunching battery8b"
     bash benchmarks/run_battery8b.sh benchmarks/logs_r4i \
-      >> benchmarks/logs_r4i_nohup.log 2>&1 || true
+      >> benchmarks/logs_r4i_nohup.log 2>&1 9>&- || true
     sleep 30
   fi
 done
 log "battery8 queue complete"
 
-# Phase 2: battery9 (written during round 5; wait for it to appear).
+# Phase 2: battery9 (round-5 ladder extensions).
 B9LOG=benchmarks/logs_r5/battery.log
 while ! grep -q "battery9 complete" "$B9LOG" 2>/dev/null; do
-  if [ ! -f benchmarks/run_battery9.sh ]; then
-    log "battery9 not written yet; sleeping"
-    sleep 300
-    continue
-  fi
+  if stood_down; then log "stand-down active; supervisor exiting"; exit 0; fi
   pid=$(pgrep -f "run_battery9.sh" | head -1)
   if [ -n "${pid:-}" ]; then
     log "battery9 running (pid $pid); waiting"
@@ -56,7 +102,7 @@ while ! grep -q "battery9 complete" "$B9LOG" 2>/dev/null; do
   else
     log "battery9 queue incomplete and no instance running; (re)launching"
     bash benchmarks/run_battery9.sh benchmarks/logs_r5 \
-      >> benchmarks/logs_r5_nohup.log 2>&1 || true
+      >> benchmarks/logs_r5_nohup.log 2>&1 9>&- || true
     sleep 30
   fi
 done
