@@ -1,0 +1,75 @@
+"""Summarize battery logs into markdown rows for BASELINE.md.
+
+Scans the battery log directories for the three result shapes the
+batteries emit — bench_step_variants ``<name> remat=<p>: X ms/step Y
+samples/s`` rows, bench JSON lines (``"metric": ...``), and
+bench_long_context ``s=N <leg>: X ms Y TFLOP/s`` rows — and prints one
+markdown table per battery item, FAILED rows included (a classified
+failure is a result). Run after any tunnel window:
+
+    python benchmarks/harvest.py [logdir ...]
+"""
+
+import json
+import re
+import sys
+from pathlib import Path
+
+ROW = re.compile(
+    r"^(?P<name>\S+)\s+remat=(?P<remat>\S+)\s*:\s+(?P<ms>[\d.]+) ms/step\s+"
+    r"(?P<sps>[\d.]+) samples/s")
+LC = re.compile(
+    r"^s=\s*(?P<s>\d+) (?P<leg>\S+)\s*:\s+(?P<ms>[\d.]+) ms\s+"
+    r"(?P<tf>[\d.]+) TFLOP/s")
+FAIL = re.compile(r"^(?P<name>.*?):?\s*FAILED\s*\(?(?P<msg>.*?)\)?\s*$")
+
+
+def harvest(logdir: Path):
+    items = sorted(p for p in logdir.glob("*.log") if p.name != "battery.log")
+    for item in items:
+        rows = []
+        for line in item.read_text(errors="replace").splitlines():
+            m = ROW.match(line)
+            if m:
+                rows.append(f"| {m['name']} | {m['remat']} | {m['ms']} ms "
+                            f"| {m['sps']} samples/s |")
+                continue
+            m = LC.match(line)
+            if m:
+                rows.append(f"| s={m['s']} {m['leg']} | — | {m['ms']} ms "
+                            f"| {m['tf']} TFLOP/s |")
+                continue
+            if '"metric"' in line:
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    continue
+                rows.append(f"| {d['metric']} | — | — | {d['value']} "
+                            f"{d['unit']} |")
+                continue
+            m = FAIL.match(line)
+            if m and "FAILED" in line:
+                rows.append(f"| {m['name'][:40]} | — | — | FAILED: "
+                            f"{m['msg'][:60]} |")
+        if rows:
+            print(f"\n### {logdir.name}/{item.stem}\n")
+            print("| variant | remat | time | rate |")
+            print("|---|---|---|---|")
+            print("\n".join(rows))
+
+
+def main():
+    dirs = [Path(d) for d in sys.argv[1:]] or [
+        Path("benchmarks/logs_r4i"), Path("benchmarks/logs_r5")]
+    any_found = False
+    for d in dirs:
+        if d.is_dir():
+            harvest(d)
+            any_found = True
+    if not any_found:
+        print("no log directories found", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
