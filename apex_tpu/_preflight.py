@@ -298,6 +298,23 @@ def preflight(
     process (``use_pallas=None`` call sites); an explicit ``use_pallas=True``
     still forces the kernel.
     """
+    # Pin the RESOLVED tune DB for the whole probe pass: each probe then
+    # compile-checks exactly the kernel configs production will consult
+    # (snapshot + user cache — or the empty DB when APEX_TPU_TUNE=0 has
+    # disabled the cache, since pinning bypasses that check in lookup()),
+    # and a concurrent autotune write or cache reload cannot shift configs
+    # mid-probe. Probes that need the pure defaults additionally unset the
+    # relevant env vars (_pinned_env).
+    from apex_tpu import tuning
+
+    db = tuning.active_db() if tuning.tuning_enabled() else tuning.TuneDB()
+    report: Dict[str, dict] = {}
+    with tuning.pinned(db):
+        report.update(_preflight_inner(kernels, verbose))
+    return report
+
+
+def _preflight_inner(kernels, verbose) -> Dict[str, dict]:
     report: Dict[str, dict] = {}
     for name in kernels or list(PROBES):
         probe = PROBES.get(name)

@@ -51,16 +51,11 @@ _VALID_THRESHOLD = -5e29  # scores below this are treated as masked-out
 _HIGHEST = jax.lax.Precision.HIGHEST
 
 
-def _block_size(s: int, streaming: bool = False, bwd: bool = False) -> int:
-    """Block sizes must be multiples of 128 so every dynamic slice is
-    provably lane-aligned for Mosaic. ``APEX_TPU_FLASH_BLOCK`` overrides
-    the default (tuning knob for benchmarks/bench_step_variants.py); the
-    value is clamped to the padded sequence so tiny probes stay valid.
-
-    ``APEX_TPU_FLASH_BLOCK_BWD`` tunes the BACKWARD kernels independently
-    (round-4 verdict Weak #1: the fused bwd holds more live tiles per
-    grid step — dq/dk/dv accumulators plus the recomputed score tile —
-    so its VMEM-optimal block need not match the forward's)."""
+def _env_block(bwd: bool = False):
+    """The env-var block override, validated, or None. The bwd var wins
+    for backward kernels (round-4 verdict Weak #1: the fused bwd holds
+    more live tiles per grid step, so its VMEM-optimal block need not
+    match the forward's)."""
     env = var = None
     if bwd:
         var = "APEX_TPU_FLASH_BLOCK_BWD"
@@ -68,30 +63,83 @@ def _block_size(s: int, streaming: bool = False, bwd: bool = False) -> int:
     if not env:
         var = "APEX_TPU_FLASH_BLOCK"
         env = os.environ.get(var)
-    if env:
-        b = int(env)
-        if b <= 0 or b % 128:
-            raise ValueError(
-                f"{var}={b} must be a positive multiple of 128"
-            )
+    if not env:
+        return None
+    b = int(env)
+    if b <= 0 or b % 128:
+        raise ValueError(f"{var}={b} must be a positive multiple of 128")
+    return b
+
+
+def _block_size(s: int, streaming: bool = False, bwd: bool = False) -> int:
+    """Per-axis block size: env override, else the cost-model default
+    (apex_tpu.tuning.cost_model.flash_block_default — the measured v5e
+    rules, with s >= 2048 resident fixed at 256; see that module's doc
+    for provenance). Blocks are multiples of 128 so every dynamic slice
+    is provably lane-aligned for Mosaic; env values are clamped to the
+    padded sequence so tiny probes stay valid. Shape-class-aware tuned
+    lookups happen one level up, in ``_flash_blocks``."""
+    b = _env_block(bwd)
+    if b is not None:
         return min(b, max(128, -(-s // 128) * 128))
-    if streaming:
-        # measured on v5e (bench_long_context, 2026-07-31): block 512 runs
-        # the streaming grids 2.1-2.2x faster than 256 (s=16384: 62.0 vs
-        # 129.7 ms f+b; s=32768: 234.0 vs 508.5 ms, 28.2 TFLOP/s) — bigger
-        # tiles amortize the per-grid-step DMA of the O(block) scratch
-        return min(512, max(128, -(-s // 128) * 128))
-    if s <= 2048:
-        # measured on v5e (BASELINE.md variants table, 2026-07-30): block 512
-        # beats 256 by 1.12x at BERT-large b128 s512 (1712 vs 1922 ms/step)
-        # and 128 loses (2514 ms); larger tiles amortize the grid/fetch
-        # overhead while the fp32 score tile (512x512 = 1 MB) stays tiny in
-        # VMEM.
-        return min(512, max(128, -(-s // 128) * 128))
-    # resident family above 2048: the fp32 score tile is [bq, bk] but the
-    # whole K/V stays in VMEM too — 256 measured best (s=4096: 8.9 ms vs
-    # 15.1 ms at 512)
-    return 256
+    from apex_tpu.tuning import cost_model
+
+    return min(cost_model.flash_block_default(s, streaming, bwd),
+               max(128, -(-s // 128) * 128))
+
+
+def _flash_blocks(sq: int, sk: int, *, d: int, dtype, causal: bool,
+                  group: int, streaming: bool, bwd: bool):
+    """(block_q, block_k) for one call, resolved shape-class-aware:
+
+        env var (APEX_TPU_FLASH_BLOCK[_BWD])   — wins outright, so A/B
+                                                 sweeps ignore the cache
+        tune-cache entry for this shape class  — apex_tpu.tuning lookup
+        cost-model default                     — _block_size
+    """
+    if _env_block(bwd) is not None:
+        return (_block_size(sq, streaming, bwd),
+                _block_size(sk, streaming, bwd))
+    from apex_tpu import tuning
+
+    cfg = tuning.flash_config(sq, sk, d, dtype, causal, group, streaming,
+                              bwd)
+    return cfg["block_q"], cfg["block_k"]
+
+
+def _streaming_available() -> bool:
+    """Could the streaming family serve long sequences in this process?
+    (Backend support present, family not pinned off by preflight, env not
+    forcing resident.)"""
+    from apex_tpu.ops._utils import kernel_disabled
+
+    if _pltpu is None or kernel_disabled("flash_attention_stream"):
+        return False
+    env = os.environ.get("APEX_TPU_FLASH_STREAM")
+    return env is None or env == "1"
+
+
+def _auto_use_kernel(family: str, q, k, causal: bool, group: int) -> bool:
+    """Backend decision for auto mode (use_pallas=None): the preflight
+    registry and APEX_TPU_USE_PALLAS behave exactly as before
+    (ops/_utils.default_use_pallas); when they choose the kernel path and
+    the env var is UNSET, the tuning layer may still route this shape
+    class to the jnp path — a pinned cache entry ({"backend": "jnp"}) or
+    the documented cost-model fallback rule
+    (tuning.cost_model.flash_backend_default). An explicit
+    APEX_TPU_USE_PALLAS=1 beats the cache (env > cache > model), and an
+    explicit use_pallas=True never reaches this function."""
+    if not default_use_pallas(family):
+        return False
+    if os.environ.get("APEX_TPU_USE_PALLAS") == "1":
+        return True
+    from apex_tpu import tuning
+
+    sq, sk, d = q.shape[1], k.shape[1], q.shape[-1]
+    backend = tuning.flash_backend_auto(
+        sq, sk, d, q.dtype, causal, group, _use_streaming(sq, sk),
+        streaming_available=_streaming_available())
+    return backend != "jnp"
 
 
 # ---------------------------------------------------------------------------
@@ -339,8 +387,8 @@ def _fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, causal, offset, scale, nk,
 def _fwd_stream_pallas(q, k, v, bias, causal, scale, drop=None, group=1):
     b, sq, d = q.shape                    # b = batch * QUERY heads
     sk = k.shape[1]
-    bq = _block_size(sq, streaming=True)
-    bk = _block_size(sk, streaming=True)
+    bq, bk = _flash_blocks(sq, sk, d=d, dtype=q.dtype, causal=causal,
+                           group=group, streaming=True, bwd=False)
     qp = _pad_seq(q, bq, 1)
     kp = _pad_seq(k, bk, 1)
     vp = _pad_seq(v, bk, 1)
@@ -518,7 +566,7 @@ def _bwd_dkv_stream_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref,
 def _bwd_stream_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None,
                        drop=None, group=1):
     (qp, kp, vp, dop, lsep, deltap, bias_p, broadcast_q, dims) = \
-        _bwd_prologue(q, k, v, bias, o, lse, do, dlse)
+        _bwd_prologue(q, k, v, bias, o, lse, do, dlse, causal, group)
     b, sq, sk, d, bq, bk, sqp, skp = dims  # b = batch * QUERY heads
     nq, nk = sqp // bq, skp // bk
     seed, thresh, inv_keep = drop if drop is not None else (None, None, 1.0)
@@ -657,8 +705,8 @@ def _fwd_pallas(q, k, v, bias, causal, scale, drop=None, group=1):
                                   group=group)
     b, sq, d = q.shape                    # b = batch * QUERY heads
     sk = k.shape[1]
-    bq = _block_size(sq)
-    bk = _block_size(sk)
+    bq, bk = _flash_blocks(sq, sk, d=d, dtype=q.dtype, causal=causal,
+                           group=group, streaming=False, bwd=False)
     qp = _pad_seq(q, bq, 1)
     kp = _pad_seq(k, bk, 1)
     vp = _pad_seq(v, bk, 1)
@@ -916,17 +964,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref, *rest,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd_prologue(q, k, v, bias, o, lse, do, dlse):
+def _bwd_prologue(q, k, v, bias, o, lse, do, dlse, causal=False, group=1):
     """Shared backward setup for both Pallas strategies: pad the operands,
     fold the (optional) lse cotangent into delta (ds = p*(dp - delta + dlse)
     because d(lse_i)/d(s_ij) = p_ij), neutralize padded q rows with an
     lse = 1e30 sentinel (p underflows to exactly 0), and synthesize the
-    padded-K-column mask bias."""
+    padded-K-column mask bias. ``causal``/``group`` only shape the tune
+    cache key — the masks themselves are the kernels' business."""
     b, sq, d = q.shape
     sk = k.shape[1]
     strm = _use_streaming(sq, sk)
-    bq = _block_size(sq, streaming=strm, bwd=True)
-    bk = _block_size(sk, streaming=strm, bwd=True)
+    bq, bk = _flash_blocks(sq, sk, d=d, dtype=q.dtype, causal=causal,
+                           group=group, streaming=strm, bwd=True)
     qp = _pad_seq(q, bq, 1)
     kp = _pad_seq(k, bk, 1)
     vp = _pad_seq(v, bk, 1)
@@ -950,7 +999,7 @@ def _bwd_prologue(q, k, v, bias, o, lse, do, dlse):
 def _bwd_fused_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None,
                       drop=None, group=1):
     (qp, kp, vp, dop, lsep, deltap, bias_p, broadcast_q, dims) = \
-        _bwd_prologue(q, k, v, bias, o, lse, do, dlse)
+        _bwd_prologue(q, k, v, bias, o, lse, do, dlse, causal, group)
     b, sq, sk, d, bq, bk, sqp, skp = dims  # b = batch * QUERY heads
 
     common = [qp, kp, vp, lsep, dop, deltap]
@@ -1021,7 +1070,7 @@ def _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None,
 def _bwd_split_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None,
                       group=1):
     (qp, kp, vp, dop, lsep, deltap, bias_p, broadcast_q, dims) = \
-        _bwd_prologue(q, k, v, bias, o, lse, do, dlse)
+        _bwd_prologue(q, k, v, bias, o, lse, do, dlse, causal, group)
     b, sq, sk, d, bq, bk, sqp, skp = dims  # b = batch * QUERY heads
 
     common = [qp, kp, vp, lsep, dop, deltap]
@@ -1198,7 +1247,8 @@ def _flash_core(q, k, v, bias, causal, scale, use_pallas, need_dbias,
 
 def _flash_core_fwd(q, k, v, bias, causal, scale, use_pallas, need_dbias,
                     group=1):
-    use = default_use_pallas("flash_attention") if use_pallas is None else use_pallas
+    use = _auto_use_kernel("flash_attention", q, k, causal, group) \
+        if use_pallas is None else use_pallas
     if use:
         o, lse = _fwd_pallas(q, k, v, bias, causal, scale, group=group)
     else:
@@ -1219,7 +1269,8 @@ def _flash_core_fwd(q, k, v, bias, causal, scale, use_pallas, need_dbias,
 
 def _flash_core_bwd(causal, scale, use_pallas, need_dbias, group, res, do):
     q, k, v, bias, o, lse = res
-    use = default_use_pallas("flash_attention") if use_pallas is None else use_pallas
+    use = _auto_use_kernel("flash_attention", q, k, causal, group) \
+        if use_pallas is None else use_pallas
     ds = None
     if use:
         dq, dk, dv = _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do,
@@ -1245,12 +1296,17 @@ def _flash_core_bwd(causal, scale, use_pallas, need_dbias, group, res, do):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-def _drop_kernel_ok(use_pallas) -> bool:
+def _drop_kernel_ok(use_pallas, q=None, k=None, causal=False,
+                    group=1) -> bool:
     """Kernel path for fused dropout (resident AND streaming kernels carry
     the counter-RNG mask), behind its own preflight family so a Mosaic
-    regression in the RNG lowering degrades just this path."""
+    regression in the RNG lowering degrades just this path. Auto mode
+    consults the tune cache per shape class like the dropout-free path."""
     if use_pallas is None:
-        return default_use_pallas("flash_attention_dropout")
+        if q is None:
+            return default_use_pallas("flash_attention_dropout")
+        return _auto_use_kernel("flash_attention_dropout", q, k, causal,
+                                group)
     return use_pallas
 
 
@@ -1272,7 +1328,7 @@ def _flash_core_drop_fwd(q, k, v, bias, seed, causal, scale, dropout_p,
                          use_pallas, need_dbias, group=1):
     thresh = keep_threshold(1.0 - dropout_p)
     inv_keep = 1.0 / (1.0 - dropout_p)
-    if _drop_kernel_ok(use_pallas):
+    if _drop_kernel_ok(use_pallas, q, k, causal, group):
         o, lse = _fwd_pallas(q, k, v, bias, causal, scale,
                              drop=(seed, thresh, inv_keep), group=group)
     else:
@@ -1290,7 +1346,7 @@ def _flash_core_drop_bwd(causal, scale, dropout_p, use_pallas, need_dbias,
     thresh = keep_threshold(1.0 - dropout_p)
     inv_keep = 1.0 / (1.0 - dropout_p)
     ds = None
-    if _drop_kernel_ok(use_pallas):
+    if _drop_kernel_ok(use_pallas, q, k, causal, group):
         dq, dk, dv = _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do,
                                  drop=(seed, thresh, inv_keep), group=group)
     else:
@@ -1344,7 +1400,8 @@ def _flash_core_lse_bwd(causal, scale, use_pallas, need_dbias, group, res,
                         cts):
     do, dlse = cts
     q, k, v, bias, o, lse = res
-    use = default_use_pallas("flash_attention") if use_pallas is None else use_pallas
+    use = _auto_use_kernel("flash_attention", q, k, causal, group) \
+        if use_pallas is None else use_pallas
     ds = None
     if use:
         dq, dk, dv = _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do,

@@ -16,6 +16,7 @@ native: params are upcast in-kernel and the output takes x.dtype.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -23,7 +24,33 @@ from jax.experimental import pallas as pl
 
 from apex_tpu.ops._utils import default_use_pallas, pallas_interpret
 
-_BLOCK_ROWS = 256
+_BLOCK_ROWS = 256  # historical default; kept for external references
+
+
+def _block_rows(kernel: str, hidden: int, dtype) -> int:
+    """Rows per grid step, resolved shape-class-aware:
+
+        APEX_TPU_LN_BLOCK_ROWS  — env override, wins outright (A/B knob
+                                  for the wide-hidden LN sweep)
+        tune-cache entry        — apex_tpu.tuning lookup by (kernel,
+                                  hidden bucket, dtype, device)
+        cost-model default      — 256 everywhere benched; wide-hidden
+                                  classes shrink to fit scoped VMEM
+
+    Must be a positive multiple of 8: the bwd kernels' per-block partial
+    reductions are (8, h) blocks (_group_sum8 / Mosaic sublane quantum).
+    """
+    env = os.environ.get("APEX_TPU_LN_BLOCK_ROWS")
+    if env:
+        r = int(env)
+        if r <= 0 or r % 8:
+            raise ValueError(
+                f"APEX_TPU_LN_BLOCK_ROWS={r} must be a positive multiple "
+                f"of 8")
+        return r
+    from apex_tpu import tuning
+
+    return tuning.ln_block_rows(kernel, hidden, dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -158,23 +185,24 @@ def _pad_rows(x2, block):
 
 def _ln_fwd_pallas(x, gamma, beta, eps):
     h = x.shape[-1]
-    x2, rows = _pad_rows(x.reshape(-1, h), _BLOCK_ROWS)
+    br = _block_rows("layer_norm", h, x.dtype)
+    x2, rows = _pad_rows(x.reshape(-1, h), br)
     rp = x2.shape[0]
-    grid = rp // _BLOCK_ROWS
+    grid = rp // br
     g2 = gamma.reshape(1, h)
     b2 = beta.reshape(1, h)
     y, mean, rstd = pl.pallas_call(
         functools.partial(_ln_fwd_kernel, eps=eps),
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            pl.BlockSpec((br, h), lambda i: (i, 0)),
             pl.BlockSpec((1, h), lambda i: (0, 0)),
             pl.BlockSpec((1, h), lambda i: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
-            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
-            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, h), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rp, h), x.dtype),
@@ -189,25 +217,26 @@ def _ln_fwd_pallas(x, gamma, beta, eps):
 
 def _ln_bwd_pallas(x, gamma, mean, rstd, dy):
     h = x.shape[-1]
-    x2, rows = _pad_rows(x.reshape(-1, h), _BLOCK_ROWS)
-    dy2, _ = _pad_rows(dy.reshape(-1, h), _BLOCK_ROWS)
-    mean2, _ = _pad_rows(mean.reshape(-1, 1), _BLOCK_ROWS)
-    rstd2, _ = _pad_rows(rstd.reshape(-1, 1), _BLOCK_ROWS)
+    br = _block_rows("layer_norm", h, x.dtype)
+    x2, rows = _pad_rows(x.reshape(-1, h), br)
+    dy2, _ = _pad_rows(dy.reshape(-1, h), br)
+    mean2, _ = _pad_rows(mean.reshape(-1, 1), br)
+    rstd2, _ = _pad_rows(rstd.reshape(-1, 1), br)
     rp = x2.shape[0]
-    grid = rp // _BLOCK_ROWS
+    grid = rp // br
     g2 = gamma.reshape(1, h)
     dx, dg_part, db_part = pl.pallas_call(
         _ln_bwd_kernel,
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            pl.BlockSpec((br, h), lambda i: (i, 0)),
             pl.BlockSpec((1, h), lambda i: (0, 0)),
-            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
-            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
-            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, h), lambda i: (i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            pl.BlockSpec((br, h), lambda i: (i, 0)),
             pl.BlockSpec((8, h), lambda i: (i, 0)),
             pl.BlockSpec((8, h), lambda i: (i, 0)),
         ],
@@ -227,19 +256,20 @@ def _ln_bwd_pallas(x, gamma, mean, rstd, dy):
 
 def _rms_fwd_pallas(x, gamma, eps):
     h = x.shape[-1]
-    x2, rows = _pad_rows(x.reshape(-1, h), _BLOCK_ROWS)
+    br = _block_rows("rms_norm", h, x.dtype)
+    x2, rows = _pad_rows(x.reshape(-1, h), br)
     rp = x2.shape[0]
-    grid = rp // _BLOCK_ROWS
+    grid = rp // br
     y, rstd = pl.pallas_call(
         functools.partial(_rms_fwd_kernel, eps=eps),
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            pl.BlockSpec((br, h), lambda i: (i, 0)),
             pl.BlockSpec((1, h), lambda i: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
-            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, h), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rp, h), x.dtype),
@@ -252,22 +282,23 @@ def _rms_fwd_pallas(x, gamma, eps):
 
 def _rms_bwd_pallas(x, gamma, rstd, dy):
     h = x.shape[-1]
-    x2, rows = _pad_rows(x.reshape(-1, h), _BLOCK_ROWS)
-    dy2, _ = _pad_rows(dy.reshape(-1, h), _BLOCK_ROWS)
-    rstd2, _ = _pad_rows(rstd.reshape(-1, 1), _BLOCK_ROWS)
+    br = _block_rows("rms_norm", h, x.dtype)
+    x2, rows = _pad_rows(x.reshape(-1, h), br)
+    dy2, _ = _pad_rows(dy.reshape(-1, h), br)
+    rstd2, _ = _pad_rows(rstd.reshape(-1, 1), br)
     rp = x2.shape[0]
-    grid = rp // _BLOCK_ROWS
+    grid = rp // br
     dx, dg_part = pl.pallas_call(
         _rms_bwd_kernel,
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            pl.BlockSpec((br, h), lambda i: (i, 0)),
             pl.BlockSpec((1, h), lambda i: (0, 0)),
-            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
-            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, h), lambda i: (i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            pl.BlockSpec((br, h), lambda i: (i, 0)),
             pl.BlockSpec((8, h), lambda i: (i, 0)),
         ],
         out_shape=[

@@ -25,6 +25,7 @@ numerics against the jnp oracles.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -48,6 +49,29 @@ _BLOCK_ROWS = 2048  # 2048 x 128 fp32 = 1 MiB per operand tile in VMEM
 # sizes. Half-size tiles keep the same sequential streaming pattern
 # (bandwidth-bound either way) with ~7 MiB resident.
 _BLOCK_ROWS_WIDE = 1024
+
+
+def _tuned_block_rows(n_tiles: int) -> int:
+    """Rows per grid step for a kernel with ``n_tiles`` live operand +
+    output tiles, resolved shape-class-aware:
+
+        APEX_TPU_OPTIM_BLOCK_ROWS  — env override, wins outright
+        tune-cache entry           — apex_tpu.tuning lookup by tile count
+        cost-model default         — the VMEM-fit rule that reproduces
+                                     the measured split above exactly
+                                     (2 tiles -> 2048, 7 tiles -> 1024)
+    """
+    env = os.environ.get("APEX_TPU_OPTIM_BLOCK_ROWS")
+    if env:
+        r = int(env)
+        if r <= 0 or r % 8:
+            raise ValueError(
+                f"APEX_TPU_OPTIM_BLOCK_ROWS={r} must be a positive "
+                f"multiple of 8")
+        return r
+    from apex_tpu import tuning
+
+    return tuning.optim_block_rows(n_tiles)
 
 ADAM_MODE_ADAM = 0  # L2 regularization folded into the gradient
 ADAM_MODE_ADAMW = 1  # decoupled weight decay
@@ -125,14 +149,15 @@ def adam_flat(grads, params, exp_avg, exp_avg_sq, *, lr, beta1, beta2, eps,
         jnp.asarray(noop_flag).astype(jnp.float32),
     ])
 
-    g2, n = _pad_rows(grads.astype(jnp.float32), _BLOCK_ROWS_WIDE)
-    p2, _ = _pad_rows(params, _BLOCK_ROWS_WIDE)
-    m2, _ = _pad_rows(exp_avg, _BLOCK_ROWS_WIDE)
-    v2, _ = _pad_rows(exp_avg_sq, _BLOCK_ROWS_WIDE)
+    br = _tuned_block_rows(n_tiles=7)
+    g2, n = _pad_rows(grads.astype(jnp.float32), br)
+    p2, _ = _pad_rows(params, br)
+    m2, _ = _pad_rows(exp_avg, br)
+    v2, _ = _pad_rows(exp_avg_sq, br)
     rows = p2.shape[0]
-    grid = rows // _BLOCK_ROWS_WIDE
+    grid = rows // br
 
-    blk = pl.BlockSpec((_BLOCK_ROWS_WIDE, LANES), lambda i: (i, 0))
+    blk = pl.BlockSpec((br, LANES), lambda i: (i, 0))
     s_spec = (
         pl.BlockSpec(memory_space=_SMEM)
         if _SMEM is not None and not pallas_interpret()
@@ -170,13 +195,14 @@ def _l2norm_kernel(x_ref, out_ref):
 def l2norm_flat(flat) -> jax.Array:
     """sqrt(sum(x^2)) of a flat buffer in ONE pass with fp32 accumulation
     (ref: csrc/multi_tensor_l2norm_kernel.cu). Accepts any float dtype."""
-    x2, _ = _pad_rows(flat.astype(jnp.float32), _BLOCK_ROWS)
+    br = _tuned_block_rows(n_tiles=2)
+    x2, _ = _pad_rows(flat.astype(jnp.float32), br)
     rows = x2.shape[0]
-    grid = rows // _BLOCK_ROWS
+    grid = rows // br
     sq = pl.pallas_call(
         _l2norm_kernel,
         grid=(grid,),
-        in_specs=[pl.BlockSpec((_BLOCK_ROWS, LANES), lambda i: (i, 0))],
+        in_specs=[pl.BlockSpec((br, LANES), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
         interpret=pallas_interpret(),
@@ -230,14 +256,15 @@ def lamb_phase1_flat(grads, params, exp_avg, exp_avg_sq, *, beta1, beta2,
         b1, b2, jnp.float32(eps), bc1, bc2,
         jnp.float32(weight_decay), jnp.asarray(grad_scale, jnp.float32),
     ])
-    g2, n = _pad_rows(grads.astype(jnp.float32), _BLOCK_ROWS_WIDE)
-    p2, _ = _pad_rows(params, _BLOCK_ROWS_WIDE)
-    m2, _ = _pad_rows(exp_avg, _BLOCK_ROWS_WIDE)
-    v2, _ = _pad_rows(exp_avg_sq, _BLOCK_ROWS_WIDE)
+    br = _tuned_block_rows(n_tiles=7)
+    g2, n = _pad_rows(grads.astype(jnp.float32), br)
+    p2, _ = _pad_rows(params, br)
+    m2, _ = _pad_rows(exp_avg, br)
+    v2, _ = _pad_rows(exp_avg_sq, br)
     rows = p2.shape[0]
-    grid = rows // _BLOCK_ROWS_WIDE
+    grid = rows // br
 
-    blk = pl.BlockSpec((_BLOCK_ROWS_WIDE, LANES), lambda i: (i, 0))
+    blk = pl.BlockSpec((br, LANES), lambda i: (i, 0))
     s_spec = (
         pl.BlockSpec(memory_space=_SMEM)
         if _SMEM is not None and not pallas_interpret()

@@ -12,9 +12,19 @@ applied pre-mask) and are the building blocks for
 ``apex_tpu.transformer.FusedScaleMaskSoftmax`` and the attention kernels.
 All are differentiable through JAX autodiff, which produces the same fused
 ``y*(dy - sum(dy*y))`` backward the reference hand-writes.
+
+Row tiling (autotuner knob): by default the whole [*, cols] tensor goes
+through one XLA-fused pass. For giant score tensors the fp32 intermediate
+can dominate HBM; ``APEX_TPU_SOFTMAX_CHUNK`` (env) or a tune-cache entry
+(kernel "softmax", see apex_tpu/tuning) sets a row-chunk size and the pass
+streams ``lax.map`` over row chunks instead — numerically identical (each
+row's softmax is independent), only the schedule changes. 0 = untiled
+(today's default everywhere).
 """
 
 from __future__ import annotations
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -22,12 +32,54 @@ import jax.numpy as jnp
 MASK_VALUE = -10000.0  # the reference's fill value for masked logits
 
 
+def _row_chunk(rows: int, cols: int, dtype) -> int:
+    """Resolved row-chunk size: env > tune cache > 0 (untiled)."""
+    env = os.environ.get("APEX_TPU_SOFTMAX_CHUNK")
+    if env:
+        c = int(env)
+        if c < 0:
+            raise ValueError(
+                f"APEX_TPU_SOFTMAX_CHUNK={c} must be >= 0 (0 = untiled)")
+        return c
+    from apex_tpu import tuning
+
+    return tuning.softmax_row_chunk(rows, cols, dtype)
+
+
+def _chunked_softmax(x32, chunk: int):
+    """softmax(x32, axis=-1) streamed over leading-row chunks. x32 is
+    fp32, already scaled/masked; rows are independent so the result is
+    bit-identical to the single-pass jax.nn.softmax."""
+    shape = x32.shape
+    rows = 1
+    for s in shape[:-1]:
+        rows *= s
+    if chunk <= 0 or rows <= chunk:
+        return jax.nn.softmax(x32, axis=-1)
+    flat = x32.reshape(rows, shape[-1])
+    pad = (-rows) % chunk
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((pad, shape[-1]), flat.dtype)], axis=0)
+    tiles = flat.reshape(-1, chunk, shape[-1])
+    out = jax.lax.map(lambda t: jax.nn.softmax(t, axis=-1), tiles)
+    return out.reshape(-1, shape[-1])[:rows].reshape(shape)
+
+
+def _softmax(x32):
+    rows = 1
+    for s in x32.shape[:-1]:
+        rows *= s
+    return _chunked_softmax(
+        x32, _row_chunk(rows, x32.shape[-1], x32.dtype))
+
+
 def scaled_softmax(x, scale: float = 1.0):
     """softmax(scale * x) — ref: scaled_softmax_cuda. The scale multiply
     happens in fp32 (the reference scales during the fp32 load), so large
     half-precision logits don't overflow before the cast."""
     dtype = x.dtype
-    y = jax.nn.softmax(x.astype(jnp.float32) * scale, axis=-1)
+    y = _softmax(x.astype(jnp.float32) * scale)
     return y.astype(dtype)
 
 
@@ -41,7 +93,7 @@ def scaled_masked_softmax(x, mask, scale: float = 1.0):
     x32 = x.astype(jnp.float32) * scale  # scale in fp32 (see scaled_softmax)
     x32 = jnp.where(jnp.asarray(mask, bool), MASK_VALUE, x32)
     # rows that are fully masked produce uniform attention in the reference
-    return jax.nn.softmax(x32, axis=-1).astype(dtype)
+    return _softmax(x32).astype(dtype)
 
 
 def scaled_upper_triang_masked_softmax(x, scale: float = 1.0):

@@ -1,0 +1,157 @@
+"""Kernel autotuning subsystem.
+
+One registry of tunable parameters per Pallas kernel family (registry.py),
+keyed by shape class (shape_class.py), resolved through three layers:
+
+    env var  >  tune cache (pinned / user file / committed snapshot)
+             >  cost-model default (cost_model.py)
+
+The ops layer calls the ``*_config`` helpers below at trace time; the
+autotune driver (``python -m apex_tpu.tuning.autotune``) sweeps the
+registry's candidate space per shape class and writes the cache
+(cache.py — ``~/.cache/apex_tpu/tunedb.json`` by default, snapshots
+committed under ``benchmarks/tunedb/``). See docs/tuning.md.
+
+Helpers here never raise on cache weirdness: an out-of-range cached value
+is clamped or ignored (cost of a wrong entry = a slow kernel, never a
+crash); env-var validation stays at the op layer where it always lived.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.tuning import cost_model, registry, shape_class
+from apex_tpu.tuning.cache import (
+    TuneDB,
+    active_db,
+    cache_path,
+    invalidate,
+    lookup,
+    pinned,
+    snapshot_dir,
+    tuning_enabled,
+)
+from apex_tpu.tuning.shape_class import (
+    class_key,
+    device_kind,
+    dtype_token,
+    flash_key,
+    ln_key,
+    optim_key,
+    softmax_key,
+)
+
+__all__ = [
+    "TuneDB", "active_db", "cache_path", "invalidate", "lookup", "pinned",
+    "snapshot_dir", "tuning_enabled", "class_key", "device_kind",
+    "dtype_token", "flash_key", "ln_key", "optim_key", "softmax_key",
+    "flash_config", "ln_block_rows", "optim_block_rows",
+    "softmax_row_chunk", "cost_model", "registry", "shape_class",
+]
+
+
+def _ceil128(s: int) -> int:
+    return max(128, -(-int(s) // 128) * 128)
+
+
+def _clamp_block(b, s: int, default: int) -> int:
+    """A cached block must be a positive multiple of 128; clamp to the
+    padded sequence (same rule as the env override) and fall back to the
+    default on anything malformed."""
+    try:
+        b = int(b)
+    except (TypeError, ValueError):
+        return default
+    if b <= 0 or b % 128:
+        return default
+    return min(b, _ceil128(s))
+
+
+def flash_config(sq: int, sk: int, d: int, dtype, causal: bool, group: int,
+                 streaming: bool, bwd: bool) -> dict:
+    """Resolved flash config for one shape class:
+    ``{"block_q", "block_k", "backend"}``. Cache entry wins where present
+    (field-wise); cost model fills the rest. Env overrides are applied by
+    ops/attention.py BEFORE consulting this.
+
+    The ops layer consumes the blocks here (attention._flash_blocks) but
+    routes the backend decision through ``flash_backend_auto`` — that one
+    reads the pin bwd-key-first so fwd and bwd can never split backends;
+    the ``backend`` field in this resolved view reports the per-pass
+    entry for introspection/tooling."""
+    dq = cost_model.flash_block_default(sq, streaming, bwd)
+    dk = cost_model.flash_block_default(sk, streaming, bwd)
+    dq, dk = min(dq, _ceil128(sq)), min(dk, _ceil128(sk))
+    cfg = {"block_q": dq, "block_k": dk, "backend": "pallas"}
+    entry = lookup(flash_key(sq, sk, d, dtype, causal, group, streaming, bwd))
+    if entry:
+        cfg["block_q"] = _clamp_block(entry.get("block_q"), sq, dq)
+        cfg["block_k"] = _clamp_block(entry.get("block_k"), sk, dk)
+        if entry.get("backend") in ("pallas", "jnp"):
+            cfg["backend"] = entry["backend"]
+    return cfg
+
+
+def flash_backend_auto(sq: int, sk: int, d: int, dtype, causal: bool,
+                       group: int, streaming: bool,
+                       streaming_available: bool) -> str:
+    """"pallas" or "jnp" for auto mode (use_pallas=None, no env override):
+    a cached ``backend`` pin wins; otherwise the documented cost-model
+    fallback rule (cost_model.flash_backend_default).
+
+    The decision is made ONCE per shape class for forward and backward
+    together (a split backend would recompute residuals inconsistently),
+    so the pin is read from the bwd-pass key first — the pass that
+    dominates cost and VMEM pressure — falling back to the fwd-pass key;
+    the autotune driver writes both."""
+    for bwd in (True, False):
+        entry = lookup(
+            flash_key(sq, sk, d, dtype, causal, group, streaming, bwd))
+        if entry and entry.get("backend") in ("pallas", "jnp"):
+            return entry["backend"]
+    return cost_model.flash_backend_default(
+        sq, sk, d, dtype_token(dtype), causal=causal, streaming=streaming,
+        streaming_available=streaming_available, device=device_kind())
+
+
+def _clamp_rows(v, default: int, quantum: int = 8, lo: int = 8,
+                hi: int = 65536) -> int:
+    try:
+        v = int(v)
+    except (TypeError, ValueError):
+        return default
+    if v < lo or v > hi or v % quantum:
+        return default
+    return v
+
+
+def ln_block_rows(kernel: str, hidden: int, dtype) -> int:
+    """Rows per grid step for the LN/RMS kernels (kernel is "layer_norm"
+    or "rms_norm"). APEX_TPU_LN_BLOCK_ROWS is applied by the op layer."""
+    default = cost_model.ln_block_rows_default(hidden, device=device_kind())
+    entry = lookup(ln_key(kernel, hidden, dtype))
+    if entry:
+        return _clamp_rows(entry.get("block_rows"), default)
+    return default
+
+
+def optim_block_rows(n_tiles: int) -> int:
+    """128-lane rows per grid step for the flat optimizer kernels;
+    ``n_tiles`` = live operand+output tiles (see shape_class.optim_key)."""
+    default = cost_model.optim_block_rows_default(n_tiles,
+                                                  device=device_kind())
+    entry = lookup(optim_key(n_tiles))
+    if entry:
+        return _clamp_rows(entry.get("block_rows"), default, lo=128)
+    return default
+
+
+def softmax_row_chunk(rows: int, cols: int, dtype) -> int:
+    """Row-tile size for the fused softmax family (0 = untiled)."""
+    entry = lookup(softmax_key(rows, cols, dtype))
+    if entry:
+        try:
+            c = int(entry.get("row_chunk", 0))
+            return max(0, c)
+        except (TypeError, ValueError):
+            pass
+    return cost_model.softmax_row_chunk_default()

@@ -1,0 +1,188 @@
+"""Persistent tune cache: JSON entries keyed by shape class.
+
+Resolution order at a kernel call site (highest wins):
+
+1. **Env var** — ``APEX_TPU_FLASH_BLOCK[_BWD]``, ``APEX_TPU_LN_BLOCK_ROWS``,
+   ``APEX_TPU_OPTIM_BLOCK_ROWS``, ``APEX_TPU_SOFTMAX_CHUNK``,
+   ``APEX_TPU_USE_PALLAS``. Enforced at the op layer (ops/attention.py
+   etc.), NOT here — the cache never sees a call the env already decided,
+   so A/B sweeps keep working unchanged on a tuned machine.
+2. **Pinned DB** — a ``pinned(db)`` context (preflight probes pin the
+   resolved DB so a mid-probe cache reload can't skew results; tests pin
+   synthetic DBs).
+3. **User cache file** — ``$APEX_TPU_TUNEDB`` or
+   ``~/.cache/apex_tpu/tunedb.json`` (what the autotune driver writes).
+4. **Committed snapshot** — ``benchmarks/tunedb/*.json`` in a repo
+   checkout (the v5e sweep results ride the repo, so a fresh container
+   starts from measured configs, not from scratch).
+5. **Cost model** — ``cost_model.py`` defaults (handled by callers when
+   ``lookup`` returns None).
+
+``APEX_TPU_TUNE=0`` disables layers 2-4 entirely (pure cost-model
+defaults — the knob preflight and A/B baselines use).
+
+File schema (version 1)::
+
+    {"version": 1,
+     "entries": {"<class key>": {"params": {...}, "source": "...",
+                                 "ms": 1.23, "note": "..."}}}
+
+Class keys embed the device kind (shape_class.class_key), so one file may
+safely carry several generations' entries.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+from pathlib import Path
+from typing import Dict, Optional
+
+SCHEMA_VERSION = 1
+
+_lock = threading.RLock()
+_pinned_db: Optional["TuneDB"] = None
+_active_db: Optional["TuneDB"] = None  # lazy singleton (snapshot + user file)
+
+
+class TuneDB:
+    """In-memory view of a tune database; persists as JSON."""
+
+    def __init__(self, entries: Optional[Dict[str, dict]] = None):
+        self.entries: Dict[str, dict] = dict(entries or {})
+
+    # -- access -----------------------------------------------------
+    def get(self, key: str) -> Optional[dict]:
+        e = self.entries.get(key)
+        return dict(e["params"]) if e and isinstance(e.get("params"), dict) \
+            else None
+
+    def record(self, key: str, params: dict, *, source: str,
+               ms: Optional[float] = None, note: Optional[str] = None):
+        entry: dict = {"params": dict(params), "source": source}
+        if ms is not None:
+            entry["ms"] = round(float(ms), 4)
+        if note:
+            entry["note"] = note
+        self.entries[key] = entry
+
+    def merge(self, other: "TuneDB") -> "TuneDB":
+        """Entries in ``other`` override same-key entries here."""
+        merged = dict(self.entries)
+        merged.update(other.entries)
+        return TuneDB(merged)
+
+    # -- persistence ------------------------------------------------
+    def to_json(self) -> dict:
+        return {"version": SCHEMA_VERSION, "entries": self.entries}
+
+    def save(self, path: os.PathLike | str) -> Path:
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(path.suffix + ".tmp")
+        tmp.write_text(json.dumps(self.to_json(), indent=1, sort_keys=True))
+        tmp.replace(path)  # atomic: concurrent readers see old or new
+        return path
+
+    @classmethod
+    def load(cls, path: os.PathLike | str) -> "TuneDB":
+        data = json.loads(Path(path).read_text())
+        if data.get("version") != SCHEMA_VERSION:
+            raise ValueError(
+                f"tunedb {path}: schema version {data.get('version')!r} "
+                f"(this build reads {SCHEMA_VERSION})"
+            )
+        entries = data.get("entries")
+        if not isinstance(entries, dict):
+            raise ValueError(f"tunedb {path}: 'entries' must be an object")
+        for k, e in entries.items():
+            if not isinstance(e, dict) or not isinstance(e.get("params"), dict):
+                raise ValueError(f"tunedb {path}: entry {k!r} lacks 'params'")
+        return cls(entries)
+
+
+def cache_path() -> Path:
+    env = os.environ.get("APEX_TPU_TUNEDB")
+    if env:
+        return Path(env)
+    return Path.home() / ".cache" / "apex_tpu" / "tunedb.json"
+
+
+def snapshot_dir() -> Path:
+    """benchmarks/tunedb/ next to the apex_tpu package (repo checkouts);
+    may not exist in an installed wheel — callers must tolerate that."""
+    return Path(__file__).resolve().parents[2] / "benchmarks" / "tunedb"
+
+
+def _load_quietly(path: Path) -> TuneDB:
+    try:
+        return TuneDB.load(path)
+    except FileNotFoundError:
+        return TuneDB()
+    except Exception as e:  # noqa: BLE001 — a corrupt cache must never
+        # take down training; it costs a warning and the defaults
+        import warnings
+
+        warnings.warn(f"apex_tpu.tuning: ignoring unreadable tunedb "
+                      f"{path}: {e}", stacklevel=3)
+        return TuneDB()
+
+
+def _build_active() -> TuneDB:
+    db = TuneDB()
+    snap = snapshot_dir()
+    if snap.is_dir():
+        for f in sorted(snap.glob("*.json")):
+            db = db.merge(_load_quietly(f))
+    db = db.merge(_load_quietly(cache_path()))  # user cache wins over snapshot
+    return db
+
+
+def tuning_enabled() -> bool:
+    return os.environ.get("APEX_TPU_TUNE") != "0"
+
+
+def active_db() -> TuneDB:
+    """The resolved runtime DB (snapshot + user cache), loaded once per
+    process; ``invalidate()`` forces a reload (tests, post-autotune)."""
+    global _active_db
+    with _lock:
+        if _pinned_db is not None:
+            return _pinned_db
+        if _active_db is None:
+            _active_db = _build_active()
+        return _active_db
+
+
+def invalidate() -> None:
+    global _active_db
+    with _lock:
+        _active_db = None
+
+
+@contextlib.contextmanager
+def pinned(db: Optional[TuneDB]):
+    """Pin the tune DB for the context's duration. ``pinned(TuneDB())``
+    pins pure cost-model defaults; ``pinned(active_db())`` freezes the
+    current resolution (what preflight does around its probes)."""
+    global _pinned_db
+    with _lock:
+        prev = _pinned_db
+        _pinned_db = db if db is not None else TuneDB()
+    try:
+        yield
+    finally:
+        with _lock:
+            _pinned_db = prev
+
+
+def lookup(key: str) -> Optional[dict]:
+    """Tuned params for a class key, or None (-> cost-model default).
+    Respects pinning and APEX_TPU_TUNE=0."""
+    if _pinned_db is not None:
+        return _pinned_db.get(key)
+    if not tuning_enabled():
+        return None
+    return active_db().get(key)

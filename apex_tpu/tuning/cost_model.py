@@ -1,0 +1,287 @@
+"""Cost-model defaults: FLOP/byte + VMEM-footprint projection per shape class.
+
+This is tier 0 of the tuning stack — what every kernel uses when neither
+an env override nor a cache entry exists. Two jobs:
+
+1. **Defaults.** Reproduce the measured v5e block choices (BASELINE.md
+   variants + long-context tables) for every benched shape class, with ONE
+   deliberate change: the resident flash family at ``s >= 2048`` now gets
+   block 256 instead of 512. The old ``s <= 2048 -> 512`` rule shipped a
+   measured ~1.6x regression at seq 2048 (VERDICT round 5, Weak #3): at
+   2048 the whole K/V row (2048 x d) is VMEM-resident *on top of* the
+   512-wide fp32 score tile and its bwd accumulators, which pushes the
+   fused backward past the comfortable scoped-VMEM point — the same
+   footprint cliff that made 256 the measured winner at s=4096 (8.9 ms vs
+   15.1 ms). 2048 sits on the same side of the cliff as 4096, not 512.
+
+2. **Projection.** A roofline estimate (``projected_ms``) of flash vs the
+   unfused jnp path per shape class: compute time = FLOPs / peak, memory
+   time = HBM bytes / bandwidth, projected = max of the two plus a
+   per-grid-step overhead. The autotune driver uses it to rank candidates
+   when no hardware answers (interpret mode), and ``flash_backend_default``
+   uses it for the documented fallback-to-jnp rule:
+
+   **Fallback threshold:** auto mode routes a shape class to the unfused
+   jnp path when ``projected_flash_ms > FALLBACK_RATIO * projected_unfused_ms``
+   (FALLBACK_RATIO = 1.1 — flash must not be projected >10% slower) or
+   when the resident family's VMEM residency exceeds ``vmem_budget`` with
+   the streaming family unavailable. A pinned cache entry
+   (``{"backend": "jnp"}``) forces the fallback for a class regardless of
+   projection; ``APEX_TPU_USE_PALLAS`` beats both (env > cache > model).
+
+All numbers are per-chip and intentionally coarse — the model only has to
+order candidates correctly, not predict milliseconds.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+# Per device-kind substring: (peak bf16 matmul FLOP/s, HBM GB/s, VMEM MiB).
+# Same normalization as bench.peak_flops; VMEM is the scoped budget Mosaic
+# enforces, not the raw SRAM size.
+DEVICE_SPECS = (
+    ("v5lite", 197e12, 819e9, 16.0),
+    ("v5e", 197e12, 819e9, 16.0),
+    ("v5p", 459e12, 2765e9, 16.0),
+    ("v6", 918e12, 1640e9, 32.0),
+    ("v4", 275e12, 1228e9, 16.0),
+    ("cpu", 1e12, 50e9, 16.0),  # nominal: interpret-mode ranking only
+)
+
+# Per-grid-step launch/DMA-setup overhead (seconds). Coarse, but it is
+# what penalizes absurdly small blocks (grid explosion) in the projection.
+GRID_STEP_OVERHEAD_S = 2e-6
+
+FALLBACK_RATIO = 1.1  # flash must not be projected >10% slower than jnp
+
+# The s >= 2048 resident classes take block 256 (see module doc).
+RESIDENT_SMALL_SEQ = 2048
+
+# Resident -> streaming routing switch: max(sq, sk) strictly greater goes
+# to the streaming family. MUST match ops/attention._STREAM_SEQ (pinned by
+# tests/L0/test_tuning.py); duplicated here so the cost model stays
+# importable without the kernel layer.
+STREAM_SEQ = 4096
+
+
+def device_spec(kind: str):
+    kind = (kind or "cpu").lower().replace(" ", "")
+    for sub, flops, bw, vmem in DEVICE_SPECS:
+        if sub in kind:
+            return flops, bw, vmem * 2**20
+    return 197e12, 819e9, 16.0 * 2**20  # unknown TPU: assume v5e
+
+
+def _ceil128(s: int) -> int:
+    return max(128, -(-int(s) // 128) * 128)
+
+
+def _dtype_bytes(dt_token: str) -> int:
+    return {"bf16": 2, "f16": 2, "f32": 4, "f64": 8}.get(dt_token, 2)
+
+
+# ------------------------------------------------------------------
+# flash attention
+# ------------------------------------------------------------------
+
+def flash_block_default(s: int, streaming: bool = False,
+                        bwd: bool = False) -> int:
+    """Default block for one sequence axis — the single source of truth
+    behind ops/attention._block_size. Measured provenance:
+
+    - streaming: 512 (v5e bench_long_context 2026-07-31 — 2.1-2.2x over
+      256 at s=16k/32k; bigger tiles amortize the per-step scratch DMA)
+    - resident s < 2048: min(512, padded) (v5e BASELINE.md variants —
+      512 beats 256 by 1.12x at BERT-large b128 s512, 128 loses)
+    - resident s >= 2048: 256 (s=4096 measured 8.9 ms vs 15.1 at 512;
+      s=2048 moved into this class — the VERDICT Weak #3 regression fix,
+      see module doc)
+
+    ``bwd`` currently shares the forward's optimum — the knob exists so a
+    tuned cache entry (or APEX_TPU_FLASH_BLOCK_BWD) can split them.
+    """
+    del bwd  # same default; the cache/env layers differentiate
+    if streaming:
+        return min(512, _ceil128(s))
+    if s < RESIDENT_SMALL_SEQ:
+        return min(512, _ceil128(s))
+    return 256
+
+
+def flash_flops(sq: int, sk: int, d: int, bwd: bool = False) -> float:
+    """Matmul FLOPs of one attention instance ([sq,d]x[sk,d] scores +
+    [sq,sk]x[sk,d] PV; backward re-does scores and adds dP/ds/dq/dk/dv —
+    5 block matmuls vs the forward's 2)."""
+    fwd = 2.0 * sq * sk * d * 2
+    return fwd * 2.5 if bwd else fwd
+
+
+def flash_hbm_bytes(sq: int, sk: int, d: int, bytes_el: int,
+                    bwd: bool = False) -> float:
+    """HBM traffic of the FUSED kernel: operands + outputs once (the
+    score matrix never leaves VMEM)."""
+    fwd = (sq + 2 * sk) * d * bytes_el + sq * d * bytes_el + sq * 4  # +lse
+    if not bwd:
+        return fwd
+    # bwd re-reads q/k/v/o/do/lse and writes dq/dk/dv
+    return (5 * (sq + sk) * d + sq) * bytes_el + sq * 4
+
+
+def unfused_hbm_bytes(sq: int, sk: int, d: int, bytes_el: int,
+                      bwd: bool = False) -> float:
+    """HBM traffic of the unfused jnp path, which materializes the
+    [sq, sk] fp32 score/probability matrix. XLA fuses the elementwise
+    chain, so the matrix crosses HBM ~twice in the forward (scores out of
+    the first dot, probabilities into the second) and ~three more times
+    in the backward (p, dp, ds)."""
+    operands = (sq + 2 * sk) * d * bytes_el + sq * d * bytes_el
+    score_passes = 2 if not bwd else 5
+    if bwd:
+        operands = (5 * (sq + sk) * d + sq) * bytes_el
+    return operands + score_passes * sq * sk * 4.0
+
+
+def grid_steps(sq: int, sk: int, bq: int, bk: int, streaming: bool) -> int:
+    nq = -(-_ceil128(sq) // bq)
+    nk = -(-_ceil128(sk) // bk)
+    return nq * nk if streaming else nq
+
+
+def projected_ms(flops: float, hbm_bytes: float, n_grid_steps: int,
+                 device: str) -> float:
+    peak, bw, _ = device_spec(device)
+    t = max(flops / peak, hbm_bytes / bw)
+    return (t + n_grid_steps * GRID_STEP_OVERHEAD_S) * 1e3
+
+
+def flash_projection(sq: int, sk: int, d: int, dt_token: str, bq: int,
+                     bk: int, *, streaming: bool, bwd: bool,
+                     device: str) -> dict:
+    """Roofline rows for one candidate config — consumed by the autotune
+    ranking and the BASELINE.md projection table."""
+    b = _dtype_bytes(dt_token)
+    fl = flash_flops(sq, sk, d, bwd)
+    fused = flash_hbm_bytes(sq, sk, d, b, bwd)
+    unfused = unfused_hbm_bytes(sq, sk, d, b, bwd)
+    steps = grid_steps(sq, sk, bq, bk, streaming)
+    return {
+        "flops": fl,
+        "fused_bytes": fused,
+        "unfused_bytes": unfused,
+        "flop_per_byte_fused": round(fl / fused, 1),
+        "flop_per_byte_unfused": round(fl / unfused, 1),
+        "grid_steps": steps,
+        "flash_ms": round(projected_ms(fl, fused, steps, device), 4),
+        "jnp_ms": round(projected_ms(fl, unfused, 0, device), 4),
+    }
+
+
+def flash_vmem_bytes(sq: int, sk: int, d: int, bytes_el: int, bq: int,
+                     bk: int, *, streaming: bool, bwd: bool) -> int:
+    """Projected peak VMEM residency of one kernel instance (the quantity
+    the scoped-VMEM compile failures at s=8192 were about)."""
+    skp, sqp = _ceil128(sk), _ceil128(sq)
+    score = bq * bk * 4
+    if streaming:
+        # O(block) residency: q/k/v tiles + (acc, m, l) scratch
+        base = (bq + 2 * bk) * d * bytes_el + bq * d * 4 + score
+        return int(base * (3 if bwd else 1))
+    if not bwd:
+        # whole K/V row resident + q tile + fp32 acc
+        return int(2 * skp * d * bytes_el + bq * d * (bytes_el + 4) + score)
+    # fused bwd: whole q/do/dq rows + kv tile + dk/dv accumulators + score
+    return int(
+        3 * sqp * d * (bytes_el + 1)  # q, do (bf16) + fp32 dq out block
+        + 2 * bk * d * bytes_el + 2 * bk * d * 4 + score
+    )
+
+
+def flash_backend_default(sq: int, sk: int, d: int, dt_token: str, *,
+                          causal: bool, streaming: bool,
+                          streaming_available: bool, device: str) -> str:
+    """"pallas" or "jnp" — the documented auto-fallback rule (module doc).
+
+    Applied per shape class at trace time; cheap (pure arithmetic)."""
+    del causal  # causal halves both paths' work — ratio unchanged
+    bq = flash_block_default(sq, streaming)
+    bk = flash_block_default(sk, streaming)
+    proj = flash_projection(sq, sk, d, dt_token, bq, bk,
+                            streaming=streaming, bwd=True, device=device)
+    if proj["flash_ms"] > FALLBACK_RATIO * proj["jnp_ms"]:
+        return "jnp"
+    if not streaming and not streaming_available:
+        _, _, vmem = device_spec(device)
+        need = flash_vmem_bytes(sq, sk, d, _dtype_bytes(dt_token), bq, bk,
+                                streaming=False, bwd=True)
+        if need > 0.75 * vmem:  # leave headroom for stack + double-buffer
+            return "jnp"
+    return "pallas"
+
+
+# ------------------------------------------------------------------
+# layer norm / rms norm
+# ------------------------------------------------------------------
+
+LN_BLOCK_ROWS_DEFAULT = 256  # today's measured choice (v5e-green, round 4)
+# live fp32 row tiles per block in the LN bwd kernel (x, dy, dx)
+_LN_LIVE_TILES = 3
+
+
+def ln_block_rows_default(hidden: int, dtype_bytes: int = 4,
+                          device: str = "cpu") -> int:
+    """256 everywhere benched (v5e-green through h=4096-class shapes);
+    only genuinely wide hidden shrinks the block, to keep the bwd
+    kernel's 3 live fp32 row tiles inside the full scoped-VMEM budget
+    (the wide-hidden LN A/B from VERDICT Next #3 sweeps this knob on
+    hardware; until then the footprint guard is the default)."""
+    del dtype_bytes  # kernels compute in fp32 regardless of input dtype
+    _, _, vmem = device_spec(device)
+    rows = LN_BLOCK_ROWS_DEFAULT
+    while rows > 8 and rows * hidden * 4 * _LN_LIVE_TILES > vmem:
+        rows //= 2
+    return rows
+
+
+# ------------------------------------------------------------------
+# optimizer flat kernels
+# ------------------------------------------------------------------
+
+def optim_block_rows_default(n_tiles: int, device: str = "cpu") -> int:
+    """Largest power-of-two row count (cap 2048, today's measured top)
+    whose n_tiles double-buffered 128-lane fp32 tiles fit 75% of the VMEM
+    budget (the measured v5e OOM was "17.03M vs limit 16.00M" — double
+    buffering plus stack overshoots a naive 2x model, hence the margin).
+    Reproduces the measured split exactly: 2 tiles (l2norm) -> 2048,
+    7 tiles (adam/lamb) -> 1024 (pallas_optim.py's _BLOCK_ROWS vs
+    _BLOCK_ROWS_WIDE). Anything above 2048 is autotune's to prove."""
+    _, _, vmem = device_spec(device)
+    rows = 2048
+    while rows > 128 and rows * 128 * 4 * n_tiles * 2 > 0.75 * vmem:
+        rows //= 2
+    return rows
+
+
+# ------------------------------------------------------------------
+# softmax tiling
+# ------------------------------------------------------------------
+
+def softmax_row_chunk_default() -> int:
+    """0 = no tiling (today's behavior: XLA fuses the whole pass). The
+    knob exists for the autotuner: giant [rows, cols] score tensors can
+    be streamed in row chunks to cap the fp32 intermediate."""
+    return 0
+
+
+def iter_flash_ladder() -> Iterable[dict]:
+    """The benched shape-class ladder (BASELINE.md rungs) — shared by the
+    projection table generator and the autotune default sweep."""
+    for sq, d, causal in (
+        (512, 64, False),    # BERT-large
+        (1024, 64, True),    # GPT-medium
+        (2048, 64, True),    # the regression class
+        (4096, 128, True),   # long-context resident boundary
+        (8192, 128, True),   # streaming
+        (16384, 128, True),  # streaming
+    ):
+        yield {"sq": sq, "sk": sq, "d": d, "causal": causal}

@@ -1,0 +1,141 @@
+"""Registry of tunable kernel parameters — the autotuner's search space.
+
+One table replaces the knowledge that used to live scattered across
+per-kernel heuristics: which parameters each kernel family exposes, the
+candidate values worth sweeping, and the validity constraints a candidate
+must satisfy before it may be timed or cached. The autotune driver sweeps
+exactly this space; the fuzz suite (tests/L0/test_tuning_fuzz.py) samples
+the same space against the jnp oracles — so any entry the tuner can emit
+is a configuration the test suite has proven numerically correct.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class Tunable:
+    """One kernel family's tunable surface."""
+
+    kernel: str
+    params: Dict[str, List]            # name -> candidate values
+    # validity check: (params, features) -> error string | None
+    check: Optional[Callable[[dict, dict], Optional[str]]] = None
+    doc: str = ""
+    defaults_from: str = ""            # cost_model symbol providing defaults
+    env: Dict[str, str] = field(default_factory=dict)  # param -> env override
+
+
+def _mult(name: str, quantum: int):
+    def chk(params: dict, _features: dict) -> Optional[str]:
+        v = params.get(name)
+        if v is not None and (v <= 0 or v % quantum):
+            return f"{name}={v} must be a positive multiple of {quantum}"
+        return None
+    return chk
+
+
+def _flash_check(params: dict, features: dict) -> Optional[str]:
+    for p in ("block_q", "block_k"):
+        err = _mult(p, 128)(params, features)
+        if err:
+            return err
+    backend = params.get("backend", "pallas")
+    if backend not in ("pallas", "jnp"):
+        return f"backend={backend!r} not in ('pallas', 'jnp')"
+    return None
+
+
+def _rows_check(params: dict, features: dict) -> Optional[str]:
+    # Mosaic sublane quantum: LN partial-reduction outputs are (8, h)
+    return _mult("block_rows", 8)(params, features)
+
+
+def _softmax_check(params: dict, _features: dict) -> Optional[str]:
+    c = params.get("row_chunk", 0)
+    if c < 0:
+        return f"row_chunk={c} must be >= 0 (0 = untiled)"
+    return None
+
+
+TUNABLES: Dict[str, Tunable] = {
+    t.kernel: t
+    for t in (
+        Tunable(
+            kernel="flash",
+            params={
+                "block_q": [128, 256, 512, 1024],
+                "block_k": [128, 256, 512, 1024],
+                "backend": ["pallas", "jnp"],
+            },
+            check=_flash_check,
+            doc="Flash attention fwd/bwd, resident + streaming families "
+                "(class features carry pass/family/causal/GQA).",
+            defaults_from="cost_model.flash_block_default / "
+                          "flash_backend_default",
+            env={"block_q": "APEX_TPU_FLASH_BLOCK",
+                 "block_k": "APEX_TPU_FLASH_BLOCK",
+                 "backend": "APEX_TPU_USE_PALLAS"},
+        ),
+        Tunable(
+            kernel="layer_norm",
+            params={"block_rows": [8, 16, 32, 64, 128, 256, 512]},
+            check=_rows_check,
+            doc="Rows per grid step of the LN fwd/bwd kernels.",
+            defaults_from="cost_model.ln_block_rows_default",
+            env={"block_rows": "APEX_TPU_LN_BLOCK_ROWS"},
+        ),
+        Tunable(
+            kernel="rms_norm",
+            params={"block_rows": [8, 16, 32, 64, 128, 256, 512]},
+            check=_rows_check,
+            doc="Rows per grid step of the RMSNorm fwd/bwd kernels.",
+            defaults_from="cost_model.ln_block_rows_default",
+            env={"block_rows": "APEX_TPU_LN_BLOCK_ROWS"},
+        ),
+        Tunable(
+            kernel="optim_flat",
+            params={"block_rows": [256, 512, 1024, 2048, 4096]},
+            check=_mult("block_rows", 8),
+            doc="128-lane rows per grid step of the flat optimizer "
+                "kernels (adam/lamb/l2norm); class carries the live tile "
+                "count.",
+            defaults_from="cost_model.optim_block_rows_default",
+            env={"block_rows": "APEX_TPU_OPTIM_BLOCK_ROWS"},
+        ),
+        Tunable(
+            kernel="softmax",
+            params={"row_chunk": [0, 1024, 2048, 4096, 8192]},
+            check=_softmax_check,
+            doc="Row tiling of the fused scale/mask softmax family "
+                "(0 = single XLA-fused pass, today's default).",
+            defaults_from="cost_model.softmax_row_chunk_default",
+            env={"row_chunk": "APEX_TPU_SOFTMAX_CHUNK"},
+        ),
+    )
+}
+
+
+def validate_entry(kernel: str, params: dict,
+                   features: Optional[dict] = None) -> None:
+    """Raise ValueError if (kernel, params) is not a legal cache entry.
+    The autotune driver calls this before writing; the cache consumer
+    side stays permissive (unknown keys are ignored, wrong values are
+    clamped) so a hand-edited file degrades, never crashes."""
+    t = TUNABLES.get(kernel)
+    if t is None:
+        raise ValueError(
+            f"unknown kernel family {kernel!r} (known: {sorted(TUNABLES)})"
+        )
+    unknown = set(params) - set(t.params)
+    if unknown:
+        raise ValueError(
+            f"{kernel}: unknown tunable(s) {sorted(unknown)} "
+            f"(known: {sorted(t.params)})"
+        )
+    if t.check is not None:
+        err = t.check(params, features or {})
+        if err:
+            raise ValueError(f"{kernel}: {err}")

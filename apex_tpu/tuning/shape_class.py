@@ -1,0 +1,157 @@
+"""Shape-class keys for the kernel autotuner.
+
+A *shape class* is the equivalence class of call shapes that share one
+tuned kernel configuration. Exact shapes would fragment the cache into
+thousands of entries that can never be swept on real hardware; raw kernel
+names would collapse shapes with very different roofline positions into
+one. The classes here bucket the axes that move the optimum:
+
+- sequence / row counts  -> next power of two (floor 128, the Mosaic lane
+  quantum every block is padded to anyway)
+- hidden / head dim      -> next power of two (floor 8)
+- dtype                  -> canonical short name (bf16 / f16 / f32 / ...)
+- boolean structure      -> causal, GQA (group > 1), streaming family,
+  fwd vs bwd pass
+- device kind            -> normalized jax device_kind ("tpuv5lite",
+  "cpu", ...), so one cache file can carry several generations
+
+The key is a flat, order-stable string — the JSON cache's dict key and
+the unit the autotune driver sweeps::
+
+    flash|tpuv5lite|pass=fwd|family=res|sq=2048|sk=2048|d=128|dt=bf16|causal=1|gqa=0
+
+Everything here is pure string/arithmetic work (no jax imports beyond the
+lazy device probe) so it is safe at trace time inside jitted code.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax
+
+
+def pow2_bucket(n: int, floor: int = 128) -> int:
+    """Smallest power of two >= max(n, 1), clamped below by ``floor``."""
+    n = max(int(n), 1)
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def seq_bucket(s: int) -> int:
+    return pow2_bucket(s, floor=128)
+
+
+def hidden_bucket(h: int) -> int:
+    return pow2_bucket(h, floor=8)
+
+
+def dtype_token(dtype) -> str:
+    """Canonical short dtype name ("bfloat16" -> "bf16")."""
+    import jax.numpy as jnp
+
+    name = jnp.dtype(dtype).name if dtype is not None else "f32"
+    return {
+        "bfloat16": "bf16",
+        "float16": "f16",
+        "float32": "f32",
+        "float64": "f64",
+        "float8_e4m3fn": "f8e4m3",
+        "float8_e5m2": "f8e5m2",
+    }.get(name, name)
+
+
+def device_kind() -> str:
+    """Normalized device kind of the default backend ("tpuv5lite", "cpu").
+
+    Never raises: before backend init (or when init fails) it reports
+    "cpu", matching ops/_utils.on_tpu's conservatism.
+    """
+    try:
+        kind = getattr(jax.devices()[0], "device_kind", "cpu")
+    except Exception:  # pragma: no cover — backend init failure
+        kind = "cpu"
+    return str(kind).lower().replace(" ", "")
+
+
+def class_key(kernel: str, features: Mapping[str, object],
+              device: str | None = None) -> str:
+    """Build the canonical cache key for (kernel, shape class).
+
+    ``features`` values are rendered as ``k=v`` tokens in sorted key
+    order; booleans render as 0/1 so keys are diff-stable across python
+    versions. ``device`` defaults to the current backend's kind.
+    """
+    dev = device if device is not None else device_kind()
+    toks = []
+    for k in sorted(features):
+        v = features[k]
+        if isinstance(v, bool):
+            v = int(v)
+        toks.append(f"{k}={v}")
+    return "|".join([kernel, dev] + toks)
+
+
+# ------------------------------------------------------------------
+# per-kernel feature builders — ONE place defines what each kernel's
+# shape class looks like, shared by the ops layer, the autotune driver
+# and the committed snapshots (a key built anywhere matches everywhere)
+# ------------------------------------------------------------------
+
+def flash_features(sq: int, sk: int, d: int, dtype, causal: bool,
+                   group: int, streaming: bool, bwd: bool) -> dict:
+    return {
+        "pass": "bwd" if bwd else "fwd",
+        "family": "stream" if streaming else "res",
+        "sq": seq_bucket(sq),
+        "sk": seq_bucket(sk),
+        "d": hidden_bucket(d),
+        "dt": dtype_token(dtype),
+        "causal": bool(causal),
+        "gqa": group > 1,
+    }
+
+
+def flash_key(sq, sk, d, dtype, causal, group, streaming, bwd,
+              device=None) -> str:
+    return class_key(
+        "flash",
+        flash_features(sq, sk, d, dtype, causal, group, streaming, bwd),
+        device,
+    )
+
+
+def ln_features(hidden: int, dtype) -> dict:
+    return {"h": hidden_bucket(hidden), "dt": dtype_token(dtype)}
+
+
+def ln_key(kernel: str, hidden: int, dtype, device=None) -> str:
+    """kernel is "layer_norm" or "rms_norm" (separate families: the bwd
+    tile counts differ — LN carries dbeta, RMS does not)."""
+    return class_key(kernel, ln_features(hidden, dtype), device)
+
+
+def optim_features(n_tiles: int) -> dict:
+    """Optimizer flat kernels are shape-oblivious (1-D streams); what
+    moves the block optimum is the LIVE TILE COUNT (operands + outputs,
+    double-buffered) against scoped VMEM — the exact quantity behind the
+    measured _BLOCK_ROWS_WIDE split (pallas_optim.py)."""
+    return {"tiles": int(n_tiles)}
+
+
+def optim_key(n_tiles: int, device=None) -> str:
+    return class_key("optim_flat", optim_features(n_tiles), device)
+
+
+def softmax_features(rows: int, cols: int, dtype) -> dict:
+    return {
+        "rows": seq_bucket(rows),
+        "cols": seq_bucket(cols),
+        "dt": dtype_token(dtype),
+    }
+
+
+def softmax_key(rows: int, cols: int, dtype, device=None) -> str:
+    return class_key("softmax", softmax_features(rows, cols, dtype), device)

@@ -1,3 +1,4 @@
+from apex_tpu.utils import compat  # noqa: F401  — installs jax API shims
 from apex_tpu.utils.pytree import (  # noqa: F401
     tree_all_finite,
     tree_cast,

@@ -1,0 +1,53 @@
+"""JAX version-compat shims, applied on ``import apex_tpu``.
+
+The library targets the current jax API (``jax.shard_map`` with
+``check_vma``, ``jax.lax.axis_size``); older runtimes (observed: 0.4.37 in
+the benchmark container) still spell these ``jax.experimental.shard_map``
+with ``check_rep`` and have no ``lax.axis_size``. Rather than sprinkling
+try/except at ~30 call sites (library, tests, examples all call
+``jax.shard_map`` directly), install the modern names once here when they
+are missing. On a current jax this module is a no-op.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def _install_shard_map() -> None:
+    try:
+        jax.shard_map  # noqa: B018 — probe; removed names raise
+        return
+    except AttributeError:
+        pass
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(*args, **kwargs):
+        # the modern kwarg is check_vma; the experimental one is check_rep
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+    shard_map.__doc__ = _shard_map.__doc__
+    jax.shard_map = shard_map
+
+
+def _install_axis_size() -> None:
+    if hasattr(lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        """Size of a bound mesh axis (modern lax.axis_size): the count of
+        participants, computed collectively."""
+        return lax.psum(1, axis_name)
+
+    lax.axis_size = axis_size
+
+
+def install() -> None:
+    _install_shard_map()
+    _install_axis_size()
+
+
+install()

@@ -25,6 +25,18 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 _METRIC = "bert_large_amp_o2_fused_lamb_samples_per_sec_per_chip"
 
+# --compile-only: AOT-lower + compile every queued rung's jitted step and
+# print a per-rung compile verdict WITHOUT timing a single rep — the
+# dry-compile gate (round-5 verdict Next #2), so tunnel minutes are never
+# spent discovering compile errors. --autotune: run the kernel autotune
+# sweep (apex_tpu.tuning.autotune) instead of the step benchmark and write
+# the tune cache. Both emit one JSON line under their own metric names so
+# they can never masquerade as a samples/sec measurement.
+_COMPILE_ONLY = "--compile-only" in sys.argv[1:]
+_AUTOTUNE = "--autotune" in sys.argv[1:]
+_COMPILE_METRIC = "bert_large_compile_gate_rungs_ok"
+_AUTOTUNE_METRIC = "apex_tpu_autotune_entries_written"
+
 
 def emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
@@ -240,6 +252,43 @@ def _success_payload(best, sweep, kernels, note=None):
     return payload
 
 
+def _compile_with_timeout(step, args, timeout_s):
+    """AOT-lower + compile in a worker thread with a deadline; never runs
+    the executable. Returns (compile_s | None, err | None) with the same
+    "hung" convention as _measure_with_timeout."""
+    box = {}
+
+    def work():
+        try:
+            t0 = time.perf_counter()
+            step.lower(*args).compile()
+            box["result"] = time.perf_counter() - t0
+        except BaseException as e:  # noqa: BLE001 — a failing rung is data
+            box["error"] = e
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return None, "hung"
+    if "error" in box:
+        return None, box["error"]
+    return box["result"], None
+
+
+def _compile_only_payload(rungs, kernels):
+    ok_count = sum(1 for r in rungs if r.get("ok"))
+    return {
+        "metric": _COMPILE_METRIC,
+        "value": float(ok_count),
+        "unit": "rungs",
+        "vs_baseline": 0.0,
+        "ok": ok_count > 0,
+        "compile_only": True,
+        "detail": {"rungs": rungs, "kernels": kernels},
+    }
+
+
 def _measure_with_timeout(step, args, iters, timeout_s):
     """Run _measure in a worker thread with a deadline. A hung remote
     compile cannot be interrupted from Python, so on timeout the caller
@@ -287,6 +336,30 @@ def main():
     # (round-2 lesson: one bad block spec must cost a log line, not the bench)
     kernel_report = apex_tpu.preflight()
     _SO_FAR["kernels"] = kernel_report
+
+    if _AUTOTUNE:
+        # sweep the kernel tunable space instead of the step benchmark:
+        # real timing on hardware, interpret+projection on CPU; entries
+        # land in the tune cache (BENCH_TUNEDB_OUT overrides the path)
+        from apex_tpu.tuning import autotune as _at
+
+        db = _at.run(
+            interpret=on_cpu,
+            out=os.environ.get("BENCH_TUNEDB_OUT"),
+            seqs=None if on_cpu else [512, 1024, 2048],
+            hiddens=None if on_cpu else [1024],
+            quick=on_cpu,
+            log=lambda m: print(m, file=sys.stderr, flush=True),
+        )
+        emit({
+            "metric": _AUTOTUNE_METRIC,
+            "value": float(len(db.entries)),
+            "unit": "entries",
+            "vs_baseline": 0.0,
+            "ok": len(db.entries) > 0,
+            "autotune": True,
+        })
+        return
 
     if on_cpu:
         toy = TransformerConfig(
@@ -350,6 +423,7 @@ def main():
 
     mesh = Mesh([dev], ("model",))
     sweep = _SO_FAR["sweep"]  # shared: partial emitters see live appends
+    compile_rungs = []
     best = None
     for batch, cfg, n_accum, opt_in_scan in plan:
         s = cfg.seq_len
@@ -410,6 +484,42 @@ def main():
             (specs, sspec),
         ), donate_argnums=(0, 1))
 
+        if _COMPILE_ONLY:
+            # dry-compile gate: lower+compile, verdict line, NO timed rep
+            compile_s, err = _compile_with_timeout(
+                step, (params, state, tokens, labels, loss_mask),
+                timeout_s=float(
+                    os.environ.get("BENCH_BATCH_TIMEOUT_S", "900")),
+            )
+            rung = {"batch": batch, "remat": remat_name, "seq": s}
+            if err == "hung":
+                # the worker still holds the device client; later rungs
+                # would queue behind it — report what we have and stop
+                print(f"bench: compile-only rung batch={batch} "
+                      f"remat={remat_name}: HUNG — sweep truncated",
+                      file=sys.stderr, flush=True)
+                rung.update(ok=False, skipped=True, error="compile hung")
+                compile_rungs.append(rung)
+                payload = _compile_only_payload(compile_rungs, kernel_report)
+                emit(payload)
+                os._exit(0 if payload["ok"] else 3)
+            elif err is not None:
+                print(f"bench: compile-only rung batch={batch} "
+                      f"remat={remat_name}: FAILED — marked skipped "
+                      f"({type(err).__name__}: "
+                      f"{str(err).splitlines()[0][:200]})",
+                      file=sys.stderr, flush=True)
+                rung.update(ok=False, skipped=True,
+                            error=str(err).splitlines()[0][:200])
+                compile_rungs.append(rung)
+            else:
+                print(f"bench: compile-only rung batch={batch} "
+                      f"remat={remat_name}: OK ({compile_s:.1f}s)",
+                      file=sys.stderr, flush=True)
+                rung.update(ok=True, compile_s=round(compile_s, 1))
+                compile_rungs.append(rung)
+            continue
+
         result, err = _measure_with_timeout(
             step, (params, state, tokens, labels, loss_mask),
             iters=5 if on_cpu else 20,
@@ -448,6 +558,10 @@ def main():
         if best is None or row["samples_per_sec"] > best["samples_per_sec"]:
             best = row
             _SO_FAR["best"] = row
+
+    if _COMPILE_ONLY:
+        emit(_compile_only_payload(compile_rungs, kernel_report))
+        return
 
     if best is None:
         raise RuntimeError(f"all batch sizes failed: {sweep}")

@@ -714,14 +714,16 @@ def test_bwd_block_override(monkeypatch):
 
 def test_block_size_and_family_routing(monkeypatch):
     """Pin the measured v5e routing defaults (BASELINE.md 2026-07-31):
-    resident family to 4096 (512-block <= 2048, 256 above), streaming
-    family above 4096 at 512-block; env override wins and is clamped."""
+    resident family to 4096 (512-block BELOW 2048, 256 from 2048 up —
+    the s=2048 class moved to 256, fixing the measured ~1.6x regression
+    of the old 512 rule there, VERDICT r5 Weak #3), streaming family
+    above 4096 at 512-block; env override wins and is clamped."""
     from apex_tpu.ops import attention as A
 
     monkeypatch.delenv("APEX_TPU_FLASH_BLOCK", raising=False)
     monkeypatch.delenv("APEX_TPU_FLASH_STREAM", raising=False)
     assert A._block_size(512) == 512
-    assert A._block_size(2048) == 512
+    assert A._block_size(2048) == 256          # regression-fix class
     assert A._block_size(4096) == 256          # resident above 2048
     assert A._block_size(16384, streaming=True) == 512
     assert A._block_size(256, streaming=True) == 256  # clamp to padded seq

@@ -1,0 +1,44 @@
+"""Hardware autotune sweep — tpu tier (APEX_TPU_HW=1 on a real chip).
+
+A small real sweep: candidates compile under Mosaic and are timed, the
+winner lands in a tunedb whose entries validate against the registry and
+are consulted by the kernel layer on the next call. The CPU suite proves
+the machinery in interpret mode; only this tier proves the Mosaic-compiled
+configs and produces transferable measured entries.
+"""
+
+import json
+
+import pytest
+
+pytestmark = [pytest.mark.tpu, pytest.mark.slow]
+
+
+def test_hardware_sweep_flash_small(tmp_path):
+    import jax.numpy as jnp
+
+    from apex_tpu.tuning import autotune, cache, registry, shape_class
+
+    out = tmp_path / "tunedb.json"
+    db = autotune.run(out=str(out), interpret=False, kernels=["flash"],
+                      seqs=[512], reps=3, quick=True, log=print)
+    data = json.loads(out.read_text())
+    assert data["entries"]
+    for key, entry in data["entries"].items():
+        registry.validate_entry(key.split("|", 1)[0], entry["params"])
+        assert entry["source"] == "hardware"
+        assert entry.get("ms", 0) > 0  # really timed, not projected
+    key = shape_class.flash_key(512, 512, 64, jnp.bfloat16, True, 1,
+                                False, False)
+    assert db.get(key) is not None
+
+
+def test_hardware_sweep_optim(tmp_path):
+    from apex_tpu.tuning import autotune, shape_class
+
+    out = tmp_path / "tunedb.json"
+    db = autotune.run(out=str(out), interpret=False,
+                      kernels=["optim_flat"], reps=3, quick=True,
+                      log=print)
+    assert db.get(shape_class.optim_key(7)) is not None
+    assert db.get(shape_class.optim_key(2)) is not None
