@@ -103,20 +103,39 @@ def my_shard(flat, axis_name: str):
     return lax.dynamic_slice_in_dim(flat, idx * shard_size, shard_size)
 
 
-def reduce_scatter_flat(flat, axis_name: str, *, mean: bool = True):
+def reduce_scatter_flat(flat, axis_name: str, *, mean: bool = True,
+                        quantized: bool | None = None):
     """reduce_scatter a flat gradient so each device owns the reduced
-    values of its shard (ref: the per-bucket reduce-scatter hooks)."""
+    values of its shard (ref: the per-bucket reduce-scatter hooks).
+
+    ``quantized=None`` follows ``APEX_TPU_QUANTIZED_COMMS``; True routes
+    through the int8 per-chunk-scaled psum_scatter with error
+    compensation (parallel/quantized_collectives.py — halves the wire
+    bytes of the ZeRO-2 gradient reduce-scatter). False (or the gate off)
+    is the exact path, bitwise-identical to the unquantized
+    implementation."""
     n = lax.psum(1, axis_name)
-    shard = lax.psum_scatter(
-        flat.reshape(n, flat.shape[0] // n), axis_name, scatter_dimension=0,
-        tiled=False,
-    )
+    if quantized is None:
+        from apex_tpu.parallel.overlap import quantized_comms_enabled
+
+        quantized = quantized_comms_enabled()
+    if quantized:
+        from apex_tpu.parallel.quantized_collectives import (
+            quantized_psum_scatter,
+        )
+
+        shard = quantized_psum_scatter(flat, axis_name)
+    else:
+        shard = lax.psum_scatter(
+            flat.reshape(n, flat.shape[0] // n), axis_name,
+            scatter_dimension=0, tiled=False,
+        )
     if mean:
         shard = shard / n
     return shard
 
 
-def all_gather_flat(shard, axis_name: str):
+def all_gather_flat(shard, axis_name: str, *, chunks: int = 1):
     """Inverse: gather every device's updated shard into the full flat
     array (ref: the all-gather of updated params).
 
@@ -125,13 +144,34 @@ def all_gather_flat(shard, axis_name: str):
     all_gather output is replicated (no all_gather_invariant in this JAX),
     and the optimizer's contract is that the returned params are replicated
     across the axis. XLA lowers this to one all-reduce over ICI.
+
+    ``chunks > 1`` splits the shard into that many independently-psummed
+    pieces. The full array is only assembled locally, so a consumer that
+    needs early pieces (the ZeRO allgather-prefetch path: the embedding
+    and first layers' params live at low flat offsets) can start compute
+    as soon as its pieces land while later pieces are still in flight —
+    the monolithic form serializes everything behind one collective.
+    ``chunks=1`` is the original single-psum path, bit-for-bit.
     """
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
-    full = jnp.zeros((n * shard.shape[0],), shard.dtype)
-    full = lax.dynamic_update_slice_in_dim(full, shard, idx * shard.shape[0],
-                                           0)
-    return lax.psum(full, axis_name)
+    s = shard.shape[0]
+    chunks = max(1, min(int(chunks), s)) if s else 1
+    if chunks == 1:
+        full = jnp.zeros((n * s,), shard.dtype)
+        full = lax.dynamic_update_slice_in_dim(full, shard, idx * s, 0)
+        return lax.psum(full, axis_name)
+    base = -(-s // chunks)  # ceil; ragged last piece
+    full = jnp.zeros((n * s,), shard.dtype)
+    for off in range(0, s, base):
+        sz = min(base, s - off)
+        piece = lax.dynamic_slice_in_dim(shard, off, sz, 0)
+        buf = jnp.zeros((n * sz,), shard.dtype)
+        buf = lax.dynamic_update_slice_in_dim(buf, piece, idx * sz, 0)
+        buf = lax.psum(buf, axis_name)
+        gathered = buf.reshape(-1, sz)  # row r = rank r's piece
+        full = full.reshape(-1, s).at[:, off:off + sz].set(gathered).reshape(-1)
+    return full
 
 
 def per_tensor_sq_norms(x_shard, ids_shard, num_tensors: int,

@@ -57,7 +57,8 @@ class DistributedFusedAdam:
                  bias_correction: bool = True,
                  max_grad_norm: Optional[float] = None,
                  grad_averaging: bool = True, axis_name: str = "data",
-                 use_pallas: Optional[bool] = None):
+                 use_pallas: Optional[bool] = None,
+                 quantized_comms: Optional[bool] = None):
         self.lr = learning_rate
         self.b1, self.b2, self.eps = b1, b2, eps
         self.weight_decay = weight_decay
@@ -71,6 +72,9 @@ class DistributedFusedAdam:
         # shards); None = platform default (TPU on, CPU oracle path off —
         # decided by benchmarks/bench_optim_kernels.py, see BASELINE.md).
         self.use_pallas = use_pallas
+        # int8 gradient reduce-scatter (parallel/quantized_collectives.py);
+        # None = follow APEX_TPU_QUANTIZED_COMMS, False = force exact
+        self.quantized_comms = quantized_comms
         self._meta: Optional[FlatMeta] = None
 
     # -- metadata ----------------------------------------------------------
@@ -103,10 +107,39 @@ class DistributedFusedAdam:
              scale=1.0):
         """One ZeRO-2 update. ``scale`` divides the gradients (loss-scale
         unscaling, amp interop). Returns (new_params, new_state)."""
+        new_state = self.step_shard(params, grads, state, scale=scale)
+        # chunks=1: the original single-collective gather, unchanged for
+        # step() users; prefetch callers pick the chunked form explicitly
+        return self.gather_params(new_state, chunks=1), new_state
+
+    def gather_params(self, state: DistAdamState, *, chunks: int = 8):
+        """Replicated params from the sharded fp32 master — the reference's
+        post-step all-gather, callable separately so a train loop can
+        PREFETCH: call this at the top of the next step (or pass it to
+        ``parallel.grad_accum.accumulate_and_step_prefetch``) instead of
+        consuming ``step``'s gathered output, and the gather lands in the
+        same XLA program as the first microbatch's forward — chunked
+        (``chunks`` independent psums), so early-offset leaves (embedding,
+        first blocks) unblock compute while later chunks are in flight.
+        Ref: distributed_fused_adam.py's all-gather-overlapped-with-next-
+        forward; arxiv 2004.13336 motivates the same overlap for sharded
+        weight updates."""
+        meta = self._require_meta()
+        flat_p = all_gather_flat(state.master, self.axis_name, chunks=chunks)
+        return unflatten(flat_p, meta)
+
+    def step_shard(self, params, grads, state: DistAdamState, *,
+                   scale=1.0) -> DistAdamState:
+        """The update WITHOUT the trailing params all-gather: reduce-scatter
+        + per-shard Adam only, returning the new sharded state. Pair with
+        :meth:`gather_params` (the allgather-prefetch split,
+        ``APEX_TPU_ZERO_PREFETCH=1`` paths); ``step`` is exactly
+        ``step_shard`` + ``gather_params``."""
         meta = self._require_meta()
         ax = self.axis_name
         flat_g = flatten_fp32(grads, meta)
-        gshard = reduce_scatter_flat(flat_g, ax, mean=self.grad_averaging)
+        gshard = reduce_scatter_flat(flat_g, ax, mean=self.grad_averaging,
+                                     quantized=self.quantized_comms)
         gshard = gshard / scale
 
         # fused global-norm clip (ref: multi_tensor_l2norm + allreduce)
@@ -162,9 +195,7 @@ class DistributedFusedAdam:
         def skip(_):
             return DistAdamState(state.step, state.master, state.m, state.v)
 
-        new_state = lax.cond(finite, do_update, skip, None)
-        flat_p = all_gather_flat(new_state.master, ax)
-        return unflatten(flat_p, meta), new_state
+        return lax.cond(finite, do_update, skip, None)
 
     def _require_meta(self) -> FlatMeta:
         if self._meta is None:

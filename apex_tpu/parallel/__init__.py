@@ -1,10 +1,16 @@
 """apex_tpu.parallel — data parallelism over the mesh ``data`` axis
 (ref: apex/parallel)."""
 
-from apex_tpu.parallel import collectives, mesh  # noqa: F401
+from apex_tpu.parallel import (  # noqa: F401
+    collectives,
+    mesh,
+    overlap,
+    quantized_collectives,
+)
 from apex_tpu.parallel.ddp import DistributedDataParallel  # noqa: F401
 from apex_tpu.parallel.grad_accum import (  # noqa: F401
     accumulate_and_step,
+    accumulate_and_step_prefetch,
     accumulate_gradients,
     split_microbatches,
 )

@@ -23,7 +23,23 @@ on TPU as on NVLink. So:
     fused optimizers consuming flat gradients, ref retain_allreduce_buffers).
 
 ``delay_allreduce`` is accepted for API parity; with one fused program there
-is nothing to delay (documented no-op).
+is nothing to delay (documented no-op) — and it STAYS a no-op when
+quantized comms is on (the quantization decision never keys off it).
+
+Quantized bucket allreduce (EQuARX-style, arxiv 2506.17615): behind
+``APEX_TPU_QUANTIZED_COMMS=1`` (or ``quantized_comms=True``) buckets at
+least ``quantize_min_bytes`` on the wire go through
+``parallel/quantized_collectives.quantized_psum`` — int8-range payload
+on an int16 wire, per-chunk pmax-shared fp32 scales, plus an
+error-compensation pass (2 B/element uncompensated — the bandwidth win —
+or 4 B compensated near-exact; replica-consistent either way, bounds in
+that module's doc). Small buckets stay exact: below the threshold the
+latency is launch-bound, not bandwidth-bound, so quantization would cost
+accuracy for nothing. ``retain_allreduce_buffers=True`` disables
+quantization entirely — the retained flat buckets feed fused optimizers
+that expect exact fp32 reduction semantics, so they must never silently
+carry quantization error. With the gate off the collective path is
+bitwise-identical to the unquantized implementation.
 """
 
 from __future__ import annotations
@@ -66,6 +82,24 @@ class DistributedDataParallel:
     gradient_predivide_factor: float = 1.0
     delay_allreduce: bool = False        # accepted for parity; no-op (see doc)
     retain_allreduce_buffers: bool = False
+    # int8 bucket allreduce: None = follow APEX_TPU_QUANTIZED_COMMS (the
+    # module-doc rules decide per bucket); True/False force it for tests
+    quantized_comms: Optional[bool] = None
+    quantize_min_bytes: int = 2 ** 16    # exact psum below this wire size
+    quantize_chunk: int = 256            # elements per int8 scale group
+
+    def _quantize_bucket(self, wire_bytes: int, dtype) -> bool:
+        """Module-doc rules: gate on, float payload, big enough on the
+        wire, and never when the reduced flat buckets are retained."""
+        on = self.quantized_comms
+        if on is None:
+            from apex_tpu.parallel.overlap import quantized_comms_enabled
+
+            on = quantized_comms_enabled()
+        return (bool(on)
+                and not self.retain_allreduce_buffers
+                and jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+                and wire_bytes >= self.quantize_min_bytes)
 
     def _buckets(self, leaves) -> Sequence[Sequence[int]]:
         """Greedy size-based bucketing by leaf index, segregated by dtype so
@@ -120,7 +154,16 @@ class DistributedDataParallel:
                     x32 = x.astype(jnp.float32) if self.allreduce_always_fp32 else x
                     parts.append((x32 * pre).reshape(-1))
                 flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
-                flat = lax.psum(flat, self.axis_name)
+                if self._quantize_bucket(
+                        flat.size * flat.dtype.itemsize, flat.dtype):
+                    from apex_tpu.parallel.quantized_collectives import (
+                        quantized_psum,
+                    )
+
+                    flat = quantized_psum(flat, self.axis_name,
+                                          chunk=self.quantize_chunk)
+                else:
+                    flat = lax.psum(flat, self.axis_name)
                 flat = flat * post
             flat_buckets.append(flat)
             # unpack

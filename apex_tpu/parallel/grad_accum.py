@@ -154,3 +154,37 @@ def accumulate_and_step(loss_fn, params, state, batch, n_micro: int,
         body, (params, state, jnp.float32(0.0), zeros),
         (batches, jnp.arange(n_micro, dtype=jnp.int32)))
     return loss_sum * inv, params, state
+
+
+def accumulate_and_step_prefetch(loss_fn, state, batch, n_micro: int,
+                                 apply_fn, gather_fn,
+                                 accum_dtype=jnp.float32,
+                                 with_index: bool = False):
+    """ZeRO allgather-prefetch form: the parameters are NOT an input —
+    they are materialized from the sharded optimizer ``state`` by
+    ``gather_fn`` INSIDE the compiled step, immediately before the first
+    microbatch's forward.
+
+    Why (arxiv 2004.13336, the weight-update-sharding overlap): a ZeRO
+    optimizer whose ``step`` ends with the parameter all-gather serializes
+    that collective at the step boundary — it finishes in one XLA program,
+    and the next program's first forward waits on all of it. Moving the
+    gather here puts it in the SAME program as the forward it feeds, and
+    with a chunked gather (``DistributedFusedAdam.gather_params``: one
+    independent psum per chunk) the scheduler starts the embedding/early-
+    block compute as soon as their low-offset chunks land while later
+    chunks are still on the wire. Behind ``APEX_TPU_ZERO_PREFETCH=1`` in
+    the bench/dryrun harnesses; call signature:
+
+      ``gather_fn(state) -> params``          (e.g. ``opt.gather_params``)
+      ``apply_fn(mean_grads, state, params) -> new_state``  (sharded; e.g.
+      ``opt.step_shard`` — NO trailing gather)
+
+    Returns ``(mean_loss, new_state)`` — the params never round-trip
+    through the caller, so the next step gathers from the fresh shards.
+    Numerically identical to gather-at-step-end (same collectives, same
+    summands, different program placement)."""
+    params = gather_fn(state)
+    loss, mean = accumulate_gradients(
+        loss_fn, params, batch, n_micro, accum_dtype, with_index)
+    return loss, apply_fn(mean, state, params)

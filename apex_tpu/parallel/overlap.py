@@ -1,0 +1,378 @@
+"""Communication-overlap subsystem: decomposed collective matmul.
+
+The MFU gap left after the kernel-autotuning PR is exposed *collective
+latency*: the TP hot paths issue one monolithic ``all_gather`` /
+``psum_scatter`` per matmul and depend on XLA's latency-hiding scheduler
+to find overlap — which it cannot, because the collective and the matmul
+are data-dependent end to end. The classic fix (XLA's own "collective
+matmul" rewrite; Wang et al., "Overlap Communication with Dependent
+Computation via Decomposition", ASPLOS 2023) is to DECOMPOSE the pair:
+
+  all-gather -> matmul      becomes   N partial matmuls, one per ring
+                                      chunk, each overlapped with the
+                                      ``ppermute`` that fetches the next
+                                      chunk;
+  matmul -> reduce-scatter  becomes   N partial matmuls feeding a ring of
+                                      shifted partial-sum accumulators.
+
+Each hop's ``ppermute`` is a neighbor DMA on ICI with no data dependence
+on the *current* chunk's matmul, so the scheduler genuinely overlaps
+them; the exposed time drops from one full collective to one chunk hop.
+
+Both fused ops carry a ``jax.custom_vjp`` whose backward decomposes
+symmetrically:
+
+  y = all_gather(x) @ A : dx = decomposed reduce_scatter(dy @ A^T)
+                          dA = ring-accumulated  x_chunk^T @ dy_slice
+  y = reduce_scatter(x @ A) : dx = decomposed all_gather(dy) @ A^T
+                              dA = ring-accumulated x_slice^T @ dy_chunk
+
+so neither direction ever materializes the gathered operand while still
+issuing only neighbor DMAs.
+
+Chunking: the local block is split into ``chunks`` pieces which alternate
+ring direction (even pieces travel +1, odd pieces -1) — ``chunks=2`` is
+the classic bidirectional ring (both ICI link directions busy, per-hop
+latency halved), larger values pipeline finer. The count is a registered
+tunable (``tuning/registry.py::overlap_tp``) resolved env >
+tune-cache > cost-model default, like every other kernel knob. Ragged
+splits (chunk count not dividing the local rows) are supported — the last
+piece is simply shorter.
+
+Everything here must run inside ``shard_map``/pmap over ``axis``. All
+partial matmuls accumulate in fp32 on the MXU (``preferred_element_type``)
+exactly like the monolithic path, so decomposed == monolithic to fp32
+summation-order tolerance.
+
+Env gates (all off by default; each lever independent):
+
+  APEX_TPU_OVERLAP_TP=1        decomposed collective matmul in the TP/SP
+                               hot paths (tensor_parallel/layers.py +
+                               mappings.py sequence-parallel region ops)
+  APEX_TPU_OVERLAP_TP_CHUNKS=N chunk-count override (beats the tune cache)
+  APEX_TPU_QUANTIZED_COMMS=1   int8 quantized DDP/ZeRO collectives
+                               (parallel/quantized_collectives.py)
+  APEX_TPU_ZERO_PREFETCH=1     ZeRO param allgather overlapped with the
+                               first microbatch forward (grad_accum.py +
+                               contrib DistributedFusedAdam)
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "all_gather_matmul",
+    "matmul_reduce_scatter",
+    "overlap_tp_enabled",
+    "quantized_comms_enabled",
+    "resolve_chunks",
+    "ring_all_gather",
+    "ring_reduce_scatter",
+    "zero_prefetch_enabled",
+]
+
+
+# -- env gates -------------------------------------------------------------
+
+def overlap_tp_enabled() -> bool:
+    """Decomposed-collective-matmul gate; read at trace time."""
+    return os.environ.get("APEX_TPU_OVERLAP_TP") == "1"
+
+
+def quantized_comms_enabled() -> bool:
+    """Quantized DDP/ZeRO collectives gate; read at trace time."""
+    return os.environ.get("APEX_TPU_QUANTIZED_COMMS") == "1"
+
+
+def zero_prefetch_enabled() -> bool:
+    """ZeRO allgather-prefetch gate; read at trace time."""
+    return os.environ.get("APEX_TPU_ZERO_PREFETCH") == "1"
+
+
+# -- chunk-count resolution (env > tune cache > cost model) ---------------
+
+def resolve_chunks(rows_local: int, n_ranks: int, dtype,
+                   chunks: int | None = None) -> int:
+    """Ring chunk count for a decomposed collective over ``rows_local``
+    local rows and an ``n_ranks`` ring. Explicit argument wins (tests /
+    direct callers), then ``APEX_TPU_OVERLAP_TP_CHUNKS``, then the tuned
+    cache entry for this shape class, then the cost-model default. The
+    result is always clamped to [1, rows_local] so a stale cache entry
+    degrades instead of crashing."""
+    if chunks is None:
+        env = os.environ.get("APEX_TPU_OVERLAP_TP_CHUNKS")
+        if env:
+            try:
+                chunks = int(env)
+            except ValueError:
+                chunks = None
+    if chunks is None:
+        from apex_tpu.tuning import cache, shape_class
+
+        entry = cache.lookup(
+            shape_class.overlap_key(rows_local, n_ranks, dtype))
+        if entry is not None:
+            try:
+                chunks = int(entry.get("chunks"))
+            except (TypeError, ValueError):
+                chunks = None
+    if chunks is None:
+        from apex_tpu.tuning import cost_model
+
+        chunks = cost_model.overlap_chunks_default(rows_local, n_ranks)
+    return max(1, min(int(chunks), max(1, rows_local)))
+
+
+# -- internals -------------------------------------------------------------
+
+def _mm(x, kernel, transpose_kernel: bool = False):
+    """Shard-local GEMM, fp32 MXU accumulation, result in operand dtype —
+    the same contraction the monolithic layers issue."""
+    k = kernel.T if transpose_kernel else kernel
+    return jnp.matmul(x, k, preferred_element_type=jnp.float32).astype(
+        jnp.result_type(x, kernel))
+
+
+def _split_points(rows: int, chunks: int):
+    """Static piece boundaries: ``chunks`` near-equal pieces, ragged last
+    piece when ``chunks`` does not divide ``rows``."""
+    chunks = max(1, min(chunks, rows)) if rows else 1
+    base = -(-rows // chunks)  # ceil
+    offs = list(range(0, rows, base))
+    return [(o, min(base, rows - o)) for o in offs]
+
+
+def _perm(n: int, direction: int):
+    return [(i, (i + direction) % n) for i in range(n)]
+
+
+def _take(x, dim: int, start, size: int):
+    return lax.dynamic_slice_in_dim(x, start, size, dim)
+
+
+def _put(buf, piece, dim: int, start):
+    return lax.dynamic_update_slice_in_dim(buf, piece, start, dim)
+
+
+def _ring_schedule(x, axis: str, dim: int, chunks: int):
+    """Yield ``(piece, src_rank, offset)`` for every (hop, piece) of a
+    bidirectional ring over ``x``'s rank-local block: the local pieces
+    first (src = this rank), then, hop by hop, each remote rank's pieces
+    as their ppermutes deliver them. Even pieces travel +1 (arrive from
+    rank r-t at hop t), odd pieces travel -1 — per-hop transfers split
+    across both ICI link directions. Pure generator of traced values; the
+    caller decides what to do with each delivered piece."""
+    n = lax.axis_size(axis)
+    r = lax.axis_index(axis)
+    pieces = [(_take(x, dim, off, size), off)
+              for off, size in _split_points(x.shape[dim], chunks)]
+    for piece, off in pieces:
+        yield piece, r, off
+    if n == 1:
+        return
+    state = [(piece, off, 1 if i % 2 == 0 else -1)
+             for i, (piece, off) in enumerate(pieces)]
+    for t in range(1, n):
+        nxt = []
+        for piece, off, d in state:
+            piece = lax.ppermute(piece, axis, _perm(n, d))
+            yield piece, (r - d * t) % n, off
+            nxt.append((piece, off, d))
+        state = nxt
+
+
+# -- decomposed plain collectives (no matmul) ------------------------------
+
+def ring_all_gather(x, axis: str, *, dim: int = 0, chunks: int | None = None):
+    """``lax.all_gather(x, axis, axis=dim, tiled=True)`` decomposed into
+    chunked ``ppermute`` neighbor hops, so each chunk transfer is an
+    independently schedulable DMA instead of one fused collective."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    s_loc = x.shape[dim]
+    chunks = resolve_chunks(s_loc, n, x.dtype, chunks)
+    shape = list(x.shape)
+    shape[dim] = n * s_loc
+    out = jnp.zeros(shape, x.dtype)
+    for piece, src, off in _ring_schedule(x, axis, dim, chunks):
+        out = _put(out, piece, dim, src * s_loc + off)
+    return out
+
+
+def ring_reduce_scatter(x, axis: str, *, dim: int = 0,
+                        chunks: int | None = None):
+    """``lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=True)``
+    decomposed: per-destination partial sums circulate the ring, each hop
+    adding the local contribution — the sum arrives fully reduced at its
+    owner after n-1 neighbor hops."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    r = lax.axis_index(axis)
+    if x.shape[dim] % n:
+        raise ValueError(
+            f"dim {dim} size {x.shape[dim]} not divisible by ring size {n}")
+    s_out = x.shape[dim] // n
+    chunks = resolve_chunks(s_out, n, x.dtype, chunks)
+
+    out = None
+    for i, (off, size) in enumerate(_split_points(s_out, chunks)):
+        d = 1 if i % 2 == 0 else -1
+        # an accumulator starting at rank r lands on rank r + d*(n-1)
+        # = r - d after n-1 hops, so it must carry destination r - d's
+        # piece; every rank it passes adds its own contribution.
+        acc = _take(x, dim, ((r - d) % n) * s_out + off, size)
+        for t in range(1, n):
+            acc = lax.ppermute(acc, axis, _perm(n, d))
+            dest = (r + d * (n - 1 - t)) % n
+            acc = acc + _take(x, dim, dest * s_out + off, size)
+        piece_out = acc
+        if out is None:
+            shape = list(x.shape)
+            shape[dim] = s_out
+            out = jnp.zeros(shape, x.dtype)
+        out = _put(out, piece_out, dim, off)
+    return out
+
+
+# -- decomposed all_gather -> matmul --------------------------------------
+
+def _ag_mm_fwd_impl(x, kernel, axis, dim, chunks, transpose_kernel=False):
+    n = lax.axis_size(axis)
+    s_loc = x.shape[dim]
+    out_cols = kernel.shape[0] if transpose_kernel else kernel.shape[1]
+    shape = list(x.shape)
+    shape[dim] = n * s_loc
+    shape[-1] = out_cols
+    y = jnp.zeros(shape, jnp.result_type(x, kernel))
+    chunks = resolve_chunks(s_loc, n, x.dtype, chunks)
+    for piece, src, off in _ring_schedule(x, axis, dim, chunks):
+        y = _put(y, _mm(piece, kernel, transpose_kernel), dim,
+                 src * s_loc + off)
+    return y
+
+
+def _mm_rs_fwd_impl(x, kernel, axis, dim, chunks, transpose_kernel=False):
+    n = lax.axis_size(axis)
+    r = lax.axis_index(axis)
+    if x.shape[dim] % n:
+        raise ValueError(
+            f"dim {dim} size {x.shape[dim]} not divisible by ring size {n}")
+    s_out = x.shape[dim] // n
+    chunks = resolve_chunks(s_out, n, x.dtype, chunks)
+    out = None
+    for i, (off, size) in enumerate(_split_points(s_out, chunks)):
+        d = 1 if i % 2 == 0 else -1
+        acc = _mm(_take(x, dim, ((r - d) % n) * s_out + off, size),
+                  kernel, transpose_kernel)
+        for t in range(1, n):
+            acc = lax.ppermute(acc, axis, _perm(n, d))
+            dest = (r + d * (n - 1 - t)) % n
+            acc = acc + _mm(_take(x, dim, dest * s_out + off, size),
+                            kernel, transpose_kernel)
+        if out is None:
+            shape = list(acc.shape)
+            shape[dim] = s_out
+            out = jnp.zeros(shape, acc.dtype)
+        out = _put(out, acc, dim, off)
+    return out
+
+
+def _ring_weight_grad(circ, indexed, axis, dim, chunks, *, circ_is_lhs,
+                      out_dtype):
+    """dA accumulated over the ring without materializing the gathered
+    operand. ``circ`` is this rank's local block (it circulates);
+    ``indexed`` holds full-length rows addressed by the source rank of
+    each delivered piece. circ_is_lhs=True computes
+    sum_src piece^T @ indexed[src]; False computes
+    sum_src indexed[src]^T @ piece. Accumulation is fp32."""
+    s_loc = circ.shape[dim]
+    n = lax.axis_size(axis)
+    chunks = resolve_chunks(s_loc, n, circ.dtype, chunks)
+
+    def flat2d(a):
+        # fold every non-contracted dim into rows; contraction dim last
+        return a.reshape(-1, a.shape[-1])
+
+    acc = None
+    for piece, src, off in _ring_schedule(circ, axis, dim, chunks):
+        other = _take(indexed, dim, src * s_loc + off, piece.shape[dim])
+        lhs, rhs = (piece, other) if circ_is_lhs else (other, piece)
+        part = jnp.matmul(flat2d(lhs).T, flat2d(rhs),
+                          preferred_element_type=jnp.float32)
+        acc = part if acc is None else acc + part
+    return acc.astype(out_dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def all_gather_matmul(x, kernel, axis: str, dim: int = 0,
+                      chunks: int | None = None):
+    """``all_gather(x, dim) @ kernel`` as one decomposed, overlappable op.
+
+    x: [..., s_loc, ..., k] local block (gather dim ``dim``), kernel:
+    [k, m] shard-local weights. Equals
+    ``lax.all_gather(x, axis, axis=dim, tiled=True) @ kernel`` to fp32
+    summation-order tolerance; the custom backward decomposes into the
+    conjugate matmul->reduce-scatter plus a ring-accumulated weight grad
+    (never materializing the gathered x)."""
+    return _ag_mm_fwd_impl(x, kernel, axis, dim, chunks)
+
+
+def _ag_mm_fwd(x, kernel, axis, dim, chunks):
+    return _ag_mm_fwd_impl(x, kernel, axis, dim, chunks), (x, kernel)
+
+
+def _ag_mm_bwd(axis, dim, chunks, res, dy):
+    x, kernel = res
+    # dx = reduce_scatter(dy @ A^T) — the conjugate decomposed pair
+    dx = _mm_rs_fwd_impl(dy, kernel, axis, dim, chunks,
+                         transpose_kernel=True)
+    # dA = gathered(x)^T @ dy, ring-accumulated while x circulates
+    dk = _ring_weight_grad(x, dy, axis, dim, chunks, circ_is_lhs=True,
+                           out_dtype=kernel.dtype)
+    return dx.astype(x.dtype), dk
+
+
+all_gather_matmul.defvjp(_ag_mm_fwd, _ag_mm_bwd)
+
+
+# -- decomposed matmul -> reduce-scatter ----------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def matmul_reduce_scatter(x, kernel, axis: str, dim: int = 0,
+                          chunks: int | None = None):
+    """``reduce_scatter(x @ kernel, dim)`` as one decomposed op.
+
+    x: [..., s, ..., k] with the scatter dim divisible by the ring size,
+    kernel: [k, m]. Equals ``lax.psum_scatter(x @ kernel, axis,
+    scatter_dimension=dim, tiled=True)`` to fp32 summation-order
+    tolerance: each destination's partial sum circulates the ring,
+    gaining one locally-computed partial matmul per hop — only the
+    destination slice of the product is ever computed per step, so the
+    matmul itself is pipelined against the neighbor DMAs."""
+    return _mm_rs_fwd_impl(x, kernel, axis, dim, chunks)
+
+
+def _mm_rs_fwd(x, kernel, axis, dim, chunks):
+    return _mm_rs_fwd_impl(x, kernel, axis, dim, chunks), (x, kernel)
+
+
+def _mm_rs_bwd(axis, dim, chunks, res, dy):
+    x, kernel = res
+    # d(x@A) = all_gather(dy); dx = all_gather(dy) @ A^T — conjugate pair
+    dx = _ag_mm_fwd_impl(dy, kernel, axis, dim, chunks,
+                         transpose_kernel=True)
+    # dA = x^T @ all_gather(dy), ring-accumulated while dy circulates
+    dk = _ring_weight_grad(dy, x, axis, dim, chunks, circ_is_lhs=False,
+                           out_dtype=kernel.dtype)
+    return dx.astype(x.dtype), dk
+
+
+matmul_reduce_scatter.defvjp(_mm_rs_fwd, _mm_rs_bwd)

@@ -1,0 +1,175 @@
+"""Quantized collectives: int8 per-chunk-scaled psum / psum_scatter.
+
+Gradient all-reduce on the DDP path and the ZeRO-2 gradient
+reduce-scatter move fp32 (or bf16) buckets whose information content is
+far below 32 bits per element — EQuARX (arxiv 2506.17615) shows a
+quantized allreduce recovering most of the exposed-collective gap on TPU
+ICI at negligible quality cost. This module implements the scheme the
+DDP/ZeRO paths opt into behind ``APEX_TPU_QUANTIZED_COMMS=1``:
+
+1. **Per-chunk scaling.** The flat payload is viewed as fixed-size chunks
+   (default 256 elements); each chunk gets its own fp32 scale so one
+   outlier only costs its own chunk's resolution, not the bucket's.
+2. **Shared scales.** Scales must agree across ranks for the integer sum
+   to be exact, so per-chunk absmaxes are ``pmax``-ed over the axis
+   first — a tiny fp32 collective (1/chunk_size of the payload).
+3. **int8-range payload, int16 wire.** Values quantize to [-127, 127]
+   (symmetric, round-to-nearest) and the wire collective runs on int16 —
+   the narrowest dtype whose per-element sum (127 · world_size, world up
+   to 250) cannot overflow, so each pass moves 2 bytes/element, half the
+   fp32 psum's 4 (beyond 250 ranks the wire silently widens to int32 for
+   correctness). Every rank dequantizes identically, so the result is
+   replica-consistent — the property DDP needs to keep parameters
+   bitwise-identical across data ranks.
+4. **fp32 error compensation.** The local quantization residual
+   ``e = x - dequant(quant(x))`` is computed in fp32, quantized at the
+   residual's own (much finer) per-chunk scale, and summed in a second
+   int16 pass that is added back after dequantization. The compensated
+   error per element is bounded by ``amax_e / 254 <= amax_x / (2·254²)``
+   per rank. Wire cost: **2 B/element uncompensated** (the 2× bandwidth
+   win, worst-case relative error ~4e-3 of the chunk absmax) or
+   **4 B/element compensated** (fp32-bandwidth parity, error ~1e-5 —
+   the accuracy-first rollout mode the DDP/ZeRO paths default to; flip
+   ``error_compensation=False`` once a workload's loss curve tolerates
+   the single-pass error to collect the bandwidth win).
+
+The documented error bounds (asserted by
+``tests/L0/test_quantized_comms_fuzz.py`` across the dtype ladder,
+bucket sizes, and ragged last chunks):
+
+  relative error vs fp32 psum, measured against the max |sum| --
+    compensated:   < 1e-4 · world_size
+    uncompensated: < 1e-2 · world_size
+
+All functions must run inside ``shard_map``/pmap over ``axis``. Payload
+dtype is preserved: inputs are upcast to fp32 for scaling, outputs cast
+back to the input dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["quantized_psum", "quantized_psum_scatter"]
+
+DEFAULT_CHUNK = 256
+_QMAX = 127.0
+# int16 sums of int8-range values overflow past 32767/127 ranks; widen
+# (and lose the bandwidth win) rather than corrupt beyond that
+_INT16_MAX_WORLD = 250
+
+
+def _wire_dtype(axis: str):
+    return jnp.int16 if lax.axis_size(axis) <= _INT16_MAX_WORLD \
+        else jnp.int32
+
+
+def _chunk_view(flat32, chunk: int):
+    """[n] fp32 -> ([c, chunk] fp32, pad) with zero padding (zeros
+    quantize exactly, so the ragged tail costs nothing)."""
+    n = flat32.shape[0]
+    chunk = max(1, min(int(chunk), n))
+    pad = (-n) % chunk
+    if pad:
+        flat32 = jnp.concatenate([flat32, jnp.zeros((pad,), jnp.float32)])
+    return flat32.reshape(-1, chunk), pad
+
+
+def _shared_scales(rows, axis: str):
+    """Per-chunk fp32 scales, pmax-shared over ``axis`` so the integer
+    sum dequantizes identically on every rank."""
+    amax = lax.pmax(jnp.max(jnp.abs(rows), axis=1), axis)
+    # a zero chunk on every rank quantizes to zeros; scale 1 avoids 0/0
+    return jnp.where(amax > 0, amax, 1.0) / _QMAX
+
+
+def _quant(rows, scales):
+    q = jnp.round(rows / scales[:, None])
+    return jnp.clip(q, -_QMAX, _QMAX).astype(jnp.int8)
+
+
+def _dequant(qrows, scales):
+    return qrows.astype(jnp.float32) * scales[:, None]
+
+
+def quantized_psum(x, axis: str, *, chunk: int = DEFAULT_CHUNK,
+                   error_compensation: bool = True):
+    """``lax.psum(x, axis)`` with an int8 wire format.
+
+    ``x``: any shape/float dtype. Returns the quantized-allreduce sum in
+    ``x``'s dtype; identical on every rank (replica-consistent). With
+    ``error_compensation`` a second int8 pass carries the fp32
+    quantization residual at its own finer scale (see module doc for the
+    error bounds)."""
+    shape, dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    rows, pad = _chunk_view(flat, chunk)
+
+    wire = _wire_dtype(axis)
+    scales = _shared_scales(rows, axis)
+    q = _quant(rows, scales)
+    total = _dequant(lax.psum(q.astype(wire), axis), scales)
+
+    if error_compensation:
+        resid = rows - _dequant(q, scales)
+        rscales = _shared_scales(resid, axis)
+        rq = _quant(resid, rscales)
+        total = total + _dequant(lax.psum(rq.astype(wire), axis),
+                                 rscales)
+
+    out = total.reshape(-1)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(shape).astype(dtype)
+
+
+def quantized_psum_scatter(x, axis: str, *, chunk: int = DEFAULT_CHUNK,
+                           error_compensation: bool = True):
+    """``lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)`` on a
+    flat [n] payload, with an int8 wire format.
+
+    ``x``: 1-D, length divisible by the axis size. Each rank receives the
+    reduced values of its own shard. Chunking is per shard-slice so the
+    scale table scatters with the payload (rank r dequantizes with the
+    scales of shard r); scales are pmax-shared over the axis exactly as
+    in :func:`quantized_psum`."""
+    if x.ndim != 1:
+        raise ValueError(f"quantized_psum_scatter takes a flat payload, "
+                         f"got shape {x.shape}")
+    n = lax.axis_size(axis)
+    if x.shape[0] % n:
+        raise ValueError(
+            f"payload length {x.shape[0]} not divisible by axis size {n}")
+    dtype = x.dtype
+    shard = x.shape[0] // n
+    chunk = max(1, min(int(chunk), shard))
+    pad = (-shard) % chunk  # ragged last chunk padded PER SHARD, so chunk
+    # rows never straddle a shard boundary and the scale table scatters
+    # cleanly with the payload
+    xs = x.astype(jnp.float32).reshape(n, shard)
+    if pad:
+        xs = jnp.concatenate([xs, jnp.zeros((n, pad), jnp.float32)], axis=1)
+    c = (shard + pad) // chunk  # chunk rows per shard
+    rows2 = xs.reshape(n * c, chunk)
+
+    wire = _wire_dtype(axis)
+
+    def reduce_pass(rows):
+        scales = _shared_scales(rows, axis)
+        q = _quant(rows, scales)
+        # scatter whole shard-blocks of chunk rows: [n, c, chunk]
+        qs = lax.psum_scatter(
+            q.astype(wire).reshape(n, c, chunk), axis,
+            scatter_dimension=0, tiled=False)
+        r = lax.axis_index(axis)
+        my_scales = lax.dynamic_slice_in_dim(scales, r * c, c, 0)
+        resid = rows - _dequant(q, scales)
+        return _dequant(qs.reshape(c, chunk), my_scales), resid
+
+    mine, resid = reduce_pass(rows2)
+    if error_compensation:
+        mine_r, _ = reduce_pass(resid)
+        mine = mine + mine_r
+
+    return mine.reshape(-1)[:shard].astype(dtype)

@@ -81,12 +81,22 @@ def column_parallel_linear(
                 "gather_output is incompatible with sequence parallelism (ref "
                 "asserts the same)"
             )
-        x = gather_from_sequence_parallel_region(
-            x, axis, True  # tensor_parallel_output_grad
-        )
+        from apex_tpu.parallel import overlap
+
+        if overlap.overlap_tp_enabled():
+            # decomposed collective matmul: the seq-dim all-gather and the
+            # GEMM become one ppermute-pipelined op (ring chunks each
+            # overlapped with a partial matmul); its custom_vjp decomposes
+            # the backward reduce-scatter symmetrically
+            y = overlap.all_gather_matmul(x, kernel, axis, 0, None)
+        else:
+            x = gather_from_sequence_parallel_region(
+                x, axis, True  # tensor_parallel_output_grad
+            )
+            y = _matmul(x, kernel)
     else:
         x = copy_to_tensor_model_parallel_region(x, axis)
-    y = _matmul(x, kernel)
+        y = _matmul(x, kernel)
     if bias is not None:
         y = y + bias
     if gather_output:
@@ -115,11 +125,19 @@ def row_parallel_linear(
                 "sequence parallelism requires input_is_parallel (ref asserts)"
             )
         x = scatter_to_tensor_model_parallel_region(x, axis)
-    y_partial = _matmul(x, kernel)
     if sequence_parallel_enabled:
-        y = reduce_scatter_to_sequence_parallel_region(y_partial, axis)
+        from apex_tpu.parallel import overlap
+
+        if overlap.overlap_tp_enabled():
+            # decomposed collective matmul: only the destination slice of
+            # the product is computed per ring step, pipelined against the
+            # partial-sum ppermutes (see parallel/overlap.py)
+            y = overlap.matmul_reduce_scatter(x, kernel, axis, 0, None)
+        else:
+            y = reduce_scatter_to_sequence_parallel_region(
+                _matmul(x, kernel), axis)
     else:
-        y = reduce_from_tensor_model_parallel_region(y_partial, axis)
+        y = reduce_from_tensor_model_parallel_region(_matmul(x, kernel), axis)
     if bias is not None:
         y = y + bias
     return y

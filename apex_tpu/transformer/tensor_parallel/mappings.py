@@ -48,6 +48,32 @@ def _reduce_scatter(x, axis: str, dim: int):
     return lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=True)
 
 
+# -- sequence-parallel collective routing ----------------------------------
+# Behind APEX_TPU_OVERLAP_TP=1 the SP region ops issue their seq-dim
+# collectives as chunked ppermute rings (parallel/overlap.py) instead of
+# one monolithic all_gather/psum_scatter, so XLA's latency-hiding
+# scheduler can interleave the chunk DMAs with neighboring compute. Gate
+# off (the default) keeps the exact lax collectives above — bitwise
+# identical to the pre-overlap behavior. The fully FUSED
+# allgather->matmul / matmul->reduce-scatter decompositions live one
+# level up in layers.py, where the matmul operand is in scope.
+
+def _sp_all_gather(x, axis: str):
+    from apex_tpu.parallel import overlap
+
+    if overlap.overlap_tp_enabled():
+        return overlap.ring_all_gather(x, axis, dim=SEQ_DIM)
+    return _all_gather(x, axis, SEQ_DIM)
+
+
+def _sp_reduce_scatter(x, axis: str):
+    from apex_tpu.parallel import overlap
+
+    if overlap.overlap_tp_enabled():
+        return overlap.ring_reduce_scatter(x, axis, dim=SEQ_DIM)
+    return _reduce_scatter(x, axis, SEQ_DIM)
+
+
 # -- copy: identity fwd, all-reduce bwd -----------------------------------
 
 @partial(jax.custom_vjp, nondiff_argnums=(1,))
@@ -135,7 +161,7 @@ def _sp_scatter_fwd(x, axis):
 
 
 def _sp_scatter_bwd(axis, _, g):
-    return (_all_gather(g, axis, SEQ_DIM),)
+    return (_sp_all_gather(g, axis),)
 
 
 scatter_to_sequence_parallel_region.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
@@ -152,16 +178,16 @@ def gather_from_sequence_parallel_region(
     grad is a *partial sum* per rank and the backward is a reduce-scatter.
     False: the grad is replicated and the backward is a plain split.
     """
-    return _all_gather(x, axis, SEQ_DIM)
+    return _sp_all_gather(x, axis)
 
 
 def _sp_gather_fwd(x, axis, tensor_parallel_output_grad):
-    return _all_gather(x, axis, SEQ_DIM), None
+    return _sp_all_gather(x, axis), None
 
 
 def _sp_gather_bwd(axis, tensor_parallel_output_grad, _, g):
     if tensor_parallel_output_grad:
-        return (_reduce_scatter(g, axis, SEQ_DIM),)
+        return (_sp_reduce_scatter(g, axis),)
     return (_split_along(g, axis, SEQ_DIM),)
 
 
@@ -171,15 +197,15 @@ gather_from_sequence_parallel_region.defvjp(_sp_gather_fwd, _sp_gather_bwd)
 @partial(jax.custom_vjp, nondiff_argnums=(1,))
 def reduce_scatter_to_sequence_parallel_region(x, axis: str):
     """Ref: mappings.py::reduce_scatter_to_sequence_parallel_region."""
-    return _reduce_scatter(x, axis, SEQ_DIM)
+    return _sp_reduce_scatter(x, axis)
 
 
 def _sp_rs_fwd(x, axis):
-    return _reduce_scatter(x, axis, SEQ_DIM), None
+    return _sp_reduce_scatter(x, axis), None
 
 
 def _sp_rs_bwd(axis, _, g):
-    return (_all_gather(g, axis, SEQ_DIM),)
+    return (_sp_all_gather(g, axis),)
 
 
 reduce_scatter_to_sequence_parallel_region.defvjp(_sp_rs_fwd, _sp_rs_bwd)

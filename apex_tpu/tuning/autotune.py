@@ -408,6 +408,87 @@ def sweep_optim(db: cache.TuneDB, *, hardware: bool, reps: int,
 # BASELINE.md projection table
 # ------------------------------------------------------------------
 
+def sweep_overlap(db: cache.TuneDB, *, hardware: bool, reps: int,
+                  log=print) -> None:
+    """Chunk-count sweep for the decomposed collective matmul
+    (parallel/overlap.py, registry family ``overlap_tp``).
+
+    With >= 2 devices of the default backend a real ppermute ring is
+    timed per (rows, ring, dtype) class — median of ``reps`` fused
+    allgather->matmul steps per candidate chunk count, winner recorded
+    with its milliseconds. Single-device sessions (the common 1-chip
+    tunnel) record the cost-model default instead
+    (``source: "cost_model_projection"``), which a later multi-chip
+    session's measured entries overwrite — never the other way around."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = jax.devices()
+    ring = len(devs)
+    # rank-local rows per class; interpret/CPU sessions sweep one small
+    # class (ring mechanics verified, timings meaningless there anyway)
+    ladder = (64, 512, 2048) if hardware else (64,)
+    if ring < 2:
+        for rows in ladder:
+            key = shape_class.overlap_key(rows, 2, jnp.bfloat16)
+            if db.get(key):
+                continue
+            db.record(
+                key,
+                {"chunks": cost_model.overlap_chunks_default(rows, 2)},
+                source="cost_model_projection",
+                note="single-device session; ring not timeable")
+        log("autotune: overlap_tp projection entries recorded (1 device)")
+        return
+
+    from apex_tpu.parallel import overlap as ov
+
+    mesh = Mesh(np.array(devs), ("ring",))
+    hidden = 512
+    for rows in ladder:
+        x = jax.random.normal(jax.random.PRNGKey(0), (rows * ring, hidden),
+                              jnp.bfloat16)
+        w = jax.random.normal(jax.random.PRNGKey(1), (hidden, hidden),
+                              jnp.bfloat16)
+        best = None
+        for chunks in registry.TUNABLES["overlap_tp"].params["chunks"]:
+            if chunks > rows:
+                continue
+
+            def body(xl, wl, chunks=chunks):
+                return ov.all_gather_matmul(xl, wl, "ring", 0, chunks)
+
+            try:
+                fn = jax.jit(jax.shard_map(
+                    body, mesh=mesh, in_specs=(P("ring"), P()),
+                    out_specs=P(), check_vma=False))
+                fn(x, w).block_until_ready()  # compile + warm
+                times = []
+                for _ in range(max(1, reps)):
+                    t0 = time.perf_counter()
+                    fn(x, w).block_until_ready()
+                    times.append(time.perf_counter() - t0)
+                ms = sorted(times)[len(times) // 2] * 1e3
+            except Exception as e:  # noqa: BLE001 — a failing candidate
+                log(f"autotune: overlap_tp rows={rows} chunks={chunks} "
+                    f"failed: {type(e).__name__}: {e}")
+                continue
+            if best is None or ms < best[1]:
+                best = (chunks, ms)
+        if best is None:
+            continue
+        key = shape_class.overlap_key(rows, ring, jnp.bfloat16)
+        entry = {"chunks": best[0]}
+        registry.validate_entry("overlap_tp", entry)
+        db.record(key, entry,
+                  source="hardware" if hardware else "interpret+cost_model",
+                  ms=best[1], note=f"ring={ring} swept")
+        log(f"autotune: overlap_tp rows={rows} ring={ring} -> "
+            f"chunks={best[0]} ({best[1]:.3f} ms)")
+
+
 def projection_table_md(device: Optional[str] = None) -> str:
     """Markdown FLOP/byte projection table over the benched ladder — the
     written per-rung plan VERDICT Next #8b asked for."""
@@ -467,7 +548,8 @@ def run(*, out: Optional[str] = None, interpret: bool = False,
 
 def _run_inner(*, out, kernels, seqs, hiddens, dtype, reps, quick,
                hardware, log) -> "cache.TuneDB":
-    kernels = kernels or ["flash", "layer_norm", "rms_norm", "optim_flat"]
+    kernels = kernels or ["flash", "layer_norm", "rms_norm", "optim_flat",
+                          "overlap_tp"]
     seqs = seqs or ([256] if quick else [256, 512])
     hiddens = hiddens or ([256] if quick else [256, 1024])
     out_path = Path(out) if out else cache.cache_path()
@@ -486,6 +568,8 @@ def _run_inner(*, out, kernels, seqs, hiddens, dtype, reps, quick,
                  hardware=hardware, reps=reps, log=log)
     if "optim_flat" in kernels:
         sweep_optim(db, hardware=hardware, reps=reps, log=log)
+    if "overlap_tp" in kernels:
+        sweep_overlap(db, hardware=hardware, reps=reps, log=log)
     path = db.save(out_path)
     cache.invalidate()  # the freshly-written file is live immediately
     log(f"autotune: wrote {len(db.entries)} entries to {path}")
@@ -503,8 +587,10 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--out", default=None,
                     help=f"output tunedb path (default {cache.cache_path()})")
     ap.add_argument("--kernels",
-                    default="flash,layer_norm,rms_norm,optim_flat",
-                    help="comma list: flash,layer_norm,rms_norm,optim_flat")
+                    default="flash,layer_norm,rms_norm,optim_flat,"
+                            "overlap_tp",
+                    help="comma list: flash,layer_norm,rms_norm,"
+                         "optim_flat,overlap_tp")
     ap.add_argument("--seqs", default=None,
                     help="flash seq classes to sweep, comma list")
     ap.add_argument("--hiddens", default=None,

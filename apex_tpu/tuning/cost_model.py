@@ -263,6 +263,23 @@ def optim_block_rows_default(n_tiles: int, device: str = "cpu") -> int:
 
 
 # ------------------------------------------------------------------
+# decomposed collective matmul (parallel/overlap.py)
+# ------------------------------------------------------------------
+
+def overlap_chunks_default(rows_local: int, n_ranks: int) -> int:
+    """Ring chunk count for the decomposed collective matmul. 2 (the
+    bidirectional ring — both ICI link directions busy, per-hop latency
+    halved) whenever the local block can split; 4 for large blocks where
+    finer pieces pipeline the DMA behind the partial matmuls without the
+    per-ppermute overhead dominating. 1 (plain unidirectional) when the
+    block is a single row or there is no ring. Anything finer is
+    autotune's to prove."""
+    if n_ranks <= 1 or rows_local < 2:
+        return 1
+    return 4 if rows_local >= 512 else 2
+
+
+# ------------------------------------------------------------------
 # softmax tiling
 # ------------------------------------------------------------------
 

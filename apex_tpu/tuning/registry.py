@@ -60,6 +60,13 @@ def _softmax_check(params: dict, _features: dict) -> Optional[str]:
     return None
 
 
+def _overlap_check(params: dict, _features: dict) -> Optional[str]:
+    c = params.get("chunks")
+    if c is not None and c < 1:
+        return f"chunks={c} must be >= 1"
+    return None
+
+
 TUNABLES: Dict[str, Tunable] = {
     t.kernel: t
     for t in (
@@ -104,6 +111,18 @@ TUNABLES: Dict[str, Tunable] = {
                 "count.",
             defaults_from="cost_model.optim_block_rows_default",
             env={"block_rows": "APEX_TPU_OPTIM_BLOCK_ROWS"},
+        ),
+        Tunable(
+            kernel="overlap_tp",
+            params={"chunks": [1, 2, 4, 8]},
+            check=_overlap_check,
+            doc="Ring chunk count of the decomposed collective matmul "
+                "(parallel/overlap.py): pieces of the local block that "
+                "circulate independently, alternating ring direction "
+                "(2 = classic bidirectional). Class carries local rows, "
+                "ring size and dtype.",
+            defaults_from="cost_model.overlap_chunks_default",
+            env={"chunks": "APEX_TPU_OVERLAP_TP_CHUNKS"},
         ),
         Tunable(
             kernel="softmax",

@@ -145,6 +145,23 @@ def optim_key(n_tiles: int, device=None) -> str:
     return class_key("optim_flat", optim_features(n_tiles), device)
 
 
+def overlap_features(rows_local: int, n_ranks: int, dtype) -> dict:
+    """Decomposed-collective-matmul chunking (parallel/overlap.py): the
+    optimum moves with the rank-local row count (how finely the block can
+    split), the ring size (hop count) and the payload dtype. Rows bucket
+    with floor 8 — SP blocks can be tiny on big meshes."""
+    return {
+        "rows": pow2_bucket(rows_local, floor=8),
+        "ring": int(n_ranks),
+        "dt": dtype_token(dtype),
+    }
+
+
+def overlap_key(rows_local: int, n_ranks: int, dtype, device=None) -> str:
+    return class_key(
+        "overlap_tp", overlap_features(rows_local, n_ranks, dtype), device)
+
+
 def softmax_features(rows: int, cols: int, dtype) -> dict:
     return {
         "rows": seq_bucket(rows),

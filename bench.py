@@ -367,10 +367,14 @@ def main():
             causal=False, dtype=jnp.bfloat16, scan_layers=True, remat=True,
         )
         # second/third rows exercise the grad-accumulation and fused
-        # optimizer-in-scan step paths on CPU so the debug smoke covers
-        # all three step_body branches
-        plan = [(4, toy, None, False), (4, toy, 2, False),
-                (4, toy, 2, True)]
+        # optimizer-in-scan step paths on CPU; the last two smoke the
+        # comms-overlap levers (decomposed TP matmul + quantized comms,
+        # and the ZeRO prefetch step) so every step_body branch compiles
+        # in the debug run
+        plan = [(4, toy, None, False, ()), (4, toy, 2, False, ()),
+                (4, toy, 2, True, ()),
+                (4, toy, None, False, ("overlap", "qcomm")),
+                (4, toy, 2, False, ("zero", "zprefetch"))]
     else:
         # BERT-large: 24 x 1024 x 16 heads, seq 512, vocab 30528 (padded)
         from apex_tpu.models import bert_large
@@ -385,7 +389,21 @@ def main():
                 loss_chunk=loss_chunk,
             )
 
-        # BENCH_BATCHES entries are "batch" or "batch@remat_policy" — the
+        # BENCH_BATCHES entries are "batch" or "batch@remat_policy", with
+        # optional "+flag" suffixes toggling the comms-overlap levers for
+        # that rung only (parallel/overlap.py):
+        #   +overlap   APEX_TPU_OVERLAP_TP=1 (decomposed collective matmul)
+        #   +qcomm     APEX_TPU_QUANTIZED_COMMS=1 (int8 collectives)
+        #   +zero      ZeRO-2 DistributedFusedAdam step (gather at step end)
+        #   +zprefetch ZeRO-2 step with the param allgather prefetched into
+        #              the next forward (APEX_TPU_ZERO_PREFETCH split)
+        # — the A/B rungs the next tunnel window measures composed. On a
+        # single chip the collectives run over a size-1 axis, so +overlap
+        # and +qcomm measure gate/quantize overhead only (the decomposed
+        # ring degenerates to the monolithic program at n=1); the rungs
+        # earn their keep on a pod slice, and single-chip they guard
+        # against the levers ever regressing the 1-chip path.
+        # The base BENCH_BATCHES entries are "batch" or "batch@remat_policy" — the
         # sweep can mix remat policies because the best operating point is
         # policy-dependent: measured on v5e (BASELINE.md, 2026-07-31),
         # dots remat fits ONLY at b<=32 where it beats full remat (415.8
@@ -404,8 +422,17 @@ def main():
         for entry in os.environ.get(
                 "BENCH_BATCHES",
                 "32@dots,64,96,128,144,128@dots_accum4,"
-                "128@dots_optscan4").split(","):
-            b, _, pol = entry.strip().partition("@")
+                "128@dots_optscan4,128@dots_accum4+overlap,"
+                "128@dots_accum4+zero,128@dots_accum4+zero+qcomm,"
+                "128@dots_accum4+zero+zprefetch").split(","):
+            spec, *flags = entry.strip().split("+")
+            bad = [f for f in flags
+                   if f not in ("overlap", "qcomm", "zero", "zprefetch")]
+            if bad:
+                raise ValueError(
+                    f"BENCH_BATCHES entry {entry!r}: unknown flag(s) {bad} "
+                    f"(known: overlap, qcomm, zero, zprefetch)")
+            b, _, pol = spec.partition("@")
             pol = pol or default_remat
             # "<policy>_accumN" / "<policy>_optscanN" only when N is a
             # real integer suffix — a malformed "dots_accum" falls
@@ -419,25 +446,68 @@ def main():
             if m:
                 pol, n_accum = m.group(1), int(m.group(3))
                 opt_in_scan = m.group(2) == "optscan"
-            plan.append((int(b), mk_cfg(pol), n_accum, opt_in_scan))
+            plan.append((int(b), mk_cfg(pol), n_accum, opt_in_scan,
+                         tuple(flags)))
 
     mesh = Mesh([dev], ("model",))
     sweep = _SO_FAR["sweep"]  # shared: partial emitters see live appends
     compile_rungs = []
     best = None
-    for batch, cfg, n_accum, opt_in_scan in plan:
+    # per-rung env toggles for the comms-overlap A/B flags; the gates are
+    # read at TRACE time (parallel/overlap.py), so setting them around the
+    # rung's build+compile scopes the lever to that rung only
+    _FLAG_ENV = {"overlap": "APEX_TPU_OVERLAP_TP",
+                 "qcomm": "APEX_TPU_QUANTIZED_COMMS",
+                 "zprefetch": "APEX_TPU_ZERO_PREFETCH"}
+
+    _saved_env: dict = {}
+
+    def _apply_rung_env(flags):
+        """Restore the previous rung's overrides, then set this rung's.
+        Called at the top of every iteration (and once after the loop),
+        so `continue` paths can never leak a lever into the next rung."""
+        for var, v in _saved_env.items():
+            if v is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = v
+        _saved_env.clear()
+        for f in flags:
+            var = _FLAG_ENV.get(f)
+            if var:
+                _saved_env[var] = os.environ.get(var)
+                os.environ[var] = "1"
+
+    for batch, cfg, n_accum, opt_in_scan, flags in plan:
+        _apply_rung_env(flags)
         s = cfg.seq_len
         remat_name = cfg.remat_policy if cfg.remat else "none"
         if n_accum:
             remat_name += f"_{'optscan' if opt_in_scan else 'accum'}{n_accum}"
+        if flags:
+            remat_name += "+" + "+".join(flags)
+        use_zero = "zero" in flags or "zprefetch" in flags
 
         def model_fn(p, tokens, labels, loss_mask, cfg=cfg):
             return bert_loss(p, tokens, labels, loss_mask, cfg)
         params = stack_layer_params(transformer_init(jax.random.PRNGKey(0), cfg))
-        amp_fn, params, opt = amp.initialize(
-            model_fn, params, fused_lamb(1e-3), opt_level="O2", verbosity=0
-        )
-        state = opt.init(params)
+        if use_zero:
+            # ZeRO-2 rung: raw fp32 params + DistributedFusedAdam over the
+            # (size-1 on a single chip) model axis; +zprefetch moves the
+            # param allgather from the step tail into the next forward
+            from apex_tpu.contrib.optimizers import DistributedFusedAdam
+
+            zopt = DistributedFusedAdam(1e-3, axis_name="model")
+            zopt.prepare(params, mesh.shape["model"])
+            pspecs = jax.tree.map(lambda _: P(), params)
+            state = jax.jit(smap(zopt.init_shard, mesh, (pspecs,), P()))(
+                params)
+        else:
+            amp_fn, params, opt = amp.initialize(
+                model_fn, params, fused_lamb(1e-3), opt_level="O2",
+                verbosity=0
+            )
+            state = opt.init(params)
         tokens = jax.random.randint(
             jax.random.PRNGKey(1), (batch, s), 0, cfg.vocab_size
         )
@@ -447,6 +517,45 @@ def main():
         loss_mask = (
             jax.random.uniform(jax.random.PRNGKey(3), (batch, s)) < 0.15
         )
+
+        def zero_step_body(params, state, tokens, labels, loss_mask,
+                           n_accum=n_accum, model_fn=model_fn):
+            from apex_tpu.parallel import (
+                accumulate_and_step_prefetch,
+                accumulate_gradients,
+                overlap,
+            )
+
+            def mb_loss(p, mb):
+                return model_fn(p, mb["t"], mb["l"], mb["m"])
+
+            batch_tree = {"t": tokens, "l": labels, "m": loss_mask}
+            # the env gate IS the mechanism (read at trace time; the
+            # +zprefetch rung flag sets APEX_TPU_ZERO_PREFETCH=1 around
+            # this rung's build+compile) — a user setting the knob gets
+            # the same step restructuring
+            if overlap.zero_prefetch_enabled():
+                # params materialize from the shards INSIDE the step,
+                # chunk-gathered right before the first microbatch forward
+                if n_accum:
+                    _, state = accumulate_and_step_prefetch(
+                        mb_loss, state, batch_tree, n_accum,
+                        lambda g, st, pp: zopt.step_shard(pp, g, st),
+                        zopt.gather_params)
+                else:
+                    p = zopt.gather_params(state)
+                    grads = jax.grad(
+                        lambda pp: model_fn(pp, tokens, labels, loss_mask))(p)
+                    state = zopt.step_shard(p, grads, state)
+                return params, state  # carrier untouched; shards carry
+            if n_accum:
+                _, grads = accumulate_gradients(
+                    mb_loss, params, batch_tree, n_accum)
+            else:
+                grads = jax.grad(
+                    lambda pp: model_fn(pp, tokens, labels, loss_mask))(
+                    params)
+            return zopt.step(params, grads, state)
 
         def step_body(params, state, tokens, labels, loss_mask,
                       n_accum=n_accum, opt_in_scan=opt_in_scan):
@@ -479,7 +588,7 @@ def main():
         specs = jax.tree.map(lambda _: P(), params)
         sspec = jax.tree.map(lambda _: P(), state)
         step = jax.jit(smap(
-            step_body, mesh,
+            zero_step_body if use_zero else step_body, mesh,
             (specs, sspec, P(), P(), P()),
             (specs, sspec),
         ), donate_argnums=(0, 1))
@@ -558,6 +667,8 @@ def main():
         if best is None or row["samples_per_sec"] > best["samples_per_sec"]:
             best = row
             _SO_FAR["best"] = row
+
+    _apply_rung_env(())  # drop the last rung's lever overrides
 
     if _COMPILE_ONLY:
         emit(_compile_only_payload(compile_rungs, kernel_report))

@@ -52,6 +52,20 @@ run lc2048_stream 1800 'TFLOP/s' env APEX_TPU_FLASH_STREAM=1 \
 # (NO XLA_FLAGS vmem probe: --xla_tpu_scoped_vmem_limit_kib is NOT a
 #  client-side flag in this stack — battery5 already hit the
 #  parse-error, BASELINE.md kernel-decisions note; don't re-burn it.)
+# 4b — comms-overlap A/B ladder at the best accum operating point
+#      (PR-2 levers: decomposed TP matmul, quantized comms, ZeRO prefetch;
+#      dry-compile gate first so a compile error costs seconds, not the
+#      measurement window, then the timed sweep). NOTE: on the 1-chip
+#      tunnel the +overlap/+qcomm deltas are gate/quantize OVERHEAD
+#      bounds (size-1 axis degenerates the ring) — the zero-vs-zprefetch
+#      pair is the real single-chip A/B; the full composition needs a
+#      pod-slice window.
+run overlap_gate  1800 '"ok": true' env \
+                       BENCH_BATCHES=128@dots_accum4,128@dots_accum4+overlap,128@dots_accum4+zero,128@dots_accum4+zero+qcomm,128@dots_accum4+zero+zprefetch \
+                       python bench.py --compile-only
+run overlap_ab    5400 '"ok": true' env \
+                       BENCH_BATCHES=128@dots_accum4,128@dots_accum4+overlap,128@dots_accum4+zero,128@dots_accum4+zero+qcomm,128@dots_accum4+zero+zprefetch \
+                       python bench.py
 # 5 — the WHOLE tpu tier in one invocation (19/19 + 5/5 goal)
 run tpu_full      3600 ' passed' env APEX_TPU_HW=1 python -m pytest tests/tpu -v
 # 6 — warm the driver's exact path last
