@@ -44,6 +44,7 @@ _SUBMODULES = (
     "transformer",
     "contrib",
     "models",
+    "serving",
     "testing",
     "tuning",
 )

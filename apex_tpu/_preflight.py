@@ -276,6 +276,32 @@ def _probe_flash_attention_dropout() -> None:
                     f"(stream={stream})")
 
 
+def _probe_paged_attention() -> None:
+    """Decode kernel vs the gather oracle on a tiny ragged paged batch
+    (GQA group 2, partial last pages, one empty slot)."""
+    from apex_tpu.ops.paged_attention import (
+        paged_attention,
+        paged_attention_ref,
+    )
+
+    nb, bs, hkv, d, slots, maxb = 16, 8, 2, 128, 4, 3
+    k_pool = jax.random.normal(jax.random.PRNGKey(0), (nb, bs, hkv, d),
+                               jnp.bfloat16)
+    v_pool = jax.random.normal(jax.random.PRNGKey(1), (nb, bs, hkv, d),
+                               jnp.bfloat16)
+    q = jax.random.normal(jax.random.PRNGKey(2), (slots, 2 * hkv, d),
+                          jnp.bfloat16)
+    tables = jax.random.permutation(
+        jax.random.PRNGKey(3), nb)[: slots * maxb].reshape(slots, maxb)
+    lengths = jnp.array([bs * maxb, 1, 0, bs + 3], jnp.int32)
+    with _pinned_env("APEX_TPU_PAGED_BLOCK_ROWS", None), \
+            _pinned_env("APEX_TPU_PAGED_KV_FETCH", None):
+        got = jax.jit(lambda *a: paged_attention(*a, use_pallas=True))(
+            q, k_pool, v_pool, tables, lengths)
+        ref = paged_attention_ref(q, k_pool, v_pool, tables, lengths)
+    assert _maxdiff(got, ref) < 0.1, "paged_attention mismatch vs oracle"
+
+
 # family name (as consulted by default_use_pallas) -> probe
 PROBES: Dict[str, Callable[[], None]] = {
     "layer_norm": _probe_layer_norm,
@@ -283,6 +309,7 @@ PROBES: Dict[str, Callable[[], None]] = {
     "flash_attention": _probe_flash_attention,
     "flash_attention_stream": _probe_flash_attention_stream,
     "flash_attention_dropout": _probe_flash_attention_dropout,
+    "paged_attention": _probe_paged_attention,
     "optim_flat": _probe_optim_flat,
 }
 

@@ -1,0 +1,295 @@
+"""Ragged paged-attention decode kernel — Pallas fwd with jnp oracle.
+
+Ref: "Ragged Paged Attention" (arxiv 2604.15464, PAPERS.md) — the
+TPU-native inference kernel shape: one decode query token per sequence, a
+ragged batch of sequence lengths, and K/V living in a fixed pool of
+fixed-size blocks ("pages") indexed through per-sequence block tables
+(serving/kv_cache.py owns the pool).
+
+TPU design: the block table and the ragged lengths ride as SCALAR
+PREFETCH operands (pltpu.PrefetchScalarGridSpec), so the K/V page for
+each grid step is selected by the BlockSpec *index map* reading the
+table — the gather happens in the pipeline's own DMAs, never as an XLA
+gather that would materialize the padded [slots, max_seq] KV. Grid is
+(slot, kv_head, fetch-step) with the fetch axis minor; each step pulls
+``kv_fetch`` pages (the pool is passed kv_fetch times with staggered
+index maps, so the pipeline overlaps the page fetches) and folds them
+into the online-softmax accumulator held in VMEM scratch — the same
+(m, l, acc) fp32 recurrence as ops/attention.py. GQA: the q rows of one
+kernel instance are the kv head's whole query group, padded up to
+``block_rows`` sublanes; pages past a sequence's length are skipped via
+pl.when on the *logical* page position, and partial last pages are
+masked per column, so ragged lengths cost masked lanes, not branches.
+
+Decode semantics: ``lengths[s]`` INCLUDES the current token — the
+caller appends the new token's K/V to the cache first (the position the
+query attends to last is its own), which makes causality within the
+step trivial. A slot with length 0 (inactive) outputs exactly 0.
+
+Tunables (``paged_decode`` family, tuning/registry.py): ``block_rows``
+(sublane padding of the query-group tile) and ``kv_fetch`` (pages per
+grid step), resolved env (APEX_TPU_PAGED_BLOCK_ROWS /
+APEX_TPU_PAGED_KV_FETCH) > tune cache > cost model, following the PR-1
+resolution order.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from apex_tpu.ops._utils import default_use_pallas, pallas_interpret
+
+try:
+    from jax.experimental.pallas import tpu as _pltpu
+except Exception:  # pragma: no cover
+    _pltpu = None
+
+_HIGHEST = jax.lax.Precision.HIGHEST
+_NEG_INF = -1e30
+
+
+def _env_int(var: str, *, quantum: int = 1):
+    env = os.environ.get(var)
+    if not env:
+        return None
+    v = int(env)
+    if v <= 0 or v % quantum:
+        raise ValueError(f"{var}={v} must be a positive multiple of {quantum}")
+    return v
+
+
+def _paged_params(n_slots: int, max_blocks: int, block_size: int, group: int,
+                  d: int, dtype) -> dict:
+    """Resolved {"block_rows", "kv_fetch"} for one call: env wins outright,
+    then the tune cache for this shape class, then the cost model — the
+    same three-layer order as every PR-1 family."""
+    from apex_tpu import tuning
+    from apex_tpu.tuning import cost_model
+
+    cfg = tuning.paged_decode_config(n_slots, max_blocks, block_size, group,
+                                     d, dtype)
+    rows = _env_int("APEX_TPU_PAGED_BLOCK_ROWS", quantum=8)
+    fetch = _env_int("APEX_TPU_PAGED_KV_FETCH")
+    return {
+        "block_rows": rows if rows is not None else cfg["block_rows"],
+        "kv_fetch": min(fetch if fetch is not None else cfg["kv_fetch"],
+                        max(1, max_blocks)),
+        "backend": cfg["backend"],
+    }
+
+
+def _auto_use_kernel(n_slots, max_blocks, block_size, group, d, dtype) -> bool:
+    """Backend decision for auto mode (use_pallas=None): preflight registry
+    and APEX_TPU_USE_PALLAS first (ops/_utils.default_use_pallas), then a
+    pinned cache entry ({"backend": "jnp"}) may still route this shape
+    class to the oracle; env=1 beats the cache (env > cache > model)."""
+    if not default_use_pallas("paged_attention"):
+        return False
+    if os.environ.get("APEX_TPU_USE_PALLAS") == "1":
+        return True
+    return _paged_params(n_slots, max_blocks, block_size, group, d,
+                         dtype)["backend"] != "jnp"
+
+
+# ---------------------------------------------------------------------------
+# jnp reference (oracle + fallback)
+# ---------------------------------------------------------------------------
+
+def paged_attention_ref(q, k_pool, v_pool, block_tables, lengths, *,
+                        scale=None):
+    """Unfused oracle: gather the pages, mask the ragged tail, fp32 softmax.
+
+    q: [S, Hq, D]; k_pool/v_pool: [N, bs, Hkv, D];
+    block_tables: [S, max_blocks] int32; lengths: [S] int32.
+    Returns [S, Hq, D]. Materializes [S, max_blocks*bs, Hkv, D] — the
+    memory-bound path the Pallas kernel exists to avoid; used as the
+    fallback and the test oracle."""
+    s_n, hq, d = q.shape
+    nb, bs, hkv, _ = k_pool.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    group = hq // hkv
+    t = block_tables.shape[1] * bs
+    idx = jnp.clip(block_tables, 0, nb - 1)
+    k = k_pool[idx].reshape(s_n, t, hkv, d).astype(jnp.float32)
+    v = v_pool[idx].reshape(s_n, t, hkv, d).astype(jnp.float32)
+    qf = q.reshape(s_n, hkv, group, d).astype(jnp.float32) * scale
+    scores = jnp.einsum("shgd,sthd->shgt", qf, k, precision=_HIGHEST)
+    valid = jnp.arange(t)[None, :] < lengths[:, None]        # [S, T]
+    scores = jnp.where(valid[:, None, None, :], scores, _NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.where(scores > _NEG_INF / 2, jnp.exp(scores - m), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    p = p / jnp.where(l == 0.0, 1.0, l)                      # len 0 -> out 0
+    o = jnp.einsum("shgt,sthd->shgd", p, v, precision=_HIGHEST)
+    return o.reshape(s_n, hq, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(tbl_ref, len_ref, q_ref, *rest, kv_fetch, block_size,
+                   scale, nj, rows):
+    """Grid (slot, kv_head, fetch-step j). rest is kv_fetch k-page refs,
+    kv_fetch v-page refs, the out ref, then (acc, m, l) scratch."""
+    k_refs = rest[:kv_fetch]
+    v_refs = rest[kv_fetch:2 * kv_fetch]
+    o_ref = rest[2 * kv_fetch]
+    acc_ref, m_ref, l_ref = rest[2 * kv_fetch + 1:]
+    del tbl_ref  # consumed by the index maps, not the body
+    si = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = len_ref[si]
+    q = q_ref[0, 0].astype(jnp.float32) * scale               # [rows, D]
+
+    for i in range(kv_fetch):                                 # unrolled
+        page = j * kv_fetch + i                               # logical page
+
+        @pl.when(page * block_size < length)
+        def _(i=i, page=page):
+            kb = k_refs[i][0, :, 0, :].astype(jnp.float32)    # [bs, D]
+            vb = v_refs[i][0, :, 0, :].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                                 # [rows, bs]
+            cols = page * block_size + jax.lax.broadcasted_iota(
+                jnp.int32, (rows, block_size), 1)
+            s = jnp.where(cols < length, s, _NEG_INF)
+            m_i, l_i = m_ref[...], l_ref[...]
+            m_new = jnp.maximum(m_i, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.where(s > _NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+            alpha = jnp.exp(m_i - m_new)
+            l_ref[...] = l_i * alpha + jnp.sum(p, axis=1, keepdims=True)
+            m_ref[...] = m_new
+            acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    @pl.when(j == nj - 1)
+    def _emit():
+        l_safe = jnp.where(l_ref[...] == 0.0, 1.0, l_ref[...])
+        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def _decode_pallas(q, k_pool, v_pool, block_tables, lengths, scale,
+                   block_rows, kv_fetch):
+    s_n, hq, d = q.shape
+    nb, bs, hkv, _ = k_pool.shape
+    group = hq // hkv
+    max_blocks = block_tables.shape[1]
+    rows = max(block_rows, -(-group // 8) * 8)                # sublane pad
+    nj = -(-max_blocks // kv_fetch)
+
+    # [S, Hkv, rows, D] q tile per (slot, kv head); pad group -> rows
+    q4 = q.reshape(s_n, hkv, group, d)
+    if rows != group:
+        q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, rows - group), (0, 0)))
+
+    tbl = jnp.clip(block_tables, 0, nb - 1).reshape(-1).astype(jnp.int32)
+
+    def page_map(i):
+        # logical page j*F+i of slot s; past-the-table steps clamp to the
+        # last entry — their logical position is >= max_blocks*bs, so the
+        # kernel's length mask kills them
+        def index(s, h, j, tbl_ref, len_ref):
+            flat = jnp.clip(s * max_blocks + j * kv_fetch + i, 0,
+                            tbl_ref.shape[0] - 1)
+            return (tbl_ref[flat], 0, h, 0)
+        return index
+
+    in_specs = [pl.BlockSpec((1, 1, rows, d), lambda s, h, j, t, l: (s, h, 0, 0))]
+    args = [q4]
+    for i in range(kv_fetch):
+        in_specs.append(pl.BlockSpec((1, bs, 1, d), page_map(i)))
+        args.append(k_pool)
+    for i in range(kv_fetch):
+        in_specs.append(pl.BlockSpec((1, bs, 1, d), page_map(i)))
+        args.append(v_pool)
+
+    grid_spec = _pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s_n, hkv, nj),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, rows, d),
+                               lambda s, h, j, t, l: (s, h, 0, 0)),
+        scratch_shapes=[
+            _pltpu.VMEM((rows, d), jnp.float32),
+            _pltpu.VMEM((rows, 1), jnp.float32),
+            _pltpu.VMEM((rows, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, kv_fetch=kv_fetch, block_size=bs, scale=scale,
+            nj=nj, rows=rows,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_n, hkv, rows, d), q.dtype),
+        interpret=pallas_interpret(),
+    )(tbl, lengths.astype(jnp.int32), *args)
+    return out[:, :, :group, :].reshape(s_n, hq, d)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def paged_attention(q, k_pool, v_pool, block_tables, lengths, *, scale=None,
+                    use_pallas=None):
+    """Ragged paged-attention decode: one query token per slot against the
+    block-paged KV pool.
+
+    q: [S, Hq, D] (S = decode slots, one token each); k_pool/v_pool:
+    [num_blocks, block_size, Hkv, D] with Hq % Hkv == 0 (GQA shares each
+    KV page across the query group in-kernel); block_tables:
+    [S, max_blocks] int32 page ids (entries past a sequence's pages are
+    ignored); lengths: [S] int32 — tokens visible to the query INCLUDING
+    its own position (append to the cache first). Slots with length 0
+    return exactly 0. No backward: decode is inference-only.
+    """
+    if q.ndim != 3:
+        raise ValueError(f"paged_attention expects q [slots, heads, dim], "
+                         f"got {q.shape}")
+    if k_pool.ndim != 4 or v_pool.shape != k_pool.shape:
+        raise ValueError(
+            f"k/v pools must be [blocks, block_size, kv_heads, dim]: "
+            f"k {k_pool.shape} v {v_pool.shape}")
+    s_n, hq, d = q.shape
+    nb, bs, hkv, dk = k_pool.shape
+    if dk != d or hkv < 1 or hq % hkv:
+        raise ValueError(
+            f"q heads {hq} not a multiple of kv heads {hkv} (or head dim "
+            f"mismatch {d} vs {dk})")
+    if block_tables.shape[0] != s_n or lengths.shape != (s_n,):
+        raise ValueError(
+            f"block_tables {block_tables.shape} / lengths {lengths.shape} "
+            f"do not match {s_n} slots")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    group = hq // hkv
+    max_blocks = block_tables.shape[1]
+
+    use = use_pallas
+    if use is None:
+        use = _auto_use_kernel(s_n, max_blocks, bs, group, d, q.dtype)
+    if not use or _pltpu is None:
+        return paged_attention_ref(q, k_pool, v_pool, block_tables, lengths,
+                                   scale=scale)
+    p = _paged_params(s_n, max_blocks, bs, group, d, q.dtype)
+    return _decode_pallas(q, k_pool, v_pool, block_tables, lengths, scale,
+                          p["block_rows"], p["kv_fetch"])

@@ -1,0 +1,42 @@
+"""apex_tpu.serving — TPU-native inference serving.
+
+Three layers (docs/serving.md):
+
+- ``kv_cache``   — block-paged KV cache: one fixed pool of fixed-size
+                   pages + per-sequence block tables, pure-functional
+                   allocate/append/free (jits, donates, shards).
+- ``scheduler``  — host-side continuous batching: free-block-watermark
+                   admission, slot accounting, eviction.
+- ``engine``     — two fixed-shape jitted programs (prefill + decode;
+                   the decode path is the ragged paged-attention kernel,
+                   ops/paged_attention.py) driven by the scheduler, with
+                   optional tensor-parallel sharded weights reusing the
+                   training layout.
+"""
+
+from apex_tpu.serving.engine import (  # noqa: F401
+    ServingConfig,
+    ServingEngine,
+    greedy_reference,
+)
+from apex_tpu.serving.kv_cache import (  # noqa: F401
+    PagedKVCache,
+    alloc_decode_blocks,
+    allocate_slot,
+    append_layer,
+    blocks_needed,
+    cache_pspecs,
+    check_invariants,
+    free_block_count,
+    free_slot,
+    paged_kv_cache,
+    write_prefill,
+)
+from apex_tpu.serving.scheduler import Request, Scheduler  # noqa: F401
+
+__all__ = [
+    "PagedKVCache", "Request", "Scheduler", "ServingConfig",
+    "ServingEngine", "alloc_decode_blocks", "allocate_slot", "append_layer",
+    "blocks_needed", "cache_pspecs", "check_invariants", "free_block_count",
+    "free_slot", "greedy_reference", "paged_kv_cache", "write_prefill",
+]

@@ -1,0 +1,459 @@
+"""Serving engine — fixed-shape jitted prefill/decode over standalone_gpt.
+
+Two device programs, compiled ONCE each, drive all traffic:
+
+- **prefill**: one request, prompt padded to ``max_prefill_len``. Runs
+  the standard training forward (the SAME tensor-parallel layers and
+  flash kernels as testing/standalone_transformer.py — arxiv 2605.25645's
+  argument for one stack, not a separate serving port), captures each
+  layer's K/V, scatters them into the paged cache
+  (serving/kv_cache.py), and emits the first greedy token from the last
+  prompt position.
+- **decode**: ALL slots at once, one token per active slot (padded
+  active-slot batch — inactive lanes compute masked garbage), each layer
+  appending its K/V at the positions ``alloc_decode_blocks`` reserved
+  and attending through the block table with the ragged paged-attention
+  kernel (ops/paged_attention.py). Shapes never depend on the request
+  mix, so the jit cache sees exactly two signatures over any workload —
+  asserted by trace counters (``engine.trace_counts``).
+
+Continuous batching: the host loop (``ServingEngine.run``) interleaves
+admission->prefill with decode steps under the scheduler's free-block
+watermark (serving/scheduler.py) and evicts finished sequences by
+returning their blocks to the pool, so later arrivals join mid-flight.
+
+Tensor parallelism is the training layout re-used verbatim: weights
+shard via ``param_specs``, the cache's KV heads ride the model axis
+(kv_cache.cache_pspecs), logits stay vocab-parallel and greedy sampling
+argmaxes across shards with a pmax/pmin pair — token-identical to the
+single-device argmax (first-max-wins tie-break in both).
+
+Env knobs (docs/serving.md): ``APEX_TPU_PAGED_BLOCK_SIZE`` (cache page
+size, default 16), ``APEX_TPU_SERVING_MAX_SLOTS`` (decode batch width,
+default 8) — defaults for ServingConfig, explicit arguments win.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.paged_attention import paged_attention
+from apex_tpu.serving import kv_cache as kc
+from apex_tpu.serving.scheduler import Request, Scheduler
+from apex_tpu.testing.commons import smap
+from apex_tpu.testing.standalone_transformer import (
+    TransformerConfig,
+    _lm_logits,
+    _mlp,
+    _norm,
+    param_specs,
+    split_qkv,
+    transformer_forward,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_embedding,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+)
+from apex_tpu.utils.profiling import trace_range
+
+
+def _env_default(var: str, fallback: int) -> int:
+    v = os.environ.get(var)
+    return int(v) if v else fallback
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Engine geometry. ``model`` is the training TransformerConfig the
+    checkpoint was built with; serving supports its dense decode subset
+    (no SP/CP/MoE/dropout — asserted at engine construction)."""
+
+    model: TransformerConfig
+    num_blocks: int = 128
+    block_size: Optional[int] = None        # APEX_TPU_PAGED_BLOCK_SIZE | 16
+    max_slots: Optional[int] = None         # APEX_TPU_SERVING_MAX_SLOTS | 8
+    max_prefill_len: Optional[int] = None   # prompt pad (compile shape)
+    max_seq_len: Optional[int] = None       # context cap per sequence
+    watermark: Optional[int] = None         # admission reserve (None=slots)
+    eos_id: Optional[int] = None            # greedy stop token (None = off)
+    dtype: object = None                    # cache dtype (None = model's)
+
+    def __post_init__(self):
+        s = object.__setattr__
+        if self.block_size is None:
+            s(self, "block_size",
+              _env_default("APEX_TPU_PAGED_BLOCK_SIZE", 16))
+        if self.max_slots is None:
+            s(self, "max_slots",
+              _env_default("APEX_TPU_SERVING_MAX_SLOTS", 8))
+        if self.max_seq_len is None:
+            s(self, "max_seq_len", self.model.seq_len)
+        if self.max_prefill_len is None:
+            s(self, "max_prefill_len", min(self.max_seq_len, 64))
+        if self.dtype is None:
+            s(self, "dtype", self.model.dtype)
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return int(math.ceil(self.max_seq_len / self.block_size))
+
+    @property
+    def n_kv_heads(self) -> int:
+        return self.model.kv_heads or self.model.heads
+
+
+def _vp_greedy(logits, axis: str, tp: int):
+    """Greedy token from vocab-parallel logits [..., v/tp]: global max via
+    pmax, global argmax as the SMALLEST winning index via pmin — the same
+    first-max-wins tie-break as jnp.argmax on the gathered vocab (vocab
+    shards are contiguous in rank order)."""
+    vloc = logits.shape[-1]
+    local_arg = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if tp == 1:
+        return local_arg
+    local_max = jnp.max(logits, axis=-1)
+    gmax = jax.lax.pmax(local_max, axis)
+    cand = jnp.where(local_max >= gmax,
+                     local_arg + jax.lax.axis_index(axis) * vloc,
+                     jnp.int32(2**30))
+    return jax.lax.pmin(cand, axis)
+
+
+def _rope_rows(cfg: TransformerConfig, pos):
+    """Per-slot RoPE table rows at positions ``pos`` [S] (fp32)."""
+    from apex_tpu.ops.rope import rope_frequencies
+
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.seq_len)
+    return cos[pos], sin[pos]
+
+
+def _rope_at(x, cos_rows, sin_rows):
+    """ops/rope._rotate at gathered per-slot positions: x [S, nh, d],
+    cos/sin_rows [S, d//2]. Same split-halves rotation, so decode matches
+    the prefill/training apply_rope bit for bit."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c = cos_rows[:, None, :]
+    s = sin_rows[:, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                           axis=-1).astype(x.dtype)
+
+
+def _check_supported(cfg: TransformerConfig):
+    for flag, msg in (
+        (cfg.sequence_parallel, "sequence_parallel"),
+        (cfg.context_axis is not None, "context parallelism"),
+        (cfg.moe_experts > 0, "MoE layers"),
+        (cfg.scan_layers, "scan_layers (pass unstacked layer params)"),
+        (cfg.dropout_p > 0 or cfg.attn_dropout_p > 0, "dropout"),
+        (not cfg.causal, "bidirectional (BERT) models"),
+    ):
+        if flag:
+            raise NotImplementedError(
+                f"serving engine does not support {msg}")
+
+
+# ---------------------------------------------------------------------------
+# device programs (shard_map-local bodies)
+# ---------------------------------------------------------------------------
+
+def _prefill_body(params, cache, tokens, slot, length, n_blocks, *, cfg,
+                  scfg):
+    """tokens [1, max_prefill_len] -> (cache', first greedy token).
+    The training forward with per-layer K/V capture; pad rows are dropped
+    by write_prefill and causality keeps them out of every valid row."""
+    ax = cfg.model_axis
+    cache = kc.allocate_slot(cache, slot, n_blocks)
+    t_pad = tokens.shape[1]
+    emb = vocab_parallel_embedding(tokens, params["embedding"], axis=ax)
+    if cfg.rope:
+        x = emb.astype(cfg.dtype)
+    else:
+        x = (emb + params["pos_embedding"][None, :t_pad]).astype(cfg.dtype)
+    x = x.transpose(1, 0, 2)                           # [s, 1, h]
+    if cfg.rope:
+        from apex_tpu.ops.rope import apply_rope, rope_frequencies
+
+        rope_tbl = rope_frequencies(cfg.head_dim, cfg.seq_len)
+    ks, vs = [], []
+    for lp in params["layers"]:
+        qkv = column_parallel_linear(
+            _norm(x, lp["ln1"], cfg),
+            lp["qkv"]["kernel"], lp["qkv"]["bias"], axis=ax,
+            gather_output=False)
+        q, k, v = split_qkv(qkv, cfg)                  # [s, 1, nh, d]
+        if cfg.rope:
+            q = apply_rope(q.transpose(1, 0, 2, 3), *rope_tbl).transpose(
+                1, 0, 2, 3)
+            k = apply_rope(k.transpose(1, 0, 2, 3), *rope_tbl).transpose(
+                1, 0, 2, 3)
+        ks.append(k[:, 0])                             # [s, n_kv, d]
+        vs.append(v[:, 0])
+        qh, kh, vh = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
+        o = flash_attention(qh, kh, vh, causal=True)
+        o = o.transpose(2, 0, 1, 3).reshape(t_pad, 1, -1)
+        o = row_parallel_linear(
+            o, lp["proj"]["kernel"], lp["proj"]["bias"], axis=ax,
+            input_is_parallel=True)
+        x = x + o
+        x = x + _mlp(lp, _norm(x, lp["ln2"], cfg), cfg, None)
+    cache = kc.write_prefill(cache, slot, jnp.stack(ks), jnp.stack(vs),
+                             length)
+    x = _norm(x, params["final_ln"], cfg)
+    xl = jax.lax.dynamic_slice_in_dim(x, length - 1, 1, 0)   # [1, 1, h]
+    xl = copy_to_tensor_model_parallel_region(xl, ax)
+    logits = _lm_logits(xl, params, cfg)[0, 0]               # [v/tp]
+    return cache, _vp_greedy(logits, ax, scfg["tp"])
+
+
+def _decode_body(params, cache, tokens, active, *, cfg, scfg):
+    """tokens [max_slots] (each slot's last token), active [max_slots]
+    bool -> (cache', next tokens [max_slots]). One fixed shape forever."""
+    ax = cfg.model_axis
+    cache, block_ids, offsets = kc.alloc_decode_blocks(cache, active)
+    lengths = jnp.where(active, cache.seq_lens, 0)
+    pos = jnp.clip(cache.seq_lens - 1, 0, cfg.seq_len - 1)   # [S]
+    emb = vocab_parallel_embedding(tokens[:, None], params["embedding"],
+                                   axis=ax)[:, 0]            # [S, h]
+    if cfg.rope:
+        x = emb.astype(cfg.dtype)
+        rope_rows = _rope_rows(cfg, pos)
+    else:
+        x = (emb + params["pos_embedding"][pos]).astype(cfg.dtype)
+    x = x[None]                                        # [s=1, b=S, h]
+    for li, lp in enumerate(params["layers"]):
+        qkv = column_parallel_linear(
+            _norm(x, lp["ln1"], cfg),
+            lp["qkv"]["kernel"], lp["qkv"]["bias"], axis=ax,
+            gather_output=False)
+        q, k, v = split_qkv(qkv, cfg)                  # [1, S, nh, d]
+        q, k, v = q[0], k[0], v[0]                     # [S, nh(_kv), d]
+        if cfg.rope:
+            q = _rope_at(q, *rope_rows)
+            k = _rope_at(k, *rope_rows)
+        cache = kc.append_layer(cache, li, block_ids, offsets, k, v)
+        o = paged_attention(q, cache.k_pool[li], cache.v_pool[li],
+                            cache.block_tables, lengths)
+        o = o.reshape(1, o.shape[0], -1)               # [1, S, nh*d]
+        o = row_parallel_linear(
+            o, lp["proj"]["kernel"], lp["proj"]["bias"], axis=ax,
+            input_is_parallel=True)
+        x = x + o
+        x = x + _mlp(lp, _norm(x, lp["ln2"], cfg), cfg, None)
+    x = _norm(x, params["final_ln"], cfg)
+    x = copy_to_tensor_model_parallel_region(x, ax)
+    logits = _lm_logits(x, params, cfg)[0]             # [S, v/tp]
+    return cache, _vp_greedy(logits, ax, scfg["tp"])
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+class ServingEngine:
+    """Continuous-batching driver. ``mesh`` is a Mesh with a "model" axis
+    (size 1 = single chip); weights shard per param_specs, the KV cache
+    per kv_cache.cache_pspecs. All loop state other than the cache is
+    host-side python."""
+
+    def __init__(self, scfg: ServingConfig, params,
+                 mesh: Optional[Mesh] = None):
+        cfg = scfg.model
+        _check_supported(cfg)
+        if mesh is None:
+            mesh = Mesh(jax.devices()[:1], ("model",))
+        tp = mesh.shape.get("model", 1)
+        if scfg.n_kv_heads % tp:
+            raise ValueError(
+                f"kv heads {scfg.n_kv_heads} not divisible by tp={tp}")
+        if scfg.max_seq_len > cfg.seq_len:
+            # holds for rope too: the engine's RoPE tables (and the
+            # unpaged parity oracle) cover cfg.seq_len positions — serving
+            # past them would silently clamp rotations, not extrapolate
+            raise ValueError(
+                f"max_seq_len {scfg.max_seq_len} exceeds the model's "
+                f"position range ({cfg.seq_len})")
+        if scfg.max_prefill_len > scfg.max_seq_len:
+            raise ValueError("max_prefill_len exceeds max_seq_len")
+        self.scfg = scfg
+        self.cfg = cfg
+        self.mesh = mesh
+        self.params = params
+        self.trace_counts = {"prefill": 0, "decode": 0}
+
+        pspec = param_specs(cfg)
+        cspec = kc.cache_pspecs(tp_axis="model")
+        opts = {"cfg": cfg, "scfg": {"tp": tp}}
+        counts = self.trace_counts
+
+        def prefill(params, cache, tokens, slot, length, n_blocks):
+            counts["prefill"] += 1            # trace-time side effect
+            with trace_range("serving.prefill"):
+                return _prefill_body(params, cache, tokens, slot, length,
+                                     n_blocks, **opts)
+
+        def decode(params, cache, tokens, active):
+            counts["decode"] += 1
+            with trace_range("serving.decode"):
+                return _decode_body(params, cache, tokens, active, **opts)
+
+        self._prefill = jax.jit(
+            smap(prefill, mesh,
+                 (pspec, cspec, P(), P(), P(), P()), (cspec, P())),
+            donate_argnums=(1,))
+        self._decode = jax.jit(
+            smap(decode, mesh, (pspec, cspec, P(), P()), (cspec, P())),
+            donate_argnums=(1,))
+        self._free = jax.jit(
+            smap(lambda cache, slot: kc.free_slot(cache, slot), mesh,
+                 (cspec, P()), cspec),
+            donate_argnums=(0,))
+
+    def fresh_cache(self) -> kc.PagedKVCache:
+        s = self.scfg
+        return kc.paged_kv_cache(
+            layers=self.cfg.layers, num_blocks=s.num_blocks,
+            block_size=s.block_size, n_kv_heads=s.n_kv_heads,
+            head_dim=self.cfg.head_dim, max_slots=s.max_slots,
+            max_blocks_per_seq=s.max_blocks_per_seq, dtype=s.dtype)
+
+    # -- the serving loop -------------------------------------------
+    def run(self, requests: List[Request], *, max_steps: int = 10_000,
+            cache: Optional[kc.PagedKVCache] = None) -> Dict[object, dict]:
+        """Serve ``requests`` (arrival-staggered) to completion. Returns
+        {rid: {"tokens": [...], "ttft_step": int, "steps": int}} plus
+        engine stats under the reserved key ``None``."""
+        s = self.scfg
+        sched = Scheduler(
+            max_slots=s.max_slots, num_blocks=s.num_blocks,
+            block_size=s.block_size,
+            max_blocks_per_seq=s.max_blocks_per_seq,
+            watermark=s.watermark)
+        for r in requests:
+            # fail fast at intake: a bad request must not surface as an
+            # opaque shape error mid-batch, after other requests already
+            # prefilled into the donated cache
+            if len(r.prompt) > s.max_prefill_len:
+                raise ValueError(
+                    f"request {r.rid!r}: prompt length {len(r.prompt)} "
+                    f"exceeds max_prefill_len {s.max_prefill_len}")
+            if len(r.prompt) + r.max_new_tokens > s.max_seq_len:
+                raise ValueError(
+                    f"request {r.rid!r}: prompt + max_new_tokens = "
+                    f"{len(r.prompt) + r.max_new_tokens} exceeds "
+                    f"max_seq_len {s.max_seq_len}")
+            sched.add(r)
+        if cache is None:
+            cache = self.fresh_cache()
+        gen: Dict[int, List[int]] = {}                 # slot -> tokens
+        out: Dict[object, dict] = {}
+        stats = {"steps": 0, "prefills": 0, "decode_steps": 0,
+                 "decode_tokens": 0, "prefill_s": 0.0, "decode_s": 0.0}
+        waiting_since: Dict[object, float] = {}        # rid -> wall ts
+
+        def finish(slot):
+            nonlocal cache
+            st = sched.running[slot]
+            out[st.req.rid]["tokens"] = gen.pop(slot)
+            cache = self._free(cache, jnp.int32(slot))
+            sched.release(slot)
+
+        step = 0
+        while sched.has_work() and step < max_steps:
+            sched.tick(step)
+            for r in list(sched._waiting):
+                waiting_since.setdefault(r.rid, time.perf_counter())
+            for slot, req, need in sched.admit():
+                tokens = jnp.zeros((1, s.max_prefill_len), jnp.int32
+                                   ).at[0, : len(req.prompt)].set(
+                    jnp.asarray(req.prompt, jnp.int32))
+                t0 = time.perf_counter()
+                cache, tok = self._prefill(
+                    self.params, cache, tokens, jnp.int32(slot),
+                    jnp.int32(len(req.prompt)), jnp.int32(need))
+                stats["prefills"] += 1
+                tok = int(tok)                # host sync: timing honest
+                now = time.perf_counter()
+                stats["prefill_s"] += now - t0
+                gen[slot] = [tok]
+                out[req.rid] = {
+                    "ttft_step": step, "steps": step,
+                    "ttft_s": now - waiting_since.get(req.rid, t0),
+                }
+                if req.max_new_tokens == 1 or tok == s.eos_id:
+                    finish(slot)
+            if sched.running:
+                active = jnp.zeros((s.max_slots,), bool)
+                tokens = jnp.zeros((s.max_slots,), jnp.int32)
+                for slot in sched.running:
+                    active = active.at[slot].set(True)
+                    tokens = tokens.at[slot].set(gen[slot][-1])
+                sched.grow_for_decode()       # host mirror of the device
+                t0 = time.perf_counter()
+                cache, nxt = self._decode(self.params, cache, tokens,
+                                          active)
+                stats["decode_steps"] += 1
+                stats["decode_tokens"] += len(sched.running)
+                nxt = jax.device_get(nxt)     # host sync: timing honest
+                stats["decode_s"] += time.perf_counter() - t0
+                for slot in list(sched.running):
+                    st = sched.running[slot]
+                    tok = int(nxt[slot])
+                    gen[slot].append(tok)
+                    out[st.req.rid]["steps"] = step
+                    if (len(gen[slot]) >= st.req.max_new_tokens
+                            or tok == s.eos_id):
+                        finish(slot)
+            step += 1
+        if sched.has_work():
+            raise RuntimeError(
+                f"serving loop exceeded {max_steps} steps with work left")
+        stats["steps"] = step
+        stats["trace_counts"] = dict(self.trace_counts)
+        stats["cache"] = cache
+        out[None] = stats
+        return out
+
+
+# ---------------------------------------------------------------------------
+# unpaged reference (tests / parity legs)
+# ---------------------------------------------------------------------------
+
+def greedy_reference(params, cfg: TransformerConfig, prompt: List[int],
+                     n_new: int, mesh: Optional[Mesh] = None,
+                     pad_to: Optional[int] = None) -> List[int]:
+    """The oracle loop: re-run the FULL training forward
+    (standalone_transformer.transformer_forward — no cache, no paging)
+    over the growing context and argmax the last position. O(n^2) in
+    compute; exists to pin token-identical greedy parity. The context is
+    padded to ``pad_to`` (default cfg.seq_len) so the loop compiles the
+    forward ONCE — causality keeps the pad rows out of every valid row."""
+    if mesh is None:
+        mesh = Mesh(jax.devices()[:1], ("model",))
+    pad_to = pad_to or cfg.seq_len
+    if len(prompt) + n_new > pad_to:
+        raise ValueError(
+            f"{len(prompt)} prompt + {n_new} new tokens exceed pad_to="
+            f"{pad_to}")
+    toks = list(prompt)
+    fwd = jax.jit(smap(lambda p, t: transformer_forward(p, t, cfg), mesh,
+                       (param_specs(cfg), P()), P()))
+    buf = jnp.zeros((1, pad_to), jnp.int32)
+    for _ in range(n_new):
+        logits = fwd(params,
+                     buf.at[0, : len(toks)].set(jnp.asarray(toks,
+                                                            jnp.int32)))
+        toks.append(int(jnp.argmax(logits[len(toks) - 1, 0])))
+    return toks[len(prompt):]

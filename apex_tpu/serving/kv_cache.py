@@ -1,0 +1,284 @@
+"""Block-paged KV cache — a fixed-pool pytree with pure-functional ops.
+
+The serving memory model of "Ragged Paged Attention" (arxiv 2604.15464)
+and vLLM: K/V for all sequences live in ONE fixed pool of fixed-size
+blocks ("pages"), and each sequence maps its logical positions to pool
+blocks through a block table. Admission/eviction then move block IDS, not
+KV bytes, and memory fragmentation is bounded by one partial block per
+sequence.
+
+Layout (the whole cache is a NamedTuple pytree — it jits, donates, and
+shards like any train state):
+
+    k_pool / v_pool  [layers, num_blocks, block_size, n_kv_heads, head_dim]
+    block_tables     [max_slots, max_blocks_per_seq] int32 (pool block ids;
+                     entries past n_blocks[slot] are meaningless and kept 0)
+    n_blocks         [max_slots] int32  — blocks assigned per slot
+    seq_lens         [max_slots] int32  — tokens written per slot
+    free             [num_blocks] bool  — pool free map (True = free)
+
+The per-layer pool slice ``k_pool[l]`` is exactly the
+``[num_blocks, block_size, n_kv_heads, head_dim]`` operand
+ops/paged_attention.py consumes. Sharding (pspecs()): KV heads ride the
+TP axis — the same head split as the training tensor-parallel layers, so
+TP-sharded decode reuses the training weight layout — and the pool's
+block axis can ride the data axis (each data rank serves its own
+requests from its own pool shard; inside shard_map all ops here are
+rank-local).
+
+Every mutator is pure (returns a new cache) and built from lax/scatter
+ops only, so the whole serving step — allocate, append, attend, free —
+jits as one program. Out-of-range scatters use mode="drop" as the
+masking mechanism for inactive slots (index ``num_blocks`` is the
+designated drop target). Callers keep the pool from overflowing via the
+scheduler's free-block watermark; ``alloc_decode_blocks`` on an empty
+pool is a documented invariant violation (it would corrupt block 0), so
+the engine checks ``free_block_count`` before every decode step.
+
+Env defaults (docs/serving.md): APEX_TPU_PAGED_BLOCK_SIZE (block_size,
+default 16), APEX_TPU_SERVING_MAX_SLOTS (max_slots, default 8) — read by
+serving/engine.py, not here; this module is explicit-arguments-only.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class PagedKVCache(NamedTuple):
+    k_pool: jax.Array       # [L, N, bs, Hkv, D]
+    v_pool: jax.Array       # [L, N, bs, Hkv, D]
+    block_tables: jax.Array  # [max_slots, max_blocks_per_seq] int32
+    n_blocks: jax.Array     # [max_slots] int32
+    seq_lens: jax.Array     # [max_slots] int32
+    free: jax.Array         # [N] bool
+
+    # -- static views ------------------------------------------------
+    @property
+    def num_blocks(self) -> int:
+        return self.k_pool.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k_pool.shape[2]
+
+    @property
+    def max_slots(self) -> int:
+        return self.block_tables.shape[0]
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return self.block_tables.shape[1]
+
+
+def paged_kv_cache(layers: int, num_blocks: int, block_size: int,
+                   n_kv_heads: int, head_dim: int, max_slots: int,
+                   max_blocks_per_seq: Optional[int] = None,
+                   dtype=jnp.bfloat16) -> PagedKVCache:
+    """A fresh cache: empty pool, zeroed tables, everything free."""
+    if max_blocks_per_seq is None:
+        max_blocks_per_seq = num_blocks
+    shape = (layers, num_blocks, block_size, n_kv_heads, head_dim)
+    return PagedKVCache(
+        k_pool=jnp.zeros(shape, dtype),
+        v_pool=jnp.zeros(shape, dtype),
+        block_tables=jnp.zeros((max_slots, max_blocks_per_seq), jnp.int32),
+        n_blocks=jnp.zeros((max_slots,), jnp.int32),
+        seq_lens=jnp.zeros((max_slots,), jnp.int32),
+        free=jnp.ones((num_blocks,), bool),
+    )
+
+
+def cache_pspecs(tp_axis: Optional[str] = "model",
+                 data_axis: Optional[str] = None) -> PagedKVCache:
+    """PartitionSpecs for shard_map in/out specs: KV heads on the TP axis
+    (kv_heads % tp == 0, same contract as the GQA column split in
+    testing/standalone_transformer.py), and — when ``data_axis`` is given
+    — pool blocks, tables and accounting over the data axis (per-rank
+    request sets; block ids are rank-local)."""
+    return PagedKVCache(
+        k_pool=P(None, data_axis, None, tp_axis, None),
+        v_pool=P(None, data_axis, None, tp_axis, None),
+        block_tables=P(data_axis),
+        n_blocks=P(data_axis),
+        seq_lens=P(data_axis),
+        free=P(data_axis),
+    )
+
+
+def blocks_needed(n_tokens: int, block_size: int) -> int:
+    """Pool blocks covering ``n_tokens`` (host-side scheduler arithmetic)."""
+    return int(math.ceil(max(int(n_tokens), 0) / block_size))
+
+
+def free_block_count(cache: PagedKVCache):
+    return jnp.sum(cache.free.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# allocate / free
+# ---------------------------------------------------------------------------
+
+def allocate_slot(cache: PagedKVCache, slot, n_blocks) -> PagedKVCache:
+    """Assign the first ``n_blocks`` free pool blocks to ``slot`` (its
+    whole table row is replaced; seq_len resets to 0). ``n_blocks`` may be
+    traced; the caller guarantees ``n_blocks <= free_block_count`` and
+    ``n_blocks <= max_blocks_per_seq`` (scheduler admission)."""
+    mb = cache.max_blocks_per_seq
+    nb_pool = cache.num_blocks
+    # free blocks first, in index order (stable sort of the "taken" flag)
+    order = jnp.argsort(jnp.logical_not(cache.free), stable=True)
+    take = order[:mb]
+    if mb > nb_pool:  # tiny pools: pad with the drop target
+        take = jnp.concatenate(
+            [take, jnp.full((mb - nb_pool,), nb_pool, take.dtype)])
+    lane = jnp.arange(mb) < n_blocks
+    row = jnp.where(lane, take, 0).astype(jnp.int32)
+    free = cache.free.at[jnp.where(lane, take, nb_pool)].set(
+        False, mode="drop")
+    return cache._replace(
+        block_tables=cache.block_tables.at[slot].set(row),
+        n_blocks=cache.n_blocks.at[slot].set(
+            jnp.asarray(n_blocks, jnp.int32)),
+        seq_lens=cache.seq_lens.at[slot].set(0),
+        free=free,
+    )
+
+
+def free_slot(cache: PagedKVCache, slot) -> PagedKVCache:
+    """Return ``slot``'s blocks to the pool and clear its row. Idempotent
+    (a slot with n_blocks == 0 frees nothing)."""
+    mb = cache.max_blocks_per_seq
+    lane = jnp.arange(mb) < cache.n_blocks[slot]
+    ids = jnp.where(lane, cache.block_tables[slot], cache.num_blocks)
+    return cache._replace(
+        block_tables=cache.block_tables.at[slot].set(
+            jnp.zeros((mb,), jnp.int32)),
+        n_blocks=cache.n_blocks.at[slot].set(0),
+        seq_lens=cache.seq_lens.at[slot].set(0),
+        free=cache.free.at[ids].set(True, mode="drop"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# prefill write
+# ---------------------------------------------------------------------------
+
+def write_prefill(cache: PagedKVCache, slot, k, v, length) -> PagedKVCache:
+    """Scatter a prefill's K/V into ``slot``'s assigned pages and set its
+    length. k/v: [layers, t_pad, n_kv_heads, head_dim] (the fixed padded
+    prefill shape); rows at positions >= ``length`` are dropped. The slot
+    must hold >= ceil(length / block_size) blocks (allocate_slot)."""
+    t_pad = k.shape[1]
+    bs = cache.block_size
+    pos = jnp.arange(t_pad)
+    tbl_idx = jnp.clip(pos // bs, 0, cache.max_blocks_per_seq - 1)
+    blocks = cache.block_tables[slot][tbl_idx]                # [t_pad]
+    valid = pos < length
+    blocks = jnp.where(valid, blocks, cache.num_blocks)       # drop target
+    offs = pos % bs
+    return cache._replace(
+        k_pool=cache.k_pool.at[:, blocks, offs].set(
+            k.astype(cache.k_pool.dtype), mode="drop"),
+        v_pool=cache.v_pool.at[:, blocks, offs].set(
+            v.astype(cache.v_pool.dtype), mode="drop"),
+        seq_lens=cache.seq_lens.at[slot].set(
+            jnp.asarray(length, jnp.int32)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# decode append
+# ---------------------------------------------------------------------------
+
+def alloc_decode_blocks(cache: PagedKVCache, active):
+    """Reserve this decode step's token position for every active slot,
+    growing block tables where the position opens a new page.
+
+    active: [max_slots] bool. Returns (cache, block_ids, offsets) where
+    block_ids/offsets [max_slots] locate each active slot's NEW token
+    (inactive slots get the drop target ``num_blocks``); seq_lens of
+    active slots are already incremented, so the lengths the paged
+    kernel wants (current token included) are ``cache.seq_lens``.
+
+    Growth walks slots with a scan (max_slots is small and static),
+    handing each needy slot the first free block — callers keep
+    ``free_block_count >= popcount(need)`` via the admission watermark.
+    """
+    pos = cache.seq_lens                                       # [S]
+    need = active & (pos // cache.block_size >= cache.n_blocks) \
+        & (cache.n_blocks < cache.max_blocks_per_seq)
+
+    def body(carry, s):
+        free, tables, nblk = carry
+        blk = jnp.argmax(free).astype(jnp.int32)               # first free
+        grow = need[s]
+        free = free.at[blk].set(jnp.where(grow, False, free[blk]))
+        tables = tables.at[s, jnp.clip(nblk[s], 0,
+                                       cache.max_blocks_per_seq - 1)].set(
+            jnp.where(grow, blk, tables[s, jnp.clip(
+                nblk[s], 0, cache.max_blocks_per_seq - 1)]))
+        nblk = nblk.at[s].add(jnp.where(grow, 1, 0))
+        return (free, tables, nblk), None
+
+    (free, tables, nblk), _ = jax.lax.scan(
+        body, (cache.free, cache.block_tables, cache.n_blocks),
+        jnp.arange(cache.max_slots))
+    tbl_idx = jnp.clip(pos // cache.block_size, 0,
+                       cache.max_blocks_per_seq - 1)
+    block_ids = jnp.where(
+        active, jnp.take_along_axis(tables, tbl_idx[:, None], 1)[:, 0],
+        cache.num_blocks).astype(jnp.int32)
+    offsets = (pos % cache.block_size).astype(jnp.int32)
+    return cache._replace(
+        block_tables=tables, n_blocks=nblk, free=free,
+        seq_lens=pos + active.astype(jnp.int32),
+    ), block_ids, offsets
+
+
+def append_layer(cache: PagedKVCache, layer: int, block_ids, offsets,
+                 k_tok, v_tok) -> PagedKVCache:
+    """Write one decode token's K/V for ``layer`` at the positions
+    alloc_decode_blocks reserved. k_tok/v_tok: [max_slots, n_kv_heads,
+    head_dim]; slots whose block_id is the drop target write nothing."""
+    return cache._replace(
+        k_pool=cache.k_pool.at[layer, block_ids, offsets].set(
+            k_tok.astype(cache.k_pool.dtype), mode="drop"),
+        v_pool=cache.v_pool.at[layer, block_ids, offsets].set(
+            v_tok.astype(cache.v_pool.dtype), mode="drop"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# invariant check (tests / debugging — host side)
+# ---------------------------------------------------------------------------
+
+def check_invariants(cache: PagedKVCache) -> None:
+    """Assert the pool accounting is consistent: assigned blocks are
+    distinct, none of them is marked free, and every unassigned block is
+    free. Host-side (concrete arrays) — test helper, not a jit citizen."""
+    import numpy as np
+
+    tables = np.asarray(cache.block_tables)
+    nblk = np.asarray(cache.n_blocks)
+    free = np.asarray(cache.free)
+    lens = np.asarray(cache.seq_lens)
+    assigned: list = []
+    for s in range(cache.max_slots):
+        row = tables[s, : nblk[s]]
+        assigned.extend(row.tolist())
+        assert lens[s] <= nblk[s] * cache.block_size, (
+            f"slot {s}: {lens[s]} tokens exceed {nblk[s]} blocks")
+    assert len(assigned) == len(set(assigned)), (
+        f"double-assigned pool blocks: {sorted(assigned)}")
+    for b in assigned:
+        assert not free[b], f"assigned block {b} marked free"
+    assert len(assigned) + int(free.sum()) == cache.num_blocks, (
+        "pool accounting leak: "
+        f"{len(assigned)} assigned + {int(free.sum())} free "
+        f"!= {cache.num_blocks}")

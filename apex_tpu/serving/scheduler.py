@@ -1,0 +1,160 @@
+"""Continuous-batching scheduler — host-side block/slot accounting.
+
+The split of responsibilities mirrors production TPU serving stacks: the
+DEVICE side (engine.py) is two fixed-shape jitted programs — prefill and
+decode — that never recompile; the HOST side (this module) decides *what*
+those programs run on each step: which waiting request is admitted into
+which slot, and when a finished sequence's blocks return to the pool.
+
+State machine per request::
+
+    WAITING --admit--> RUNNING --(eos | max_new_tokens)--> FINISHED
+      ^ arrival gate (requests carry an arrival step; continuous
+        batching means later arrivals join mid-flight decodes)
+
+Admission policy (free-block watermark): a request is admitted only when
+a slot is free AND the pool would retain >= ``watermark`` free blocks
+after its prompt allocation. The watermark reserves decode headroom for
+the sequences already running — every active sequence needs at most one
+new block per ``block_size`` decode steps, so ``watermark = max_slots``
+(the default) guarantees a full round of block growth before the next
+admission can be reconsidered; sizing the pool for the worst case
+(``sum(ceil(max_ctx/bs))``) makes growth unconditionally safe.
+
+The scheduler's counters are an exact host mirror of the device cache's
+accounting (it sees every admit/grow/release), so steady-state decode
+needs no device round-trip to make admission decisions. The engine
+cross-checks the mirror against ``kv_cache.free_block_count`` in tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from apex_tpu.serving.kv_cache import blocks_needed
+
+WAITING = "WAITING"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``arrival`` is the engine step index at
+    which the request becomes visible (staggered-arrival workloads)."""
+
+    rid: object
+    prompt: List[int]
+    max_new_tokens: int = 16
+    arrival: int = 0
+
+    def __post_init__(self):
+        if not self.prompt:
+            raise ValueError(f"request {self.rid!r}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"request {self.rid!r}: max_new_tokens must be >= 1")
+
+
+@dataclasses.dataclass
+class _Running:
+    req: Request
+    slot: int
+    n_blocks: int          # blocks currently assigned to the slot
+    tokens_in_cache: int   # prompt + generated tokens written so far
+
+
+class Scheduler:
+    """Slot/block bookkeeping + admission. Pure host state."""
+
+    def __init__(self, *, max_slots: int, num_blocks: int, block_size: int,
+                 max_blocks_per_seq: int,
+                 watermark: Optional[int] = None):
+        self.max_slots = max_slots
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.free_blocks = num_blocks
+        self.watermark = max_slots if watermark is None else watermark
+        self._future: List[Request] = []
+        self._waiting: Deque[Request] = deque()
+        self.running: Dict[int, _Running] = {}     # slot -> state
+        self._free_slots = sorted(range(max_slots))
+
+    # -- intake ------------------------------------------------------
+    def add(self, req: Request) -> None:
+        # capacity check covers the WHOLE lifetime (prompt + decode
+        # budget), so grow_for_decode can never push a sequence past
+        # max_blocks_per_seq — without this, decode past the last page
+        # would silently overwrite live K/V on device while the host
+        # mirror debits blocks the device never allocated
+        need = blocks_needed(len(req.prompt) + req.max_new_tokens,
+                             self.block_size)
+        if need > self.max_blocks_per_seq:
+            raise ValueError(
+                f"request {req.rid!r}: {len(req.prompt)} prompt + "
+                f"{req.max_new_tokens} new tokens need {need} blocks > "
+                f"max_blocks_per_seq {self.max_blocks_per_seq} "
+                f"(raise max_seq_len or split the request)")
+        self._future.append(req)
+        self._future.sort(key=lambda r: r.arrival)
+
+    def tick(self, step: int) -> None:
+        """Move requests whose arrival step has come into the wait queue."""
+        while self._future and self._future[0].arrival <= step:
+            self._waiting.append(self._future.pop(0))
+
+    def has_work(self) -> bool:
+        return bool(self._future or self._waiting or self.running)
+
+    # -- admission ---------------------------------------------------
+    def admit(self) -> List[Tuple[int, Request, int]]:
+        """Admit FIFO from the wait queue while a slot is free and the
+        pool keeps ``watermark`` blocks after each prompt allocation.
+        Returns [(slot, request, prompt_blocks)]; the caller runs the
+        prefills and reports the first decode tokens via started()."""
+        admitted = []
+        while self._waiting and self._free_slots:
+            req = self._waiting[0]
+            need = blocks_needed(len(req.prompt), self.block_size)
+            if self.free_blocks - need < self.watermark:
+                break                         # FIFO: no skip-ahead
+            self._waiting.popleft()
+            slot = self._free_slots.pop(0)
+            self.free_blocks -= need
+            self.running[slot] = _Running(
+                req=req, slot=slot, n_blocks=need,
+                tokens_in_cache=len(req.prompt))
+            admitted.append((slot, req, need))
+        return admitted
+
+    # -- decode-step accounting -------------------------------------
+    def grow_for_decode(self) -> int:
+        """Account one token appended to every running slot (the engine's
+        decode step does exactly that): slots whose new position opens a
+        fresh page take a block from the pool. Returns the number of
+        blocks taken; raises if the pool underflows — that is a watermark
+        sizing bug, and corrupting block 0 on device would be worse."""
+        grown = 0
+        for st in self.running.values():
+            pos = st.tokens_in_cache
+            if pos // self.block_size >= st.n_blocks:
+                st.n_blocks += 1
+                grown += 1
+            st.tokens_in_cache = pos + 1
+        self.free_blocks -= grown
+        if self.free_blocks < 0:
+            raise RuntimeError(
+                f"paged pool underflow: decode growth took {grown} blocks "
+                f"with only {self.free_blocks + grown} free — the "
+                f"admission watermark ({self.watermark}) is undersized "
+                f"for this workload")
+        return grown
+
+    def release(self, slot: int) -> None:
+        """Finished sequence: return its blocks, free its slot."""
+        st = self.running.pop(slot)
+        self.free_blocks += st.n_blocks
+        self._free_slots.append(slot)
+        self._free_slots.sort()

@@ -16,6 +16,7 @@ from apex_tpu.testing.standalone_transformer import (  # noqa: F401
     gpt_loss,
     param_specs,
     sp_grad_sync,
+    split_qkv,
     stack_layer_params,
     transformer_forward,
     transformer_init,

@@ -358,6 +358,41 @@ def _rope_tables(cfg: TransformerConfig, s: int):
     return cos, sin
 
 
+def split_qkv(qkv, cfg: TransformerConfig):
+    """Local QKV columns [s, b, cols/tp] -> (q, k, v) head tensors
+    ([s, b, nh(_kv)_local, d]) under the Megatron column layouts. ONE
+    definition shared by the training forward (_attention) and the
+    serving engine (serving/engine.py) — the layouts must agree or a
+    served checkpoint silently permutes heads.
+
+    Dense MHA: columns ordered [heads, (q|k|v), d] so a contiguous TP
+    column split hands each rank WHOLE heads — the same function at every
+    tp (ref: attention.py reshapes local qkv to [s, b, nh_local, 3*hd]
+    then split_tensor_along_last_dim; the round-1 [3, nh, hd] order
+    silently changed with tp). GQA: KV-GROUP-major — per kv head
+    [q_0..q_{g-1}, k, v] — the same invariance argument, requiring
+    kv_heads % tp == 0 (each rank needs whole kv groups)."""
+    s, b = qkv.shape[0], qkv.shape[1]
+    dd = cfg.head_dim
+    if cfg.kv_heads:
+        group = cfg.heads // cfg.kv_heads
+        assert qkv.shape[-1] % ((group + 2) * dd) == 0, (
+            f"GQA column split landed mid-group: local qkv cols "
+            f"{qkv.shape[-1]} vs group stride {(group + 2) * dd} — "
+            f"kv_heads={cfg.kv_heads} must be divisible by the model-axis "
+            "size (each TP rank needs whole kv groups)")
+        n_kv = qkv.shape[-1] // ((group + 2) * dd)
+        qkv = qkv.reshape(s, b, n_kv, group + 2, dd)
+        q = qkv[:, :, :, :group].reshape(s, b, n_kv * group, dd)
+        k = qkv[:, :, :, group]           # [s, b, n_kv, d]
+        v = qkv[:, :, :, group + 1]
+        return q, k, v
+    n_local = qkv.shape[-1] // (3 * dd)
+    qkv = qkv.reshape(s, b, n_local, 3, dd)
+    q, k, v = (qkv[:, :, :, i] for i in range(3))      # [s, b, nh, d]
+    return q, k, v
+
+
 def _attention(lp, x, cfg: TransformerConfig, dropout_key, attn_key=None,
                rope_tables=None):
     """x: [s(, /tp if SP), b, h] -> same. Column QKV (no output gather) ->
@@ -373,30 +408,7 @@ def _attention(lp, x, cfg: TransformerConfig, dropout_key, attn_key=None,
     )                                     # [s, b, 3h/tp]
     s, b = qkv.shape[0], qkv.shape[1]
     dd = cfg.head_dim
-    if cfg.kv_heads:
-        # KV-GROUP-major layout: per kv head [q_0..q_{g-1}, k, v] — a
-        # contiguous TP column split hands each rank whole groups, same
-        # invariance argument as the dense [heads, (q|k|v), d] order
-        group = cfg.heads // cfg.kv_heads
-        assert qkv.shape[-1] % ((group + 2) * dd) == 0, (
-            f"GQA column split landed mid-group: local qkv cols "
-            f"{qkv.shape[-1]} vs group stride {(group + 2) * dd} — "
-            f"kv_heads={cfg.kv_heads} must be divisible by the model-axis "
-            "size (each TP rank needs whole kv groups)")
-        n_kv = qkv.shape[-1] // ((group + 2) * dd)
-        qkv = qkv.reshape(s, b, n_kv, group + 2, dd)
-        q = qkv[:, :, :, :group].reshape(s, b, n_kv * group, dd)
-        k = qkv[:, :, :, group]           # [s, b, n_kv, d]
-        v = qkv[:, :, :, group + 1]
-    else:
-        n_local = qkv.shape[-1] // (3 * dd)
-        # Megatron layout: qkv columns are ordered [heads, (q|k|v), d] so
-        # a contiguous column split hands each TP rank WHOLE heads — the
-        # same function at every tp (ref: attention.py reshapes local qkv
-        # to [s, b, nh_local, 3*hd] then split_tensor_along_last_dim).
-        # The round-1 [3, nh, hd] order silently changed with tp.
-        qkv = qkv.reshape(s, b, n_local, 3, dd)
-        q, k, v = (qkv[:, :, :, i] for i in range(3))  # [s, b, nh, d]
+    q, k, v = split_qkv(qkv, cfg)
     if cfg.rope:
         from apex_tpu.ops.rope import apply_rope
 

@@ -37,15 +37,17 @@ from apex_tpu.tuning.shape_class import (
     flash_key,
     ln_key,
     optim_key,
+    paged_key,
     softmax_key,
 )
 
 __all__ = [
     "TuneDB", "active_db", "cache_path", "invalidate", "lookup", "pinned",
     "snapshot_dir", "tuning_enabled", "class_key", "device_kind",
-    "dtype_token", "flash_key", "ln_key", "optim_key", "softmax_key",
-    "flash_config", "ln_block_rows", "optim_block_rows",
-    "softmax_row_chunk", "cost_model", "registry", "shape_class",
+    "dtype_token", "flash_key", "ln_key", "optim_key", "paged_key",
+    "softmax_key", "flash_config", "ln_block_rows", "optim_block_rows",
+    "paged_decode_config", "softmax_row_chunk", "cost_model", "registry",
+    "shape_class",
 ]
 
 
@@ -143,6 +145,34 @@ def optim_block_rows(n_tiles: int) -> int:
     if entry:
         return _clamp_rows(entry.get("block_rows"), default, lo=128)
     return default
+
+
+def paged_decode_config(n_slots: int, max_blocks: int, block_size: int,
+                        group: int, d: int, dtype) -> dict:
+    """Resolved paged-decode config for one shape class:
+    ``{"block_rows", "kv_fetch", "backend"}``. Cache entry wins field-wise
+    where present (clamped to legal values); the cost model fills the
+    rest. Env overrides (APEX_TPU_PAGED_BLOCK_ROWS /
+    APEX_TPU_PAGED_KV_FETCH) are applied by ops/paged_attention.py BEFORE
+    consulting this — the standard env > cache > model order."""
+    rows_d = cost_model.paged_block_rows_default(group)
+    fetch_d = cost_model.paged_kv_fetch_default(
+        block_size, d, {"bf16": 2, "f16": 2}.get(dtype_token(dtype), 4))
+    cfg = {"block_rows": rows_d, "kv_fetch": fetch_d, "backend": "pallas"}
+    entry = lookup(paged_key(n_slots, max_blocks, block_size, group, d,
+                             dtype))
+    if entry:
+        cfg["block_rows"] = _clamp_rows(entry.get("block_rows"), rows_d,
+                                        quantum=8, lo=8, hi=512)
+        try:
+            f = int(entry.get("kv_fetch"))
+            if 1 <= f <= max(1, max_blocks):
+                cfg["kv_fetch"] = f
+        except (TypeError, ValueError):
+            pass
+        if entry.get("backend") in ("pallas", "jnp"):
+            cfg["backend"] = entry["backend"]
+    return cfg
 
 
 def softmax_row_chunk(rows: int, cols: int, dtype) -> int:

@@ -404,6 +404,96 @@ def sweep_optim(db: cache.TuneDB, *, hardware: bool, reps: int,
         log(f"autotune: optim_flat tiles={tiles} -> block_rows={best}")
 
 
+def sweep_paged(db: cache.TuneDB, *, hardware: bool, reps: int,
+                log=print) -> None:
+    """(block_rows, kv_fetch) sweep for the ragged paged-attention decode
+    kernel (ops/paged_attention.py, registry family ``paged_decode``).
+
+    Hardware sessions time the kernel per (slots, kv span, page size,
+    group, d) class — median of ``reps`` decode calls per candidate,
+    winner recorded with milliseconds. Interpret sessions VERIFY each
+    candidate against the gather oracle and record the cost-model
+    default (projections lack the resolution to overturn the measured
+    rule — same policy as the flash sweep)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.ops.paged_attention import (
+        _decode_pallas,
+        paged_attention_ref,
+    )
+
+    space = registry.TUNABLES["paged_decode"].params
+    ladder = (
+        # (slots, hq, hkv, d, block_size, max_blocks)
+        (8, 8, 8, 128, 16, 64),      # dense MHA decode, 1k context
+        (8, 8, 2, 128, 16, 64),      # GQA group 4
+    ) if hardware else ((4, 4, 2, 64, 8, 4),)
+    for slots, hq, hkv, d, bs, maxb in ladder:
+        nb = slots * maxb + 8
+        group = hq // hkv
+        keys = jax.random.split(jax.random.PRNGKey(slots + d), 4)
+        k_pool = jax.random.normal(keys[0], (nb, bs, hkv, d), jnp.bfloat16)
+        v_pool = jax.random.normal(keys[1], (nb, bs, hkv, d), jnp.bfloat16)
+        q = jax.random.normal(keys[2], (slots, hq, d), jnp.bfloat16)
+        tables = jax.random.permutation(keys[3], nb)[: slots * maxb
+                                                     ].reshape(slots, maxb)
+        lengths = jnp.full((slots,), bs * maxb - 3, jnp.int32)
+        ref = paged_attention_ref(q, k_pool, v_pool, tables, lengths)
+        scale = 1.0 / (d ** 0.5)
+        best = None
+        for rows in space["block_rows"]:
+            for fetch in space["kv_fetch"]:
+                if fetch > maxb:
+                    continue
+
+                def f(q, kp, vp, t, le, rows=rows, fetch=fetch):
+                    return _decode_pallas(q, kp, vp, t, le, scale, rows,
+                                          fetch)
+
+                try:
+                    fn = jax.jit(f)
+                    got = fn(q, k_pool, v_pool, tables, lengths)
+                    got.block_until_ready()
+                    err = float(jnp.max(jnp.abs(
+                        got.astype(jnp.float32) - ref.astype(jnp.float32))))
+                    if err > 5e-2:
+                        raise AssertionError(f"oracle mismatch {err}")
+                    times = []
+                    for _ in range(max(1, reps)):
+                        t0 = time.perf_counter()
+                        fn(q, k_pool, v_pool, tables,
+                           lengths).block_until_ready()
+                        times.append(time.perf_counter() - t0)
+                    ms = sorted(times)[len(times) // 2] * 1e3
+                except Exception as e:  # noqa: BLE001 — failing candidate
+                    log(f"autotune: paged_decode rows={rows} "
+                        f"fetch={fetch} failed: {type(e).__name__}: {e}")
+                    continue
+                if best is None or ms < best[2]:
+                    best = (rows, fetch, ms)
+        if best is None:
+            continue
+        if hardware:
+            entry = {"block_rows": best[0], "kv_fetch": best[1]}
+        else:  # verified, but keep the measured-rule defaults
+            entry = {
+                "block_rows": cost_model.paged_block_rows_default(group),
+                "kv_fetch": cost_model.paged_kv_fetch_default(bs, d),
+            }
+        registry.validate_entry("paged_decode", entry)
+        key = shape_class.paged_key(slots, maxb, bs, group, d,
+                                    jnp.bfloat16)
+        db.record(key, entry,
+                  source="hardware" if hardware else "interpret+cost_model",
+                  ms=best[2] if hardware else None,
+                  note=f"swept {len(space['block_rows'])}x"
+                       f"{len(space['kv_fetch'])} candidates")
+        log(f"autotune: paged_decode slots={slots} g={group} d={d} -> "
+            f"rows={entry['block_rows']} fetch={entry['kv_fetch']}"
+            + (f" ({best[2]:.3f} ms)" if hardware else " (verified)"))
+
+
 # ------------------------------------------------------------------
 # BASELINE.md projection table
 # ------------------------------------------------------------------
@@ -549,7 +639,7 @@ def run(*, out: Optional[str] = None, interpret: bool = False,
 def _run_inner(*, out, kernels, seqs, hiddens, dtype, reps, quick,
                hardware, log) -> "cache.TuneDB":
     kernels = kernels or ["flash", "layer_norm", "rms_norm", "optim_flat",
-                          "overlap_tp"]
+                          "overlap_tp", "paged_decode"]
     seqs = seqs or ([256] if quick else [256, 512])
     hiddens = hiddens or ([256] if quick else [256, 1024])
     out_path = Path(out) if out else cache.cache_path()
@@ -570,6 +660,8 @@ def _run_inner(*, out, kernels, seqs, hiddens, dtype, reps, quick,
         sweep_optim(db, hardware=hardware, reps=reps, log=log)
     if "overlap_tp" in kernels:
         sweep_overlap(db, hardware=hardware, reps=reps, log=log)
+    if "paged_decode" in kernels:
+        sweep_paged(db, hardware=hardware, reps=reps, log=log)
     path = db.save(out_path)
     cache.invalidate()  # the freshly-written file is live immediately
     log(f"autotune: wrote {len(db.entries)} entries to {path}")
@@ -588,9 +680,9 @@ def main(argv: Optional[list] = None) -> int:
                     help=f"output tunedb path (default {cache.cache_path()})")
     ap.add_argument("--kernels",
                     default="flash,layer_norm,rms_norm,optim_flat,"
-                            "overlap_tp",
+                            "overlap_tp,paged_decode",
                     help="comma list: flash,layer_norm,rms_norm,"
-                         "optim_flat,overlap_tp")
+                         "optim_flat,overlap_tp,paged_decode")
     ap.add_argument("--seqs", default=None,
                     help="flash seq classes to sweep, comma list")
     ap.add_argument("--hiddens", default=None,

@@ -280,6 +280,35 @@ def overlap_chunks_default(rows_local: int, n_ranks: int) -> int:
 
 
 # ------------------------------------------------------------------
+# ragged paged-attention decode (ops/paged_attention.py)
+# ------------------------------------------------------------------
+
+def paged_block_rows_default(group: int) -> int:
+    """Sublane padding of the decode q tile ([group, d] per (slot,
+    kv-head) instance). The fp32 tile quantum is 8 sublanes, so anything
+    below 8 pads to 8 anyway; pad dense-MHA groups of 1 straight to 8 and
+    otherwise round the group up. Capped at 32 — beyond that the q tile's
+    dead rows outweigh the MXU occupancy win on every projected shape;
+    larger is autotune's to prove."""
+    return max(8, min(32, -(-int(group) // 8) * 8))
+
+
+def paged_kv_fetch_default(block_size: int, d: int,
+                           dtype_bytes: int = 2) -> int:
+    """Pages pulled per grid step. More pages per step amortize the
+    per-step overhead (the dominant cost at decode's tiny arithmetic
+    intensity) and give the pipeline independent DMAs to overlap; the
+    bound is the K+V page tiles resident per step staying comfortably
+    inside scoped VMEM (1 MiB budget — decode shares VMEM with nothing
+    else, but double buffering doubles the footprint)."""
+    budget = 2**20
+    fetch = 8
+    while fetch > 1 and fetch * block_size * d * dtype_bytes * 2 > budget:
+        fetch //= 2
+    return fetch
+
+
+# ------------------------------------------------------------------
 # softmax tiling
 # ------------------------------------------------------------------
 

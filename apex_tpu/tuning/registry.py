@@ -67,6 +67,19 @@ def _overlap_check(params: dict, _features: dict) -> Optional[str]:
     return None
 
 
+def _paged_check(params: dict, features: dict) -> Optional[str]:
+    err = _mult("block_rows", 8)(params, features)
+    if err:
+        return err
+    f = params.get("kv_fetch")
+    if f is not None and f < 1:
+        return f"kv_fetch={f} must be >= 1"
+    backend = params.get("backend", "pallas")
+    if backend not in ("pallas", "jnp"):
+        return f"backend={backend!r} not in ('pallas', 'jnp')"
+    return None
+
+
 TUNABLES: Dict[str, Tunable] = {
     t.kernel: t
     for t in (
@@ -123,6 +136,26 @@ TUNABLES: Dict[str, Tunable] = {
                 "ring size and dtype.",
             defaults_from="cost_model.overlap_chunks_default",
             env={"chunks": "APEX_TPU_OVERLAP_TP_CHUNKS"},
+        ),
+        Tunable(
+            kernel="paged_decode",
+            params={
+                "block_rows": [8, 16, 32],
+                "kv_fetch": [1, 2, 4, 8],
+                "backend": ["pallas", "jnp"],
+            },
+            check=_paged_check,
+            doc="Ragged paged-attention decode kernel "
+                "(ops/paged_attention.py): block_rows = sublane padding of "
+                "the per-(slot, kv-head) query-group tile; kv_fetch = KV "
+                "pages pulled per grid step (staggered index maps pipeline "
+                "the page DMAs). Class carries slots, total paged KV span, "
+                "page size, GQA group, head dim and dtype.",
+            defaults_from="cost_model.paged_block_rows_default / "
+                          "paged_kv_fetch_default",
+            env={"block_rows": "APEX_TPU_PAGED_BLOCK_ROWS",
+                 "kv_fetch": "APEX_TPU_PAGED_KV_FETCH",
+                 "backend": "APEX_TPU_USE_PALLAS"},
         ),
         Tunable(
             kernel="softmax",

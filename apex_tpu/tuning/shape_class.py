@@ -162,6 +162,31 @@ def overlap_key(rows_local: int, n_ranks: int, dtype, device=None) -> str:
         "overlap_tp", overlap_features(rows_local, n_ranks, dtype), device)
 
 
+def paged_features(n_slots: int, max_blocks: int, block_size: int,
+                   group: int, d: int, dtype) -> dict:
+    """Ragged paged-attention decode (ops/paged_attention.py): the optimum
+    moves with the decode batch width (slots), the paged KV span a slot
+    can reach (max_blocks * block_size — what the fetch loop walks), the
+    page size (DMA granule), the GQA group (q tile rows) and head dim."""
+    return {
+        "slots": pow2_bucket(n_slots, floor=8),
+        "kv": seq_bucket(max_blocks * block_size),
+        "bs": int(block_size),
+        "g": int(group),
+        "d": hidden_bucket(d),
+        "dt": dtype_token(dtype),
+    }
+
+
+def paged_key(n_slots: int, max_blocks: int, block_size: int, group: int,
+              d: int, dtype, device=None) -> str:
+    return class_key(
+        "paged_decode",
+        paged_features(n_slots, max_blocks, block_size, group, d, dtype),
+        device,
+    )
+
+
 def softmax_features(rows: int, cols: int, dtype) -> dict:
     return {
         "rows": seq_bucket(rows),

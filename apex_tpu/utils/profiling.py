@@ -16,13 +16,31 @@ from typing import Iterator, Optional
 
 import jax
 
-# master switch mirroring the reference's DistributedDataParallel(prof=...)
-_PROF_ENABLED = os.environ.get("APEX_TPU_PROF", "1") == "1"
+# master switch mirroring the reference's DistributedDataParallel(prof=...).
+# None = "no programmatic override": trace_range then follows the env var
+# (default on). APEX_TPU_PROF is re-read at every trace_range call — the
+# old import-time latch silently ignored an env var set after import (e.g.
+# a harness enabling profiling around one benchmark phase) — and when SET
+# it wins over set_profiling_enabled, so the operator's env always decides.
+_PROF_OVERRIDE: bool | None = None
 
 
 def set_profiling_enabled(enabled: bool) -> None:
-    global _PROF_ENABLED
-    _PROF_ENABLED = enabled
+    """Programmatic default for when APEX_TPU_PROF is unset; pass ``None``
+    to clear. An explicit APEX_TPU_PROF env value beats this."""
+    global _PROF_OVERRIDE
+    _PROF_OVERRIDE = enabled
+
+
+def profiling_enabled() -> bool:
+    """The switch trace_range consults, resolved at CALL time:
+    APEX_TPU_PROF env (when set) > set_profiling_enabled > default on."""
+    env = os.environ.get("APEX_TPU_PROF")
+    if env is not None:
+        return env == "1"
+    if _PROF_OVERRIDE is not None:
+        return _PROF_OVERRIDE
+    return True
 
 
 @contextlib.contextmanager
@@ -31,7 +49,7 @@ def trace_range(name: str) -> Iterator[None]:
     timeline: ``jax.named_scope`` names the *ops emitted during tracing* so
     the range survives into compiled device traces (the nvtx-in-kernel
     analog), and ``TraceAnnotation`` marks host-side eager execution."""
-    if _PROF_ENABLED:
+    if profiling_enabled():
         with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
             yield
     else:

@@ -30,12 +30,18 @@ _METRIC = "bert_large_amp_o2_fused_lamb_samples_per_sec_per_chip"
 # dry-compile gate (round-5 verdict Next #2), so tunnel minutes are never
 # spent discovering compile errors. --autotune: run the kernel autotune
 # sweep (apex_tpu.tuning.autotune) instead of the step benchmark and write
-# the tune cache. Both emit one JSON line under their own metric names so
-# they can never masquerade as a samples/sec measurement.
+# the tune cache. --serving: run the inference-serving rung
+# (apex_tpu.serving continuous batching: decode steps/s + time-to-first-
+# token at a fixed request mix) instead of the training sweep; the serving
+# prefill/decode programs are ALSO dry-compiled by --compile-only as their
+# own rung. Each mode emits one JSON line under its own metric name so it
+# can never masquerade as a samples/sec measurement.
 _COMPILE_ONLY = "--compile-only" in sys.argv[1:]
 _AUTOTUNE = "--autotune" in sys.argv[1:]
+_SERVING = "--serving" in sys.argv[1:]
 _COMPILE_METRIC = "bert_large_compile_gate_rungs_ok"
 _AUTOTUNE_METRIC = "apex_tpu_autotune_entries_written"
+_SERVING_METRIC = "apex_tpu_serving_decode_steps_per_sec"
 
 
 def emit(payload: dict) -> None:
@@ -314,6 +320,135 @@ def _measure_with_timeout(step, args, iters, timeout_s):
     return box["result"], None
 
 
+def _serving_setup(on_cpu: bool):
+    """Engine + workload geometry for the serving rung. One definition
+    shared by the timed run (--serving) and the dry-compile gate."""
+    import jax.numpy as jnp  # noqa: F811 — bench defers jax-heavy imports
+
+    from apex_tpu.serving import ServingConfig, ServingEngine
+    from apex_tpu.testing import TransformerConfig, transformer_init
+
+    if on_cpu:
+        cfg = TransformerConfig(
+            vocab_size=512, seq_len=128, hidden=128, layers=2, heads=4,
+            causal=True, dtype=jnp.bfloat16,
+        )
+        scfg = ServingConfig(model=cfg, num_blocks=128, block_size=8,
+                             max_slots=4, max_prefill_len=32,
+                             max_seq_len=64)
+    else:
+        # GPT-medium-class decode: big enough for a real HBM-bound decode
+        # signal, small enough that prefill+decode compile inside the gate
+        cfg = TransformerConfig(
+            vocab_size=32768, seq_len=2048, hidden=1024, layers=12,
+            heads=16, causal=True, dtype=jnp.bfloat16,
+        )
+        scfg = ServingConfig(model=cfg, num_blocks=2048,
+                             max_prefill_len=512, max_seq_len=2048)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    return ServingEngine(scfg, params), cfg, scfg
+
+
+def _serving_requests(cfg, scfg, on_cpu: bool):
+    """The FIXED request mix (deterministic): 16 requests, prompt lengths
+    short:medium:long = 2:1:1, arrivals staggered 4 per step, equal
+    decode budgets — so decode steps/s and TTFT are comparable across
+    rounds."""
+    import numpy as np
+
+    from apex_tpu.serving import Request
+
+    rng = np.random.RandomState(0)
+    mp = scfg.max_prefill_len
+    mix = [max(2, mp // 8), max(2, mp // 8), max(3, mp // 2), mp]
+    n_new = 8 if on_cpu else 32
+    return [
+        Request(rid=i,
+                prompt=rng.randint(1, cfg.vocab_size,
+                                   size=mix[i % 4]).tolist(),
+                max_new_tokens=n_new, arrival=i // 4)
+        for i in range(16)
+    ]
+
+
+def _serving_payload(on_cpu: bool) -> dict:
+    eng, cfg, scfg = _serving_setup(on_cpu)
+    reqs = _serving_requests(cfg, scfg, on_cpu)
+    eng.run(list(reqs))                       # warmup: pays the 2 compiles
+    out = eng.run(list(reqs))
+    stats = out.pop(None)
+    ttfts = sorted(v["ttft_s"] for v in out.values())
+    decode_sps = stats["decode_steps"] / max(stats["decode_s"], 1e-9)
+    return {
+        "metric": _SERVING_METRIC,
+        "value": round(decode_sps, 2),
+        "unit": "decode_steps/sec",
+        "vs_baseline": 0.0,
+        "ok": len(out) == len(reqs),
+        "serving": True,
+        "detail": {
+            "decode_tokens_per_sec": round(
+                stats["decode_tokens"] / max(stats["decode_s"], 1e-9), 2),
+            "ttft_mean_s": round(sum(ttfts) / len(ttfts), 4),
+            "ttft_p95_s": round(ttfts[int(0.95 * (len(ttfts) - 1))], 4),
+            "requests": len(reqs),
+            "decode_steps": stats["decode_steps"],
+            "prefill_s": round(stats["prefill_s"], 3),
+            "decode_s": round(stats["decode_s"], 3),
+            "trace_counts": stats["trace_counts"],
+            "config": {
+                "hidden": cfg.hidden, "layers": cfg.layers,
+                "heads": cfg.heads, "vocab": cfg.vocab_size,
+                "block_size": scfg.block_size,
+                "max_slots": scfg.max_slots,
+                "max_prefill_len": scfg.max_prefill_len,
+            },
+        },
+    }
+
+
+def _serving_compile_rung(on_cpu: bool, timeout_s: float) -> dict:
+    """Dry-compile the serving prefill + decode programs as one gate rung
+    (no timed rep, same verdict-line convention as the batch rungs)."""
+    import jax.numpy as jnp  # noqa: F811
+
+    rung = {"rung": "serving", "batch": None, "remat": "serving"}
+    t_total = 0.0
+    try:
+        eng, cfg, scfg = _serving_setup(on_cpu)
+        cache = eng.fresh_cache()
+        for name, step, args in (
+            ("prefill", eng._prefill,
+             (eng.params, cache,
+              jnp.zeros((1, scfg.max_prefill_len), jnp.int32),
+              jnp.int32(0), jnp.int32(2), jnp.int32(1))),
+            ("decode", eng._decode,
+             (eng.params, cache, jnp.zeros((scfg.max_slots,), jnp.int32),
+              jnp.zeros((scfg.max_slots,), bool))),
+        ):
+            compile_s, err = _compile_with_timeout(step, args, timeout_s)
+            if err is not None:
+                msg = ("compile hung" if err == "hung"
+                       else f"{type(err).__name__}: "
+                            f"{str(err).splitlines()[0][:200]}")
+                print(f"bench: compile-only rung serving/{name}: FAILED — "
+                      f"marked skipped ({msg})", file=sys.stderr,
+                      flush=True)
+                rung.update(ok=False, skipped=True, error=f"{name}: {msg}")
+                return rung
+            t_total += compile_s
+        print(f"bench: compile-only rung serving: OK ({t_total:.1f}s)",
+              file=sys.stderr, flush=True)
+        rung.update(ok=True, compile_s=round(t_total, 1))
+    except Exception as e:  # noqa: BLE001 — a failing rung is data
+        print(f"bench: compile-only rung serving: FAILED — marked skipped "
+              f"({type(e).__name__}: {str(e).splitlines()[0][:200]})",
+              file=sys.stderr, flush=True)
+        rung.update(ok=False, skipped=True,
+                    error=str(e).splitlines()[0][:200])
+    return rung
+
+
 def main():
     from jax.sharding import Mesh, PartitionSpec as P
 
@@ -359,6 +494,13 @@ def main():
             "ok": len(db.entries) > 0,
             "autotune": True,
         })
+        return
+
+    if _SERVING:
+        # serving rung: continuous-batching decode steps/s + TTFT at the
+        # fixed request mix (apex_tpu.serving); its own metric name so it
+        # can never masquerade as a training samples/sec measurement
+        emit(_serving_payload(on_cpu))
         return
 
     if on_cpu:
@@ -671,6 +813,13 @@ def main():
     _apply_rung_env(())  # drop the last rung's lever overrides
 
     if _COMPILE_ONLY:
+        # the serving prefill/decode programs ride the gate as their own
+        # rung, so a serving compile regression costs seconds, not the
+        # measurement window (ISSUE-3 satellite)
+        compile_rungs.append(_serving_compile_rung(
+            on_cpu,
+            timeout_s=float(os.environ.get("BENCH_BATCH_TIMEOUT_S", "900")),
+        ))
         emit(_compile_only_payload(compile_rungs, kernel_report))
         return
 

@@ -66,6 +66,14 @@ run overlap_gate  1800 '"ok": true' env \
 run overlap_ab    5400 '"ok": true' env \
                        BENCH_BATCHES=128@dots_accum4,128@dots_accum4+overlap,128@dots_accum4+zero,128@dots_accum4+zero+qcomm,128@dots_accum4+zero+zprefetch \
                        python bench.py
+# 4c — inference serving rung (PR-3): continuous-batching decode through
+#      the paged KV cache + ragged paged-attention kernel. The serving
+#      prefill/decode programs already ride the overlap_gate compile-only
+#      item above (bench.py --compile-only appends a "serving" rung);
+#      this is the timed run: decode steps/s + TTFT at the fixed
+#      16-request mix (GPT-medium-class geometry, metric
+#      apex_tpu_serving_decode_steps_per_sec).
+run serving_bench 3600 '"ok": true' python bench.py --serving
 # 5 — the WHOLE tpu tier in one invocation (19/19 + 5/5 goal)
 run tpu_full      3600 ' passed' env APEX_TPU_HW=1 python -m pytest tests/tpu -v
 # 6 — warm the driver's exact path last

@@ -71,6 +71,41 @@ def test_trace_range_is_transparent():
     np.testing.assert_array_equal(np.asarray(f(x)), 3.0)
 
 
+def test_profiling_enabled_resolution_order(monkeypatch):
+    """Pins the call-time switch: APEX_TPU_PROF env (re-read at every
+    call, not latched at import) > set_profiling_enabled > default on.
+    The old import-time latch silently ignored an env var set after
+    import — the ISSUE-3 satellite fix."""
+    from apex_tpu.utils import profiling
+
+    monkeypatch.delenv("APEX_TPU_PROF", raising=False)
+    monkeypatch.setattr(profiling, "_PROF_OVERRIDE", None)
+    assert profiling.profiling_enabled()          # default: on
+
+    # env set AFTER import takes effect at the next call
+    monkeypatch.setenv("APEX_TPU_PROF", "0")
+    assert not profiling.profiling_enabled()
+    monkeypatch.setenv("APEX_TPU_PROF", "1")
+    assert profiling.profiling_enabled()
+
+    # programmatic switch works while env is unset ...
+    monkeypatch.delenv("APEX_TPU_PROF", raising=False)
+    profiling.set_profiling_enabled(False)
+    assert not profiling.profiling_enabled()
+    # ... and the env var WINS over it in both directions
+    monkeypatch.setenv("APEX_TPU_PROF", "1")
+    assert profiling.profiling_enabled()
+    profiling.set_profiling_enabled(True)
+    monkeypatch.setenv("APEX_TPU_PROF", "0")
+    assert not profiling.profiling_enabled()
+
+    # trace_range itself honors the disabled switch (still transparent)
+    with trace_range("disabled-range"):
+        y = jnp.ones((2,)) + 1
+    np.testing.assert_array_equal(np.asarray(y), 2.0)
+    profiling.set_profiling_enabled(None)
+
+
 def test_global_memory_buffer_shim():
     buf = get_global_memory_buffer()
     assert isinstance(buf, GlobalMemoryBuffer)

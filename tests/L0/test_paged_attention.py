@@ -1,0 +1,233 @@
+"""Ragged paged-attention decode kernel vs the jnp oracle.
+
+Runs on the hermetic CPU mesh with the Pallas kernel in INTERPRET mode
+(tests/conftest.py pins JAX_PLATFORMS=cpu; ops/_utils.pallas_interpret
+turns interpret on off-TPU), mirroring the test_tuning_fuzz.py pattern:
+a clean-env fixture so inherited A/B knobs can't skew the sweep, plus
+seeded random samples over the tunable space (registry.TUNABLES
+["paged_decode"]) so any cache entry the autotuner can emit is a
+configuration this suite has proven numerically correct.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.paged_attention import paged_attention, paged_attention_ref
+from apex_tpu.tuning import cache, registry, shape_class
+
+
+@pytest.fixture(autouse=True)
+def _clean_paged_env(monkeypatch, tmp_path):
+    for var in ("APEX_TPU_PAGED_BLOCK_ROWS", "APEX_TPU_PAGED_KV_FETCH",
+                "APEX_TPU_USE_PALLAS", "APEX_TPU_TUNE"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("APEX_TPU_TUNEDB", str(tmp_path / "tunedb.json"))
+    cache.invalidate()
+    yield
+    cache.invalidate()
+
+
+def _maxdiff(a, b):
+    return float(jnp.max(jnp.abs(
+        a.astype(jnp.float32) - b.astype(jnp.float32))))
+
+
+def _setup(slots, hq, hkv, d, nb, bs, maxb, lens, dtype, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    k_pool = jax.random.normal(ks[0], (nb, bs, hkv, d), dtype)
+    v_pool = jax.random.normal(ks[1], (nb, bs, hkv, d), dtype)
+    q = jax.random.normal(ks[2], (slots, hq, d), dtype)
+    # distinct pages per (slot, table entry) — catches block-id mixups
+    tables = jax.random.permutation(ks[3], nb)[: slots * maxb].reshape(
+        slots, maxb).astype(jnp.int32)
+    return q, k_pool, v_pool, tables, jnp.asarray(lens, jnp.int32)
+
+
+_TOL = {jnp.float32: 2e-5, jnp.bfloat16: 5e-2}
+
+
+@pytest.mark.parametrize("group", [1, 2, 4])
+@pytest.mark.parametrize("d", [32, 64, 128])
+def test_kernel_vs_oracle_gqa_head_dim_grid(group, d):
+    hkv = 2
+    args = _setup(slots=4, hq=group * hkv, hkv=hkv, d=d, nb=16, bs=8,
+                  maxb=3, lens=[24, 1, 9, 17], dtype=jnp.float32,
+                  seed=group * 10 + d)
+    got = paged_attention(*args, use_pallas=True)
+    ref = paged_attention_ref(*args)
+    assert _maxdiff(got, ref) < _TOL[jnp.float32], (group, d)
+
+
+@pytest.mark.parametrize("lens", [
+    [0, 0, 0, 0],            # all inactive
+    [1, 1, 1, 1],            # single token each
+    [32, 0, 32, 0],          # full tables, interleaved empty
+    [5, 31, 8, 16],          # partial pages at every boundary class
+])
+def test_kernel_vs_oracle_ragged_lengths(lens):
+    args = _setup(slots=4, hq=4, hkv=4, d=64, nb=24, bs=8, maxb=4,
+                  lens=lens, dtype=jnp.float32, seed=sum(lens))
+    got = paged_attention(*args, use_pallas=True)
+    ref = paged_attention_ref(*args)
+    assert _maxdiff(got, ref) < _TOL[jnp.float32], lens
+    for i, n in enumerate(lens):
+        if n == 0:  # inactive slots output exactly 0, not NaN
+            assert float(jnp.max(jnp.abs(got[i].astype(jnp.float32)))) == 0.0
+
+
+def test_kernel_matches_flash_attention_last_row():
+    """Cross-oracle: paged decode of the LAST position over a contiguous
+    cache equals causal flash attention's last row."""
+    from apex_tpu.ops.attention import attention_reference
+
+    b_s, hq, d, t = 8, 4, 64, 24
+    k = jax.random.normal(jax.random.PRNGKey(0), (1, hq, t, d))
+    v = jax.random.normal(jax.random.PRNGKey(1), (1, hq, t, d))
+    q = jax.random.normal(jax.random.PRNGKey(2), (1, hq, t, d))
+    full = attention_reference(q, k, v, causal=True)[0, :, -1]   # [hq, d]
+
+    # pack the same K/V into pages (identity table)
+    maxb = -(-t // b_s)
+    pad = maxb * b_s - t
+    k_pool = jnp.pad(k[0].transpose(1, 0, 2), ((0, pad), (0, 0), (0, 0))
+                     ).reshape(maxb, b_s, hq, d)
+    v_pool = jnp.pad(v[0].transpose(1, 0, 2), ((0, pad), (0, 0), (0, 0))
+                     ).reshape(maxb, b_s, hq, d)
+    got = paged_attention(
+        q[0, :, -1][None], k_pool, v_pool,
+        jnp.arange(maxb, dtype=jnp.int32)[None],
+        jnp.array([t], jnp.int32), use_pallas=True)[0]
+    assert _maxdiff(got, full) < 1e-4
+
+
+@pytest.mark.parametrize("case", range(6))
+def test_fuzz_paged_config_space_vs_oracle(case):
+    """Seeded samples over the registry's tunable space, pinned through
+    the tune cache exactly as the autotuner would write them."""
+    rng = random.Random(5000 + case)
+    space = registry.TUNABLES["paged_decode"].params
+    p = {
+        "slots": rng.choice([1, 3, 8]),
+        "hkv": rng.choice([1, 2]),
+        "group": rng.choice([1, 2, 4]),
+        "d": rng.choice([32, 64, 128]),
+        "bs": rng.choice([4, 8, 16]),
+        "maxb": rng.choice([1, 3, 5]),
+        "dtype": rng.choice([jnp.float32, jnp.bfloat16]),
+        "block_rows": rng.choice(space["block_rows"]),
+        "kv_fetch": rng.choice(space["kv_fetch"]),
+    }
+    total = p["bs"] * p["maxb"]
+    lens = [rng.randint(0, total) for _ in range(p["slots"])]
+    nb = max(p["slots"] * p["maxb"], 8)
+    args = _setup(p["slots"], p["group"] * p["hkv"], p["hkv"], p["d"], nb,
+                  p["bs"], p["maxb"], lens, p["dtype"], seed=case)
+
+    entry = {"block_rows": p["block_rows"], "kv_fetch": p["kv_fetch"]}
+    registry.validate_entry("paged_decode", entry)    # only legal entries
+    db = cache.TuneDB()
+    db.record(
+        shape_class.paged_key(p["slots"], p["maxb"], p["bs"], p["group"],
+                              p["d"], p["dtype"]),
+        entry, source="fuzz")
+    with cache.pinned(db):
+        got = paged_attention(*args, use_pallas=True)
+    ref = paged_attention_ref(*args)
+    assert _maxdiff(got, ref) < _TOL[p["dtype"]], p
+
+
+def test_env_overrides_win_over_cache(monkeypatch):
+    """APEX_TPU_PAGED_* env beats a pinned cache entry (resolution-order
+    pin, mirroring the PR-1 flash test) — and both still match the
+    oracle."""
+    from apex_tpu.ops import paged_attention as mod
+
+    args = _setup(slots=2, hq=4, hkv=2, d=64, nb=8, bs=8, maxb=2,
+                  lens=[10, 3], dtype=jnp.float32)
+    db = cache.TuneDB()
+    db.record(shape_class.paged_key(2, 2, 8, 2, 64, jnp.float32),
+              {"block_rows": 32, "kv_fetch": 1}, source="test")
+    monkeypatch.setenv("APEX_TPU_PAGED_BLOCK_ROWS", "8")
+    monkeypatch.setenv("APEX_TPU_PAGED_KV_FETCH", "2")
+    with cache.pinned(db):
+        resolved = mod._paged_params(2, 2, 8, 2, 64, jnp.float32)
+        assert resolved["block_rows"] == 8      # env, not the cached 32
+        assert resolved["kv_fetch"] == 2        # env, not the cached 1
+        got = paged_attention(*args, use_pallas=True)
+    assert _maxdiff(got, paged_attention_ref(*args)) < _TOL[jnp.float32]
+
+    with cache.pinned(db):                       # env gone -> cache wins
+        monkeypatch.delenv("APEX_TPU_PAGED_BLOCK_ROWS")
+        monkeypatch.delenv("APEX_TPU_PAGED_KV_FETCH")
+        resolved = mod._paged_params(2, 2, 8, 2, 64, jnp.float32)
+        assert resolved["block_rows"] == 32
+        assert resolved["kv_fetch"] == 1
+
+
+def test_backend_pin_routes_to_oracle(monkeypatch):
+    """A cached {"backend": "jnp"} pin forces the fallback in auto mode;
+    APEX_TPU_USE_PALLAS=1 overrides the pin (env > cache)."""
+    from apex_tpu.ops import paged_attention as mod
+
+    db = cache.TuneDB()
+    db.record(shape_class.paged_key(2, 2, 8, 2, 64, jnp.float32),
+              {"backend": "jnp"}, source="test")
+    with cache.pinned(db):
+        monkeypatch.setenv("APEX_TPU_USE_PALLAS", "1")
+        assert mod._auto_use_kernel(2, 2, 8, 2, 64, jnp.float32)
+        monkeypatch.delenv("APEX_TPU_USE_PALLAS")
+        assert not mod._auto_use_kernel(2, 2, 8, 2, 64, jnp.float32)
+
+
+def test_shape_validation_errors():
+    q = jnp.zeros((2, 4, 16))
+    k_pool = jnp.zeros((4, 8, 2, 16))
+    tbl = jnp.zeros((2, 2), jnp.int32)
+    lens = jnp.zeros((2,), jnp.int32)
+    with pytest.raises(ValueError, match="slots, heads, dim"):
+        paged_attention(q[0], k_pool, k_pool, tbl, lens)
+    with pytest.raises(ValueError, match="pools"):
+        paged_attention(q, k_pool, k_pool[:, :, :1], tbl, lens)
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        paged_attention(jnp.zeros((2, 3, 16)), k_pool, k_pool, tbl, lens)
+    with pytest.raises(ValueError, match="do not match"):
+        paged_attention(q, k_pool, k_pool, tbl[:1], lens)
+
+
+def test_registry_entry_validation():
+    registry.validate_entry("paged_decode", {"block_rows": 16,
+                                             "kv_fetch": 4})
+    with pytest.raises(ValueError, match="block_rows"):
+        registry.validate_entry("paged_decode", {"block_rows": 12})
+    with pytest.raises(ValueError, match="kv_fetch"):
+        registry.validate_entry("paged_decode", {"kv_fetch": 0})
+    with pytest.raises(ValueError, match="backend"):
+        registry.validate_entry("paged_decode", {"backend": "cuda"})
+
+
+def test_cost_model_defaults_legal():
+    """Every cost-model default must validate against the registry (the
+    invariant the autotuner relies on)."""
+    from apex_tpu.tuning import cost_model
+
+    for group in (1, 2, 4, 8, 16):
+        rows = cost_model.paged_block_rows_default(group)
+        registry.validate_entry("paged_decode", {"block_rows": rows})
+        assert rows >= min(group, 32)
+    for bs in (4, 16, 64, 256):
+        for d in (64, 128, 256):
+            f = cost_model.paged_kv_fetch_default(bs, d)
+            registry.validate_entry("paged_decode", {"kv_fetch": f})
+
+
+def test_interpret_mode_on_cpu():
+    """Tier-1 hygiene pin: this suite runs the KERNEL path with no TPU —
+    platform is cpu and pallas_interpret() resolves True."""
+    from apex_tpu.ops._utils import pallas_interpret
+
+    assert jax.devices()[0].platform == "cpu"
+    assert pallas_interpret()
