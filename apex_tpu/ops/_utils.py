@@ -7,6 +7,19 @@ import os
 import jax
 
 
+def env_int(var: str, *, quantum: int = 1):
+    """Validated integer env override (None when unset/empty): positive
+    multiple of ``quantum`` or a loud ValueError — the op-layer knob
+    contract (APEX_TPU_PAGED_*, APEX_TPU_MOE_TILE_*)."""
+    env = os.environ.get(var)
+    if not env:
+        return None
+    v = int(env)
+    if v <= 0 or v % quantum:
+        raise ValueError(f"{var}={v} must be a positive multiple of {quantum}")
+    return v
+
+
 def on_tpu() -> bool:
     try:
         return jax.devices()[0].platform == "tpu"

@@ -1,0 +1,459 @@
+"""Ragged grouped matmul (MegaBlocks-style "gmm") — Pallas fwd/bwd kernels.
+
+The MoE expert FFN is E independent matmuls over contiguous, *ragged*
+token groups: ``out[offs[e]:offs[e+1]] = lhs[offs[e]:offs[e+1]] @ rhs[e]``.
+The dense GShard/Switch dispatch pays O(t·E·C·h) to express this as one
+batched einsum over fixed-capacity slots; this kernel walks the groups
+directly, so expert FLOPs scale with the tokens actually routed — the
+dropless MoE fast path (transformer/moe.py, APEX_TPU_MOE_GROUPED=1).
+
+TPU design (same discipline as ops/paged_attention.py): the ragged group
+boundaries ride as SCALAR PREFETCH operands. ``group_sizes`` is a traced
+array, so the grid must be static — the work decomposition uses the
+MegaBlocks bound: every (tile_t-aligned row tile) x (group) intersection
+is one work item, at most ``t_pad/tile_t + E`` of them. A jnp prologue
+(`_group_metadata`) turns ``group_sizes`` into flat ``work_tile`` /
+``work_group`` arrays (+ a sentinel row) and the BlockSpec index maps
+read them to select the lhs row tile and the rhs expert block per grid
+step — the ragged gather happens in the pipeline's own DMAs. Tiles that
+straddle a group boundary are visited once per group with the rows
+outside the group masked to zero; consecutive visitors of one output
+tile accumulate into an fp32 VMEM scratch that is flushed by the tile's
+last visitor (fp32 MXU accumulation throughout,
+``preferred_element_type``). Row tiles past the last routed token are
+emitted as exact zeros, so ``sum(group_sizes) < t`` is well-defined.
+
+Three entry points:
+
+- ``gmm(lhs[t,h], rhs[E,h,f], group_sizes[E]) -> [t,f]`` — the forward.
+- ``gmm(..., transpose_rhs=True)`` with ``lhs[t,f]`` contracts against
+  ``rhs[E,h,f]`` transposed per group -> ``[t,h]`` — the same kernel
+  body with swapped dot dimensions; the backward's dlhs reuses it.
+- ``tgmm(lhs[t,a], dout[t,b], group_sizes) -> [E,a,b]`` — per-group
+  outer product (``lhs_e^T @ dout_e``), the backward's drhs. Output
+  blocks of empty groups are zeroed in the wrapper (their grid steps
+  are never visited).
+
+``gmm`` carries a ``jax.custom_vjp``: dlhs via gmm against rhs^T, drhs
+via tgmm — both Pallas (or both oracle, per the same backend decision).
+
+Tunables (``moe_grouped`` family, tuning/registry.py): ``tile_t`` (rows
+per work tile, sublane multiple of 8) and ``tile_f`` (output columns per
+grid step, lane multiple of 128), resolved env (APEX_TPU_MOE_TILE_T /
+APEX_TPU_MOE_TILE_F) > tune cache > cost model; the cost model also owns
+the oracle-fallback threshold (``cost_model.MOE_FALLBACK_ROWS`` — below
+it the dense segment oracle beats the grid overhead) that backs the
+``backend`` pin, following the PR-1 resolution order.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from apex_tpu.ops._utils import default_use_pallas, env_int, pallas_interpret
+
+try:
+    from jax.experimental.pallas import tpu as _pltpu
+except Exception:  # pragma: no cover
+    _pltpu = None
+
+_HIGHEST = jax.lax.Precision.HIGHEST
+
+
+def _ceil(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _pad128(n: int) -> int:
+    return max(128, _ceil(n, 128) * 128)
+
+
+def _gmm_params(t: int, e: int, h: int, f: int, dtype) -> dict:
+    """Resolved {"tile_t", "tile_f", "backend"} for one call: env wins
+    outright, then the tune cache for this shape class, then the cost
+    model — the same three-layer order as every PR-1 family."""
+    from apex_tpu import tuning
+
+    cfg = tuning.moe_grouped_config(t, e, h, f, dtype)
+    tt = env_int("APEX_TPU_MOE_TILE_T", quantum=8)
+    tf = env_int("APEX_TPU_MOE_TILE_F", quantum=128)
+    return {
+        "tile_t": tt if tt is not None else cfg["tile_t"],
+        "tile_f": tf if tf is not None else cfg["tile_f"],
+        "backend": cfg["backend"],
+    }
+
+
+def _auto_use_kernel(t: int, e: int, h: int, f: int, dtype) -> bool:
+    """Backend decision for auto mode (use_pallas=None): preflight registry
+    and APEX_TPU_USE_PALLAS first (ops/_utils.default_use_pallas), then a
+    pinned cache entry ({"backend": "jnp"}) or the cost model's
+    oracle-fallback threshold may still route this shape class to the
+    segment oracle; env=1 beats the cache (env > cache > model)."""
+    if not default_use_pallas("grouped_matmul"):
+        return False
+    if os.environ.get("APEX_TPU_USE_PALLAS") == "1":
+        return True
+    return _gmm_params(t, e, h, f, dtype)["backend"] != "jnp"
+
+
+# ---------------------------------------------------------------------------
+# jnp reference (oracle + fallback)
+# ---------------------------------------------------------------------------
+
+def _segment_ids(group_sizes, rows: int):
+    """Group id per row (rows past sum(group_sizes) get id E — the
+    one-hot of which is all-zero, so trailing rows contribute/receive
+    exact zeros, matching the kernel contract)."""
+    ends = jnp.cumsum(group_sizes.astype(jnp.int32))
+    return jnp.searchsorted(ends, jnp.arange(rows, dtype=jnp.int32),
+                            side="right")
+
+
+def gmm_ref(lhs, rhs, group_sizes, *, transpose_rhs=False, out_dtype=None):
+    """Unfused oracle: one-hot segment select + dense einsum over every
+    expert — O(t·E·h·f) FLOPs, the cost the kernel exists to avoid; used
+    as the fallback (small-row shape classes) and the test oracle."""
+    e = rhs.shape[0]
+    sel = jax.nn.one_hot(_segment_ids(group_sizes, lhs.shape[0]), e,
+                         dtype=lhs.dtype)                      # [t, E]
+    eq = "te,tf,ehf->th" if transpose_rhs else "te,th,ehf->tf"
+    out = jnp.einsum(eq, sel, lhs, rhs,
+                     preferred_element_type=jnp.float32)
+    return out.astype(out_dtype or lhs.dtype)
+
+
+def tgmm_ref(lhs, dout, group_sizes, *, out_dtype=None):
+    """Per-group outer-product oracle: ``out[e] = lhs_e^T @ dout_e``."""
+    e = group_sizes.shape[0]
+    sel = jax.nn.one_hot(_segment_ids(group_sizes, lhs.shape[0]), e,
+                         dtype=lhs.dtype)                      # [t, E]
+    out = jnp.einsum("te,ta,tb->eab", sel, lhs, dout,
+                     preferred_element_type=jnp.float32)
+    return out.astype(out_dtype or lhs.dtype)
+
+
+# ---------------------------------------------------------------------------
+# work decomposition (jnp prologue -> scalar prefetch)
+# ---------------------------------------------------------------------------
+
+def _group_metadata(group_sizes, t_pad: int, tile_t: int):
+    """Static-shape work list for the ragged grid.
+
+    Work item i handles the intersection of row tile ``work_tile[i]``
+    with group ``work_group[i]``; items are ordered by (group, tile), so
+    both sequences are nondecreasing — the property the revisit-chain
+    accumulation in the kernels relies on. Trailing row tiles past the
+    last routed token get items with the sentinel group E (empty row
+    mask — they flush zeros); unused slots get the sentinel tile ``pt``
+    (never emitted). One extra sentinel row (tile=pt, group=E) lets the
+    kernels peek at ``i+1`` without bounds checks.
+
+    Returns (work_tile [n+1], work_group [n+1], offs [E+1]), all int32,
+    with n = t_pad//tile_t + E — the MegaBlocks bound on (tile, group)
+    intersections."""
+    e = group_sizes.shape[0]
+    pt = t_pad // tile_t
+    nw = pt + e
+    offs = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(group_sizes.astype(jnp.int32)),
+    ])                                                         # [E+1]
+    first = offs[:-1] // tile_t
+    last = (offs[1:] - 1) // tile_t                            # nonempty only
+    span = jnp.where(group_sizes > 0, last - first + 1, 0)
+    wend = jnp.cumsum(span)                                    # [E]
+    wstart = wend - span
+    nreal = wend[-1]
+    idx = jnp.arange(nw, dtype=jnp.int32)
+    g = jnp.searchsorted(wend, idx, side="right").astype(jnp.int32)
+    gc = jnp.minimum(g, e - 1)
+    tile = first[gc] + (idx - wstart[gc])
+    covered = _ceil(offs[-1], tile_t)             # tiles holding real rows
+    is_trail = (idx >= nreal) & (idx < nreal + (pt - covered))
+    tile = jnp.where(is_trail, covered + (idx - nreal), tile)
+    valid = idx < nreal + (pt - covered)
+    work_tile = jnp.where(valid, tile, pt)
+    work_group = jnp.where(idx < nreal, g, e)
+    sent_t = jnp.full((1,), pt, jnp.int32)
+    sent_g = jnp.full((1,), e, jnp.int32)
+    return (jnp.concatenate([work_tile, sent_t]),
+            jnp.concatenate([work_group, sent_g]), offs)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels
+# ---------------------------------------------------------------------------
+
+def _gmm_kernel(tile_ref, group_ref, offs_ref, lhs_ref, rhs_ref, out_ref,
+                acc_ref, *, tile_t, pt, ne, transpose_rhs):
+    """Grid (f-tile j, work item i). One masked partial matmul per step,
+    accumulated in fp32 scratch; the tile's last visitor flushes."""
+    i = pl.program_id(1)
+    tile = tile_ref[i]
+    g = jnp.minimum(group_ref[i], ne - 1)
+    prev_tile = jnp.where(i == 0, -1, tile_ref[jnp.maximum(i - 1, 0)])
+
+    @pl.when(prev_tile != tile)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    rows = tile * tile_t + jax.lax.broadcasted_iota(
+        jnp.int32, (tile_t, 1), 0)
+    mask = (rows >= offs_ref[g]) & (rows < offs_ref[g + 1])
+    lhs = jnp.where(mask, lhs_ref[...], 0)
+    rhs = rhs_ref[0]
+    # contract lhs[:, h] with rhs[h, tf] (fwd) or rhs[tf, f]^T (dlhs)
+    dims = (((1,), (1,)), ((), ())) if transpose_rhs \
+        else (((1,), (0,)), ((), ()))
+    acc_ref[...] += jax.lax.dot_general(
+        lhs, rhs, dims, preferred_element_type=jnp.float32)
+
+    @pl.when(tile_ref[i + 1] != tile)
+    def _emit():
+        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
+def _gmm_pallas(lhs, rhs, group_sizes, tile_t, tile_f, transpose_rhs,
+                out_dtype):
+    t, kdim = lhs.shape
+    e = rhs.shape[0]
+    # output columns come from rhs's h dim (transposed) or f dim (fwd)
+    n_out = rhs.shape[1] if transpose_rhs else rhs.shape[2]
+    k_pad = _pad128(kdim)
+    tile_f = min(tile_f, _pad128(n_out))
+    # the grid floor-divides, so the padded output width must be a tile
+    # multiple or trailing blocks would never be visited (= garbage out)
+    f_pad = _ceil(_pad128(n_out), tile_f) * tile_f
+    t_pad = _ceil(max(t, 1), tile_t) * tile_t
+    pt = t_pad // tile_t
+    nf = f_pad // tile_f
+
+    lhs_p = jnp.pad(lhs, ((0, t_pad - t), (0, k_pad - kdim)))
+    if transpose_rhs:
+        rhs_p = jnp.pad(rhs, ((0, 0), (0, f_pad - rhs.shape[1]),
+                              (0, k_pad - kdim)))
+        rhs_block = (1, tile_f, k_pad)
+        rhs_map = lambda j, i, tr, gr, of: (jnp.minimum(gr[i], e - 1), j, 0)
+    else:
+        rhs_p = jnp.pad(rhs, ((0, 0), (0, k_pad - kdim),
+                              (0, f_pad - rhs.shape[2])))
+        rhs_block = (1, k_pad, tile_f)
+        rhs_map = lambda j, i, tr, gr, of: (jnp.minimum(gr[i], e - 1), 0, j)
+
+    work_tile, work_group, offs = _group_metadata(group_sizes, t_pad, tile_t)
+
+    def row_map(j, i, tile_ref, group_ref, offs_ref):
+        return (jnp.minimum(tile_ref[i], pt - 1), 0)
+
+    grid_spec = _pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(nf, pt + e),
+        in_specs=[
+            pl.BlockSpec((tile_t, k_pad), row_map),
+            pl.BlockSpec(rhs_block, rhs_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile_t, tile_f),
+            lambda j, i, tr, gr, of: (jnp.minimum(tr[i], pt - 1), j)),
+        scratch_shapes=[_pltpu.VMEM((tile_t, tile_f), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_gmm_kernel, tile_t=tile_t, pt=pt, ne=e,
+                          transpose_rhs=transpose_rhs),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t_pad, f_pad), out_dtype),
+        interpret=pallas_interpret(),
+    )(work_tile, work_group, offs, lhs_p, rhs_p)
+    return out[:t, :n_out]
+
+
+def _tgmm_kernel(tile_ref, group_ref, offs_ref, lhs_ref, dout_ref, out_ref,
+                 acc_ref, *, tile_t, ne):
+    """Grid (a-tile, b-tile, work item). Per-group outer product: the
+    revisit chain is keyed on the GROUP (consecutive work items of one
+    group are adjacent), flushed by the group's last visitor."""
+    i = pl.program_id(2)
+    tile = tile_ref[i]
+    g_raw = group_ref[i]
+    g = jnp.minimum(g_raw, ne - 1)
+    prev_g = jnp.where(i == 0, -1, group_ref[jnp.maximum(i - 1, 0)])
+
+    @pl.when(prev_g != g_raw)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    rows = tile * tile_t + jax.lax.broadcasted_iota(
+        jnp.int32, (tile_t, 1), 0)
+    mask = (rows >= offs_ref[g]) & (rows < offs_ref[g + 1])
+    lhs = jnp.where(mask, lhs_ref[...], 0)
+    acc_ref[...] += jax.lax.dot_general(
+        lhs, dout_ref[...], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    # sentinel groups (trail/invalid, g_raw == ne) never emit; the real
+    # last group's chain may extend through them — its written buffer is
+    # what the pipeline copies out at the end
+    @pl.when((group_ref[i + 1] != g_raw) & (g_raw < ne))
+    def _emit():
+        out_ref[0] = acc_ref[...].astype(out_ref.dtype)
+
+
+def _tgmm_pallas(lhs, dout, group_sizes, tile_t, tile_f, out_dtype):
+    t, a = lhs.shape
+    _, b = dout.shape
+    e = group_sizes.shape[0]
+    ta = min(tile_f, _pad128(a))
+    tb = min(tile_f, _pad128(b))
+    # same grid floor-division rule as _gmm_pallas: pad to tile multiples
+    a_pad = _ceil(_pad128(a), ta) * ta
+    b_pad = _ceil(_pad128(b), tb) * tb
+    t_pad = _ceil(max(t, 1), tile_t) * tile_t
+    pt = t_pad // tile_t
+
+    lhs_p = jnp.pad(lhs, ((0, t_pad - t), (0, a_pad - a)))
+    dout_p = jnp.pad(dout, ((0, t_pad - t), (0, b_pad - b)))
+    work_tile, work_group, offs = _group_metadata(group_sizes, t_pad, tile_t)
+
+    def row_map_a(ja, jb, i, tr, gr, of):
+        return (jnp.minimum(tr[i], pt - 1), ja)
+
+    def row_map_b(ja, jb, i, tr, gr, of):
+        return (jnp.minimum(tr[i], pt - 1), jb)
+
+    grid_spec = _pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(a_pad // ta, b_pad // tb, pt + e),
+        in_specs=[
+            pl.BlockSpec((tile_t, ta), row_map_a),
+            pl.BlockSpec((tile_t, tb), row_map_b),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, ta, tb),
+            lambda ja, jb, i, tr, gr, of: (jnp.minimum(gr[i], e - 1), ja,
+                                           jb)),
+        scratch_shapes=[_pltpu.VMEM((ta, tb), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_tgmm_kernel, tile_t=tile_t, ne=e),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((e, a_pad, b_pad), out_dtype),
+        interpret=pallas_interpret(),
+    )(work_tile, work_group, offs, lhs_p, dout_p)
+    # grid steps of empty groups are never visited -> their out blocks
+    # are undefined; the contract (= the oracle, = jax.grad) is zeros
+    out = jnp.where(group_sizes[:, None, None] > 0, out, 0)
+    return out[:, :a, :b]
+
+
+# ---------------------------------------------------------------------------
+# differentiable core (custom_vjp) + public API
+# ---------------------------------------------------------------------------
+
+def _gmm_dispatch(lhs, rhs, group_sizes, transpose_rhs, out_dtype,
+                  use_pallas):
+    t, kdim = lhs.shape
+    e, h, f = rhs.shape
+    out_dtype = out_dtype or lhs.dtype
+    use = use_pallas
+    if use is None:
+        use = _auto_use_kernel(t, e, h, f, lhs.dtype)
+    if not use or _pltpu is None:
+        return gmm_ref(lhs, rhs, group_sizes, transpose_rhs=transpose_rhs,
+                       out_dtype=out_dtype)
+    p = _gmm_params(t, e, h, f, lhs.dtype)
+    return _gmm_pallas(lhs, rhs, group_sizes, p["tile_t"], p["tile_f"],
+                       transpose_rhs, out_dtype)
+
+
+def _tgmm_dispatch(lhs, dout, group_sizes, out_dtype, use_pallas):
+    t, a = lhs.shape
+    _, b = dout.shape
+    e = group_sizes.shape[0]
+    out_dtype = out_dtype or lhs.dtype
+    use = use_pallas
+    if use is None:
+        use = _auto_use_kernel(t, e, a, b, lhs.dtype)
+    if not use or _pltpu is None:
+        return tgmm_ref(lhs, dout, group_sizes, out_dtype=out_dtype)
+    p = _gmm_params(t, e, a, b, lhs.dtype)
+    return _tgmm_pallas(lhs, dout, group_sizes, p["tile_t"], p["tile_f"],
+                        out_dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _gmm_core(lhs, rhs, group_sizes, transpose_rhs, out_dtype, use_pallas):
+    return _gmm_dispatch(lhs, rhs, group_sizes, transpose_rhs, out_dtype,
+                         use_pallas)
+
+
+def _gmm_core_fwd(lhs, rhs, group_sizes, transpose_rhs, out_dtype,
+                  use_pallas):
+    out = _gmm_dispatch(lhs, rhs, group_sizes, transpose_rhs, out_dtype,
+                        use_pallas)
+    return out, (lhs, rhs, group_sizes)
+
+
+def _gmm_core_bwd(transpose_rhs, out_dtype, use_pallas, res, dout):
+    lhs, rhs, group_sizes = res
+    del out_dtype  # cotangent dtypes follow the primals
+    if transpose_rhs:
+        # fwd: out[t,h'] = sum_f lhs[t,f] rhs[g,h',f]
+        dlhs = _gmm_dispatch(dout, rhs, group_sizes, False, lhs.dtype,
+                             use_pallas)
+        drhs = _tgmm_dispatch(dout, lhs, group_sizes, rhs.dtype, use_pallas)
+    else:
+        # fwd: out[t,f'] = sum_h lhs[t,h] rhs[g,h,f']
+        dlhs = _gmm_dispatch(dout, rhs, group_sizes, True, lhs.dtype,
+                             use_pallas)
+        drhs = _tgmm_dispatch(lhs, dout, group_sizes, rhs.dtype, use_pallas)
+    dsizes = np.zeros(group_sizes.shape, jax.dtypes.float0)
+    return dlhs, drhs, dsizes
+
+
+_gmm_core.defvjp(_gmm_core_fwd, _gmm_core_bwd)
+
+
+def gmm(lhs, rhs, group_sizes, *, transpose_rhs=False, out_dtype=None,
+        use_pallas=None):
+    """Ragged grouped matmul over contiguous expert groups.
+
+    lhs: ``[t, h]`` rows sorted by group (``[t, f]`` with
+    ``transpose_rhs=True``); rhs: ``[E, h, f]``; group_sizes: ``[E]``
+    int — rows ``cumsum[e-1]:cumsum[e]`` of lhs belong to expert e
+    (``sum(group_sizes) <= t``; trailing rows produce exact zeros).
+    Returns ``[t, f]`` (``[t, h]`` transposed) in ``out_dtype`` (default
+    lhs.dtype), accumulated in fp32 on the MXU. Differentiable in lhs
+    and rhs (custom_vjp: dlhs via the transposed gmm, drhs via
+    :func:`tgmm`); empty groups are legal and get zero gradients.
+    """
+    if lhs.ndim != 2 or rhs.ndim != 3:
+        raise ValueError(f"gmm expects lhs [t, k], rhs [E, k_or_h, f]: "
+                         f"got {lhs.shape} / {rhs.shape}")
+    if group_sizes.shape != (rhs.shape[0],):
+        raise ValueError(f"group_sizes {group_sizes.shape} does not match "
+                         f"E={rhs.shape[0]}")
+    kdim = rhs.shape[2] if transpose_rhs else rhs.shape[1]
+    if lhs.shape[1] != kdim:
+        raise ValueError(
+            f"lhs contract dim {lhs.shape[1]} != rhs {kdim} "
+            f"(transpose_rhs={transpose_rhs})")
+    return _gmm_core(lhs, rhs, group_sizes.astype(jnp.int32), transpose_rhs,
+                     out_dtype, use_pallas)
+
+
+def tgmm(lhs, dout, group_sizes, *, out_dtype=None, use_pallas=None):
+    """Per-group outer product ``out[e] = lhs_e^T @ dout_e`` -> [E, a, b]
+    (the gmm backward's drhs; also useful standalone). Not itself
+    differentiable — it IS the derivative."""
+    if lhs.ndim != 2 or dout.ndim != 2 or lhs.shape[0] != dout.shape[0]:
+        raise ValueError(f"tgmm expects row-aligned 2-D operands: "
+                         f"{lhs.shape} / {dout.shape}")
+    return _tgmm_dispatch(lhs, dout, group_sizes.astype(jnp.int32),
+                          out_dtype, use_pallas)

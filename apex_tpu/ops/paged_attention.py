@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from apex_tpu.ops._utils import default_use_pallas, pallas_interpret
+from apex_tpu.ops._utils import default_use_pallas, env_int, pallas_interpret
 
 try:
     from jax.experimental.pallas import tpu as _pltpu
@@ -51,16 +51,6 @@ except Exception:  # pragma: no cover
 
 _HIGHEST = jax.lax.Precision.HIGHEST
 _NEG_INF = -1e30
-
-
-def _env_int(var: str, *, quantum: int = 1):
-    env = os.environ.get(var)
-    if not env:
-        return None
-    v = int(env)
-    if v <= 0 or v % quantum:
-        raise ValueError(f"{var}={v} must be a positive multiple of {quantum}")
-    return v
 
 
 def _paged_params(n_slots: int, max_blocks: int, block_size: int, group: int,
@@ -73,8 +63,8 @@ def _paged_params(n_slots: int, max_blocks: int, block_size: int, group: int,
 
     cfg = tuning.paged_decode_config(n_slots, max_blocks, block_size, group,
                                      d, dtype)
-    rows = _env_int("APEX_TPU_PAGED_BLOCK_ROWS", quantum=8)
-    fetch = _env_int("APEX_TPU_PAGED_KV_FETCH")
+    rows = env_int("APEX_TPU_PAGED_BLOCK_ROWS", quantum=8)
+    fetch = env_int("APEX_TPU_PAGED_KV_FETCH")
     return {
         "block_rows": rows if rows is not None else cfg["block_rows"],
         "kv_fetch": min(fetch if fetch is not None else cfg["kv_fetch"],

@@ -23,15 +23,43 @@ score order (priority dispatch); overflow tokens are DROPPED from that
 expert — their combine weight is 0 and the caller's residual connection
 carries them through unchanged (Switch-Transformer semantics).
 
-Aux outputs: the Switch load-balance loss (E * Σ_e fraction_e * prob_e)
-and the router z-loss (mean log²Z) — add them to the task loss with small
-coefficients; both psum-ready (they are plain means over local tokens).
+**Grouped fast path** (``APEX_TPU_MOE_GROUPED=1`` or
+``moe_apply(..., grouped=True)``): the dense [t, E, C] dispatch/combine
+einsums — O(t·E·C·h) FLOPs and memory just to MOVE tokens — are replaced
+by a sort-based dispatch over the ragged grouped-matmul kernel
+(ops/grouped_matmul.py): argsort the token→expert assignments, gather
+into expert-sorted order, run the expert FFN as two ``gmm``s over the
+contiguous groups, scatter-add the results back weighted by the router
+gates. Two modes:
+
+- capacity mode (``capacity_factor`` a float): token-for-token identical
+  drop set to the einsum path (the same priority-dispatch ``fits`` mask;
+  dropped assignments keep their rows with combine weight 0), outputs
+  equal to fp32-accumulation tolerance. Under EP the capacity slots ride
+  the SAME two all_to_alls — the scatter/gather replaces the dispatch/
+  combine einsums and the expert FFN runs as a gmm over the received
+  slots.
+- dropless mode (``capacity_factor=None``): every assignment is honored
+  — expert FLOPs scale with the tokens actually routed, no phantom
+  capacity padding. The einsum path cannot express this (it would need
+  C = t·k); requires the grouped path and, for now, ep = 1
+  (a dropless EP exchange needs data-dependent all_to_all splits).
+
+With the gate off, ``moe_apply`` is bitwise identical to the pre-grouped
+implementation.
+
+Aux outputs: the Switch load-balance loss (E * Σ_e fraction_e * prob_e),
+the router z-loss (mean log²Z), the dropped-token fraction, and
+``expert_load`` — the per-expert fraction of the t·k routed assignments
+(sums to 1; utils/metrics.step_metrics(moe_aux=...) surfaces it for
+router-collapse monitoring without recomputing dispatch).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -61,7 +89,10 @@ class MoEConfig:
     ffn: int
     num_experts: int
     top_k: int = 2
-    capacity_factor: float = 1.25
+    capacity_factor: object = 1.25  # float, or None = dropless (grouped
+                                    # path only: no per-expert cap, no
+                                    # drops — the einsum path cannot
+                                    # express it)
     expert_axis: object = None     # mesh axis name sharding experts, or
                                    # None = all experts local (ep = 1)
     act: str = "gelu"              # "gelu" | "swiglu" (Mixtral-style
@@ -75,6 +106,8 @@ class MoEConfig:
         assert self.act in ("gelu", "swiglu"), self.act
 
     def capacity(self, tokens: int) -> int:
+        assert self.capacity_factor is not None, \
+            "dropless MoE (capacity_factor=None) has no capacity"
         c = -(-tokens * self.top_k * self.capacity_factor // self.num_experts)
         return max(int(c), 1)
 
@@ -96,14 +129,16 @@ def moe_init(key, cfg: MoEConfig):
     }
 
 
-def _dispatch_masks(logits, cfg: MoEConfig, capacity: int):
-    """Static-shape top-k capacity dispatch.
+def _route(logits, cfg: MoEConfig, capacity):
+    """Shared top-k routing (both dispatch paths).
 
-    logits [t, E] fp32. Returns (dispatch [t, E, C] bool,
-    combine [t, E, C] fp32, aux dict). Tokens take expert slots in
-    router-probability order (priority dispatch): within each expert,
-    higher-prob tokens win the capacity race — deterministic and
-    argsort-stable."""
+    logits [t, E] fp32. Returns (top_idx [t, k] int32, sel [t, k, E]
+    one-hot fp32, gate [t, k] fp32, pos [t, k] int32 capacity slot |
+    None, fits [t, k] bool, aux).
+    With a capacity, slots are taken in router-probability order
+    (priority dispatch): within each expert, higher-prob tokens win the
+    capacity race — deterministic and argsort-stable. ``capacity=None``
+    (dropless) skips the slot race entirely (fits all-True)."""
     t, e = logits.shape
     probs = jax.nn.softmax(logits, axis=-1)                    # [t, E]
     _, top_idx = lax.top_k(probs, cfg.top_k)                   # [t, k]
@@ -113,30 +148,26 @@ def _dispatch_masks(logits, cfg: MoEConfig, capacity: int):
     sel = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)        # [t, k, E]
     gate = jnp.take_along_axis(probs, top_idx, axis=-1)        # [t, k]
 
-    # priority order: sort (expert, -prob) pairs implicitly by ranking
-    # each selection within its expert by gate DESC. rank via argsort of
-    # (-gate) per expert using a stable double-argsort over the flat
-    # [t*k] selections.
-    flat_sel = sel.reshape(t * cfg.top_k, e)                   # [tk, E]
-    flat_gate = gate.reshape(t * cfg.top_k)                    # [tk]
-    order = jnp.argsort(-flat_gate)                            # high first
-    sel_sorted = flat_sel[order]
-    pos_sorted = jnp.cumsum(sel_sorted, axis=0) - sel_sorted   # slot index
-    inv = jnp.argsort(order)
-    pos = jnp.take_along_axis(
-        pos_sorted, inv[:, None], axis=0
-    )                                                          # [tk, E]
-    pos = jnp.sum(pos * flat_sel, axis=-1).reshape(t, cfg.top_k)
-    pos = pos.astype(jnp.int32)
-    fits = pos < capacity                                      # [t, k]
-
-    slot = jax.nn.one_hot(
-        jnp.where(fits, pos, capacity), capacity + 1, dtype=jnp.float32
-    )[..., :capacity]                                          # [t, k, C]
-    # dispatch[t, e, c] = 1 iff token t sits in slot c of expert e
-    dispatch = jnp.einsum("tke,tkc->tec", sel, slot)
-    combine = jnp.einsum("tke,tkc,tk->tec", sel, slot,
-                         jnp.where(fits, gate, 0.0))
+    if capacity is None:
+        pos = None
+        fits = jnp.ones((t, cfg.top_k), bool)
+    else:
+        # priority order: sort (expert, -prob) pairs implicitly by ranking
+        # each selection within its expert by gate DESC. rank via argsort
+        # of (-gate) per expert using a stable double-argsort over the
+        # flat [t*k] selections.
+        flat_sel = sel.reshape(t * cfg.top_k, e)               # [tk, E]
+        flat_gate = gate.reshape(t * cfg.top_k)                # [tk]
+        order = jnp.argsort(-flat_gate)                        # high first
+        sel_sorted = flat_sel[order]
+        pos_sorted = jnp.cumsum(sel_sorted, axis=0) - sel_sorted  # slot idx
+        inv = jnp.argsort(order)
+        pos = jnp.take_along_axis(
+            pos_sorted, inv[:, None], axis=0
+        )                                                      # [tk, E]
+        pos = jnp.sum(pos * flat_sel, axis=-1).reshape(t, cfg.top_k)
+        pos = pos.astype(jnp.int32)
+        fits = pos < capacity                                  # [t, k]
 
     # Switch aux losses (computed pre-capacity so the signal pushes the
     # router toward balance, not toward whatever fit)
@@ -145,16 +176,49 @@ def _dispatch_masks(logits, cfg: MoEConfig, capacity: int):
     aux = {
         "load_balance": e * jnp.sum(frac_tokens * frac_probs),
         "router_z": jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2),
-        "dropped_fraction": 1.0 - jnp.sum(combine > 0) / (t * cfg.top_k),
+        # router-health vector: fraction of the t*k assignments routed to
+        # each expert (sums to 1) — metrics.step_metrics(moe_aux=...)
+        "expert_load": jnp.mean(sel, axis=(0, 1)),
     }
+    return top_idx, sel, gate, pos, fits, aux
+
+
+def _dispatch_masks(logits, cfg: MoEConfig, capacity: int):
+    """Static-shape top-k capacity dispatch (the einsum path's masks).
+
+    logits [t, E] fp32. Returns (dispatch [t, E, C] bool,
+    combine [t, E, C] fp32, aux dict)."""
+    t, _ = logits.shape
+    _, sel, gate, pos, fits, aux = _route(logits, cfg, capacity)
+
+    slot = jax.nn.one_hot(
+        jnp.where(fits, pos, capacity), capacity + 1, dtype=jnp.float32
+    )[..., :capacity]                                          # [t, k, C]
+    # dispatch[t, e, c] = 1 iff token t sits in slot c of expert e
+    dispatch = jnp.einsum("tke,tkc->tec", sel, slot)
+    combine = jnp.einsum("tke,tkc,tk->tec", sel, slot,
+                         jnp.where(fits, gate, 0.0))
+    aux = dict(aux)
+    aux["dropped_fraction"] = \
+        1.0 - jnp.sum(combine > 0) / (t * cfg.top_k)
     return dispatch, combine, aux
 
 
+def _grouped_enabled() -> bool:
+    """The trace-time gate (same discipline as parallel/overlap.py)."""
+    return os.environ.get("APEX_TPU_MOE_GROUPED") == "1"
+
+
 def moe_apply(params, x, cfg: MoEConfig, *,
-              tokens_replicated_over_axis: bool = False):
+              tokens_replicated_over_axis: bool = False, grouped=None):
     """x [t, h] -> ([t, h], aux). Inside shard_map when expert_axis is
     set: params["w1"/"w2"] are the rank-LOCAL [E_local, ...] shards and
     two all_to_alls move token slots between expert owners.
+
+    ``grouped``: None (default) reads APEX_TPU_MOE_GROUPED at trace
+    time; True/False force the sort-based grouped-matmul dispatch or the
+    einsum dispatch (see module doc). Gate off = bitwise the pre-grouped
+    implementation.
 
     ``tokens_replicated_over_axis``: set True when x is the SAME tokens on
     every expert-axis rank (e.g. MoE riding a TP group without sequence
@@ -167,7 +231,19 @@ def moe_apply(params, x, cfg: MoEConfig, *,
     tokens (SP, or one shard per rank) leave it False: each expert's grad
     sums DISJOINT token slices and is already complete."""
     t, h = x.shape
-    cap = cfg.capacity(t)
+    if grouped is None:
+        grouped = _grouped_enabled()
+    if cfg.capacity_factor is None:
+        if not grouped:
+            raise ValueError(
+                "dropless MoE (capacity_factor=None) needs the grouped "
+                "dispatch: set APEX_TPU_MOE_GROUPED=1 or pass grouped=True "
+                "(the einsum path would need capacity = t * top_k)")
+        if cfg.expert_axis is not None:
+            raise NotImplementedError(
+                "dropless MoE under expert parallelism needs data-dependent "
+                "all_to_all splits; use a capacity_factor with EP, or "
+                "ep = 1 for dropless")
     w1, w2 = params["w1"], params["w2"]
     if tokens_replicated_over_axis and cfg.expert_axis is not None:
         inv_p = 1.0 / lax.axis_size(cfg.expert_axis)
@@ -175,6 +251,10 @@ def moe_apply(params, x, cfg: MoEConfig, *,
         w2 = _grad_scale(w2, inv_p)
     params = dict(params, w1=w1, w2=w2)
     logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    if grouped:
+        return _moe_grouped(params, x, logits, cfg)
+
+    cap = cfg.capacity(t)
     dispatch, combine, aux = _dispatch_masks(logits, cfg, cap)
     # dispatch is one-hot, so this gather-einsum is exact in any dtype;
     # cast to the compute dtype BEFORE the exchange (halves ICI bytes)
@@ -198,10 +278,7 @@ def moe_apply(params, x, cfg: MoEConfig, *,
     # the compute dtype at full MXU rate, fp32 MXU accumulation
     hmid = jnp.einsum("ech,ehf->ecf", xin, params["w1"],
                       preferred_element_type=jnp.float32)
-    if cfg.act == "swiglu":
-        hmid = jax.nn.silu(hmid[..., :cfg.ffn]) * hmid[..., cfg.ffn:]
-    else:
-        hmid = jax.nn.gelu(hmid)
+    hmid = _moe_act(hmid, cfg)
     out = jnp.einsum(
         "ecf,efh->ech", hmid.astype(cfg.dtype), params["w2"],
         preferred_element_type=jnp.float32)
@@ -219,8 +296,93 @@ def moe_apply(params, x, cfg: MoEConfig, *,
     return y.astype(x.dtype), aux
 
 
+def _moe_act(hmid, cfg: MoEConfig):
+    """Expert activation on the fp32 accumulator (shared by both paths;
+    hmid's leading dims are free — [e, c, f1] or [rows, f1])."""
+    if cfg.act == "swiglu":
+        return jax.nn.silu(hmid[..., :cfg.ffn]) * hmid[..., cfg.ffn:]
+    return jax.nn.gelu(hmid)
+
+
+def _moe_grouped(params, x, logits, cfg: MoEConfig):
+    """Sort-based dispatch over the ragged grouped matmul.
+
+    ep = 1: argsort the [t*k] token->expert assignments (stable, so equal
+    experts keep token order), gather tokens into expert-sorted order,
+    FFN = two gmms over the contiguous groups, scatter-add combine
+    weighted by the router gates. Dropped assignments (capacity mode)
+    keep their rows with weight 0 — identical drop sets, identical
+    per-token math to the einsum path at fp32-accumulation tolerance.
+
+    EP: the capacity slots are built by SCATTER (no [t, E, C] one-hot
+    einsum), ride the same two all_to_alls as the einsum path, the local
+    expert FFN runs as a gmm over the received slot rows (uniform groups
+    of p*C), and the combine is a gather + weighted sum."""
+    from apex_tpu.ops.grouped_matmul import gmm
+
+    t, h = x.shape
+    k, e = cfg.top_k, cfg.num_experts
+    dropless = cfg.capacity_factor is None
+    cap = None if dropless else cfg.capacity(t)
+    top_idx, sel, gate, pos, fits, aux = _route(logits, cfg, cap)
+    w_flat = jnp.where(fits, gate, 0.0).reshape(t * k)         # fp32
+    aux = dict(aux)
+    # dropless honors every assignment by construction — pin the exact 0
+    # rather than letting XLA's reassociated 1 - n/n wobble around it
+    aux["dropped_fraction"] = jnp.float32(0.0) if dropless else \
+        1.0 - jnp.sum(w_flat > 0) / (t * k)
+    e_flat = top_idx.reshape(t * k).astype(jnp.int32)
+
+    if cfg.expert_axis is not None:
+        p = lax.axis_size(cfg.expert_axis)
+        assert cfg.num_experts % p == 0, (
+            f"num_experts={cfg.num_experts} not divisible by "
+            f"|{cfg.expert_axis}|={p}")
+        e_local = cfg.num_experts // p
+        # dispatch: scatter each fitting assignment into its (expert,
+        # capacity-slot) row — the relayout the dispatch einsum used to
+        # pay O(t*E*C*h) for; collisions are impossible (distinct experts
+        # per token, distinct slots per expert)
+        slot = e_flat * cap + pos.reshape(t * k)               # [tk]
+        slot = jnp.where(fits.reshape(t * k), slot, e * cap)   # OOB = drop
+        x_rep = jnp.repeat(x.astype(cfg.dtype), k, axis=0)     # [tk, h]
+        xin = jnp.zeros((e * cap, h), cfg.dtype).at[slot].set(
+            x_rep, mode="drop")
+        xin = xin.reshape(p, e_local, cap, h)
+        xin = lax.all_to_all(xin, cfg.expert_axis, split_axis=0,
+                             concat_axis=0, tiled=False)       # [p, eL, C, h]
+        rows = xin.transpose(1, 0, 2, 3).reshape(e_local * p * cap, h)
+        sizes = jnp.full((e_local,), p * cap, jnp.int32)
+        hmid = gmm(rows, params["w1"], sizes, out_dtype=jnp.float32)
+        hmid = _moe_act(hmid, cfg)
+        out = gmm(hmid.astype(cfg.dtype), params["w2"], sizes,
+                  out_dtype=jnp.float32).astype(cfg.dtype)
+        out = out.reshape(e_local, p, cap, h).transpose(1, 0, 2, 3)
+        out = lax.all_to_all(out, cfg.expert_axis, split_axis=0,
+                             concat_axis=0, tiled=False)
+        out = out.reshape(e * cap, h)
+        # combine: gather each assignment's slot row, weight by its gate
+        taken = out[jnp.clip(slot, 0, e * cap - 1)].astype(jnp.float32)
+        y = jnp.sum((taken * w_flat[:, None]).reshape(t, k, h), axis=1)
+        return y.astype(x.dtype), aux
+
+    # ep = 1: expert-sorted ragged groups, no capacity padding at all
+    order = jnp.argsort(e_flat, stable=True)                   # [tk]
+    tok = order // k                                           # source token
+    xs = jnp.take(x.astype(cfg.dtype), tok, axis=0)            # [tk, h]
+    group_sizes = jnp.bincount(e_flat, length=e).astype(jnp.int32)
+    hmid = gmm(xs, params["w1"], group_sizes, out_dtype=jnp.float32)
+    hmid = _moe_act(hmid, cfg)
+    ys = gmm(hmid.astype(cfg.dtype), params["w2"], group_sizes,
+             out_dtype=jnp.float32).astype(cfg.dtype)
+    w_sorted = w_flat[order]
+    y = jnp.zeros((t, h), jnp.float32).at[tok].add(
+        ys.astype(jnp.float32) * w_sorted[:, None])
+    return y.astype(x.dtype), aux
+
+
 def moe_reference(params, x, cfg: MoEConfig):
     """ep=1 oracle: identical math with all experts local (used by tests
-    to pin the all_to_all exchange)."""
+    to pin the all_to_all exchange). Always the einsum path."""
     cfg1 = dataclasses.replace(cfg, expert_axis=None)
-    return moe_apply(params, x, cfg1)
+    return moe_apply(params, x, cfg1, grouped=False)

@@ -36,6 +36,7 @@ from apex_tpu.tuning.shape_class import (
     dtype_token,
     flash_key,
     ln_key,
+    moe_key,
     optim_key,
     paged_key,
     softmax_key,
@@ -44,10 +45,10 @@ from apex_tpu.tuning.shape_class import (
 __all__ = [
     "TuneDB", "active_db", "cache_path", "invalidate", "lookup", "pinned",
     "snapshot_dir", "tuning_enabled", "class_key", "device_kind",
-    "dtype_token", "flash_key", "ln_key", "optim_key", "paged_key",
-    "softmax_key", "flash_config", "ln_block_rows", "optim_block_rows",
-    "paged_decode_config", "softmax_row_chunk", "cost_model", "registry",
-    "shape_class",
+    "dtype_token", "flash_key", "ln_key", "moe_key", "optim_key",
+    "paged_key", "softmax_key", "flash_config", "ln_block_rows",
+    "moe_grouped_config", "optim_block_rows", "paged_decode_config",
+    "softmax_row_chunk", "cost_model", "registry", "shape_class",
 ]
 
 
@@ -170,6 +171,33 @@ def paged_decode_config(n_slots: int, max_blocks: int, block_size: int,
                 cfg["kv_fetch"] = f
         except (TypeError, ValueError):
             pass
+        if entry.get("backend") in ("pallas", "jnp"):
+            cfg["backend"] = entry["backend"]
+    return cfg
+
+
+def moe_grouped_config(t: int, e: int, h: int, f: int, dtype) -> dict:
+    """Resolved grouped-matmul config for one shape class:
+    ``{"tile_t", "tile_f", "backend"}``. Cache entry wins field-wise
+    where present (clamped to legal values); the cost model fills the
+    rest. Env overrides (APEX_TPU_MOE_TILE_T / APEX_TPU_MOE_TILE_F) are
+    applied by ops/grouped_matmul.py BEFORE consulting this — the
+    standard env > cache > model order."""
+    b = {"bf16": 2, "f16": 2}.get(dtype_token(dtype), 4)
+    tt_d = cost_model.moe_tile_t_default(h, f, b, device=device_kind())
+    tf_d = cost_model.moe_tile_f_default(f)
+    cfg = {
+        "tile_t": tt_d,
+        "tile_f": tf_d,
+        "backend": cost_model.moe_backend_default(t, e, h, f,
+                                                  device=device_kind()),
+    }
+    entry = lookup(moe_key(t, e, h, f, dtype))
+    if entry:
+        cfg["tile_t"] = _clamp_rows(entry.get("tile_t"), tt_d, quantum=8,
+                                    lo=8, hi=4096)
+        cfg["tile_f"] = _clamp_rows(entry.get("tile_f"), tf_d, quantum=128,
+                                    lo=128, hi=4096)
         if entry.get("backend") in ("pallas", "jnp"):
             cfg["backend"] = entry["backend"]
     return cfg

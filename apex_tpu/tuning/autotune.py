@@ -46,6 +46,8 @@ _SWEEP_ENV = (
     "APEX_TPU_FLASH_BLOCK_BWD",
     "APEX_TPU_FLASH_STREAM",
     "APEX_TPU_LN_BLOCK_ROWS",
+    "APEX_TPU_MOE_TILE_T",
+    "APEX_TPU_MOE_TILE_F",
     "APEX_TPU_OPTIM_BLOCK_ROWS",
     "APEX_TPU_SOFTMAX_CHUNK",
     "APEX_TPU_USE_PALLAS",
@@ -494,6 +496,105 @@ def sweep_paged(db: cache.TuneDB, *, hardware: bool, reps: int,
             + (f" ({best[2]:.3f} ms)" if hardware else " (verified)"))
 
 
+def sweep_moe(db: cache.TuneDB, *, hardware: bool, reps: int,
+              log=print) -> None:
+    """(tile_t, tile_f) sweep for the ragged grouped matmul
+    (ops/grouped_matmul.py, registry family ``moe_grouped``).
+
+    Hardware sessions time a full gmm f+b step per (rows, E, h, f) class
+    — median of ``reps`` value_and_grad calls per candidate, winner
+    recorded with milliseconds. Interpret sessions VERIFY each candidate
+    against the segment oracle (fwd + both grads, skewed ragged groups)
+    and record the cost-model default (projections lack the resolution
+    to overturn the measured rule — same policy as the flash sweep)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.ops.grouped_matmul import gmm, gmm_ref
+
+    space = registry.TUNABLES["moe_grouped"].params
+    ladder = (
+        # (rows = tokens * top_k, E, hidden, ffn)
+        (4096, 8, 1024, 4096),     # GPT-medium-class MoE FFN
+        (16384, 8, 1024, 4096),    # the long-batch class
+    ) if hardware else ((96, 4, 64, 128),)
+    for t, e, h, f in ladder:
+        keys = jax.random.split(jax.random.PRNGKey(t + e), 4)
+        lhs = jax.random.normal(keys[0], (t, h), jnp.bfloat16)
+        rhs = jax.random.normal(keys[1], (e, h, f), jnp.bfloat16)
+        do = jax.random.normal(keys[2], (t, f), jnp.bfloat16)
+        # skewed ragged split (one heavy group, one empty) + remainder
+        heavy = t // 2
+        rest = (t - heavy) // max(e - 2, 1)
+        sizes = [heavy, 0] + [rest] * (e - 2)
+        sizes[-1] += t - sum(sizes)
+        group_sizes = jnp.array(sizes, jnp.int32)
+
+        def loss(lhs, rhs, use):
+            y = gmm(lhs, rhs, group_sizes, use_pallas=use)
+            return jnp.vdot(y.astype(jnp.float32), do.astype(jnp.float32))
+
+        gr = None
+        if not hardware:  # candidate-independent oracle grads, once
+            gr = jax.grad(
+                lambda lhs, rhs: jnp.vdot(
+                    gmm_ref(lhs, rhs, group_sizes).astype(jnp.float32),
+                    do.astype(jnp.float32)),
+                argnums=(0, 1))(lhs, rhs)
+        best = None
+        src = "hardware" if hardware else "interpret+cost_model"
+        for tt in space["tile_t"]:
+            for tf in space["tile_f"]:
+                db_c = cache.TuneDB()
+                db_c.record(shape_class.moe_key(t, e, h, f, jnp.bfloat16),
+                            {"tile_t": tt, "tile_f": tf},
+                            source="sweep-candidate")
+                try:
+                    with _sweep_env(), cache.pinned(db_c):
+                        g = jax.jit(jax.grad(
+                            lambda lhs, rhs: loss(lhs, rhs, True),
+                            argnums=(0, 1)))
+                        gp = g(lhs, rhs)
+                        jax.block_until_ready(gp)
+                        if hardware:
+                            times = []
+                            for _ in range(max(1, reps)):
+                                t0 = time.perf_counter()
+                                jax.block_until_ready(g(lhs, rhs))
+                                times.append(time.perf_counter() - t0)
+                            times.sort()
+                            score = times[len(times) // 2] * 1e3
+                        else:
+                            for a, c in zip(gp, gr):
+                                assert _maxdiff(a, c) < 0.1, \
+                                    f"grad mismatch {_maxdiff(a, c)}"
+                            # interpret runs prove correctness, not speed:
+                            # rank by distance from the measured defaults
+                            score = (abs(tt - cost_model.moe_tile_t_default(
+                                h, f, device=shape_class.device_kind()))
+                                + abs(tf - cost_model.moe_tile_f_default(f)))
+                except Exception as err:  # noqa: BLE001 — failing candidate
+                    log(f"autotune: moe_grouped t={t} tile_t={tt} "
+                        f"tile_f={tf}: REJECTED ({type(err).__name__}: "
+                        f"{str(err).splitlines()[0][:120]})")
+                    continue
+                if best is None or score < best[2]:
+                    best = (tt, tf, score)
+        if best is None:
+            log(f"autotune: moe_grouped t={t}: no viable candidate; class "
+                f"keeps its cost-model default")
+            continue
+        entry = {"tile_t": best[0], "tile_f": best[1]}
+        registry.validate_entry("moe_grouped", entry)
+        db.record(shape_class.moe_key(t, e, h, f, jnp.bfloat16), entry,
+                  source=src, ms=best[2] if hardware else None,
+                  note=f"swept {len(space['tile_t'])}x"
+                       f"{len(space['tile_f'])} candidates")
+        log(f"autotune: moe_grouped t={t} e={e} h={h} f={f} -> "
+            f"tile_t={best[0]} tile_f={best[1]}"
+            + (f" ({best[2]:.3f} ms)" if hardware else " (verified)"))
+
+
 # ------------------------------------------------------------------
 # BASELINE.md projection table
 # ------------------------------------------------------------------
@@ -639,7 +740,7 @@ def run(*, out: Optional[str] = None, interpret: bool = False,
 def _run_inner(*, out, kernels, seqs, hiddens, dtype, reps, quick,
                hardware, log) -> "cache.TuneDB":
     kernels = kernels or ["flash", "layer_norm", "rms_norm", "optim_flat",
-                          "overlap_tp", "paged_decode"]
+                          "overlap_tp", "paged_decode", "moe_grouped"]
     seqs = seqs or ([256] if quick else [256, 512])
     hiddens = hiddens or ([256] if quick else [256, 1024])
     out_path = Path(out) if out else cache.cache_path()
@@ -662,6 +763,8 @@ def _run_inner(*, out, kernels, seqs, hiddens, dtype, reps, quick,
         sweep_overlap(db, hardware=hardware, reps=reps, log=log)
     if "paged_decode" in kernels:
         sweep_paged(db, hardware=hardware, reps=reps, log=log)
+    if "moe_grouped" in kernels:
+        sweep_moe(db, hardware=hardware, reps=reps, log=log)
     path = db.save(out_path)
     cache.invalidate()  # the freshly-written file is live immediately
     log(f"autotune: wrote {len(db.entries)} entries to {path}")
@@ -680,9 +783,9 @@ def main(argv: Optional[list] = None) -> int:
                     help=f"output tunedb path (default {cache.cache_path()})")
     ap.add_argument("--kernels",
                     default="flash,layer_norm,rms_norm,optim_flat,"
-                            "overlap_tp,paged_decode",
+                            "overlap_tp,paged_decode,moe_grouped",
                     help="comma list: flash,layer_norm,rms_norm,"
-                         "optim_flat,overlap_tp,paged_decode")
+                         "optim_flat,overlap_tp,paged_decode,moe_grouped")
     ap.add_argument("--seqs", default=None,
                     help="flash seq classes to sweep, comma list")
     ap.add_argument("--hiddens", default=None,

@@ -309,6 +309,53 @@ def paged_kv_fetch_default(block_size: int, d: int,
 
 
 # ------------------------------------------------------------------
+# ragged grouped matmul (ops/grouped_matmul.py)
+# ------------------------------------------------------------------
+
+# Oracle-fallback threshold: below this many routed rows the grouped
+# kernel's grid overhead (t_pad/tile_t + E work steps, each a masked
+# partial matmul) exceeds what the dense one-hot segment einsum costs,
+# so auto mode routes the class to the jnp oracle. A pinned cache entry
+# ({"backend": ...}) overrides per class; APEX_TPU_USE_PALLAS=1 beats
+# both (env > cache > model, as everywhere).
+MOE_FALLBACK_ROWS = 256
+
+
+def moe_tile_t_default(h: int, f: int, dtype_bytes: int = 2,
+                       device: str = "cpu") -> int:
+    """Rows per work tile. 512 (the MXU-occupancy sweet spot measured for
+    the flash q tiles) shrunk by powers of two while the per-step
+    resident tiles — lhs [tile_t, h] + rhs [h, tile_f] + out
+    [tile_t, tile_f] double-buffered, plus the fp32 accumulator — push
+    past 75% of scoped VMEM (wide-expert shapes: h=8192 bf16 drops to
+    128). Anything finer is autotune's to prove."""
+    _, _, vmem = device_spec(device)
+    tf = moe_tile_f_default(f)
+    tm = 512
+    while tm > 128 and (
+        2 * (tm * h + h * tf + tm * tf) * dtype_bytes + tm * tf * 4
+    ) > 0.75 * vmem:
+        tm //= 2
+    return tm
+
+
+def moe_tile_f_default(f: int) -> int:
+    """Output columns per grid step: 256 (two MXU lanes' worth — enough
+    reuse of the resident lhs tile without blowing the rhs block up),
+    clamped to the padded output width for narrow experts."""
+    return min(256, _ceil128(f))
+
+
+def moe_backend_default(t: int, e: int, h: int, f: int,
+                        device: str = "cpu") -> str:
+    """"pallas" or "jnp" — the documented oracle-fallback rule: tiny
+    routed-row counts can't amortize the ragged grid (MOE_FALLBACK_ROWS),
+    so the dense segment oracle wins there."""
+    del e, h, f, device  # row count dominates; the rest is autotune's
+    return "jnp" if t < MOE_FALLBACK_ROWS else "pallas"
+
+
+# ------------------------------------------------------------------
 # softmax tiling
 # ------------------------------------------------------------------
 

@@ -53,6 +53,19 @@ def _rows_check(params: dict, features: dict) -> Optional[str]:
     return _mult("block_rows", 8)(params, features)
 
 
+def _moe_check(params: dict, features: dict) -> Optional[str]:
+    err = _mult("tile_t", 8)(params, features)
+    if err:
+        return err
+    err = _mult("tile_f", 128)(params, features)
+    if err:
+        return err
+    backend = params.get("backend", "pallas")
+    if backend not in ("pallas", "jnp"):
+        return f"backend={backend!r} not in ('pallas', 'jnp')"
+    return None
+
+
 def _softmax_check(params: dict, _features: dict) -> Optional[str]:
     c = params.get("row_chunk", 0)
     if c < 0:
@@ -155,6 +168,27 @@ TUNABLES: Dict[str, Tunable] = {
                           "paged_kv_fetch_default",
             env={"block_rows": "APEX_TPU_PAGED_BLOCK_ROWS",
                  "kv_fetch": "APEX_TPU_PAGED_KV_FETCH",
+                 "backend": "APEX_TPU_USE_PALLAS"},
+        ),
+        Tunable(
+            kernel="moe_grouped",
+            params={
+                "tile_t": [128, 256, 512],
+                "tile_f": [128, 256, 512],
+                "backend": ["pallas", "jnp"],
+            },
+            check=_moe_check,
+            doc="Ragged grouped matmul (ops/grouped_matmul.py, the "
+                "dropless-MoE expert FFN): tile_t = rows per work tile "
+                "(sublane multiple of 8), tile_f = output columns per grid "
+                "step (lane multiple of 128). The cost model also owns the "
+                "oracle-fallback row threshold behind the backend default "
+                "(cost_model.MOE_FALLBACK_ROWS). Class carries routed rows, "
+                "expert count, hidden, ffn and dtype.",
+            defaults_from="cost_model.moe_tile_t_default / "
+                          "moe_tile_f_default / moe_backend_default",
+            env={"tile_t": "APEX_TPU_MOE_TILE_T",
+                 "tile_f": "APEX_TPU_MOE_TILE_F",
                  "backend": "APEX_TPU_USE_PALLAS"},
         ),
         Tunable(

@@ -187,6 +187,25 @@ def paged_key(n_slots: int, max_blocks: int, block_size: int, group: int,
     )
 
 
+def moe_features(t: int, e: int, h: int, f: int, dtype) -> dict:
+    """Ragged grouped matmul (ops/grouped_matmul.py): the optimum moves
+    with the routed row count (t = tokens x top_k — seq bucket, so one
+    tuned entry covers a batch-size neighborhood), the expert count (work
+    items per grid, rhs block count), hidden and ffn widths (the resident
+    lhs/rhs tile footprint) and the payload dtype."""
+    return {
+        "t": seq_bucket(t),
+        "e": int(e),
+        "h": hidden_bucket(h),
+        "f": hidden_bucket(f),
+        "dt": dtype_token(dtype),
+    }
+
+
+def moe_key(t: int, e: int, h: int, f: int, dtype, device=None) -> str:
+    return class_key("moe_grouped", moe_features(t, e, h, f, dtype), device)
+
+
 def softmax_features(rows: int, cols: int, dtype) -> dict:
     return {
         "rows": seq_bucket(rows),

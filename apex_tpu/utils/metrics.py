@@ -50,14 +50,24 @@ def step_metrics(
     found_inf=None,
     counters: Optional[StepCounters] = None,
     opt_state=None,
+    moe_aux=None,
 ) -> dict:
     """Build the per-step scalar dict (loss, grad_norm, loss_scale,
-    found_inf, overflow/step counts). Every value is a device scalar;
-    jit-safe. Pass only what you have — absent inputs are omitted.
+    found_inf, overflow/step counts, MoE router health). Every value is
+    a device array; jit-safe. Pass only what you have — absent inputs
+    are omitted.
 
     ``opt_state``: an ``amp.AmpOptState`` — reads its scaler scale and
     ``skipped_steps`` overflow count (single source of truth for amp
-    loops; don't also pass ``counters``)."""
+    loops; don't also pass ``counters``).
+
+    ``moe_aux``: the aux dict ``transformer.moe.moe_apply`` returns (or
+    a list of them, one per MoE layer — averaged). Surfaces the router
+    health the dispatch already computed — ``moe_dropped_fraction``
+    (scalar) and ``moe_expert_load`` (per-expert [E] assignment-fraction
+    vector; a collapsing router shows one entry racing to 1) — so
+    training loops can log router collapse without recomputing
+    dispatch."""
     out = {}
     if loss is not None:
         out["loss"] = jnp.asarray(loss, jnp.float32)
@@ -79,4 +89,13 @@ def step_metrics(
             for i, sc in enumerate(opt_state.scaler):
                 out[f"loss_scale{i}"] = sc.scale
         out["overflow_count"] = opt_state.skipped_steps
+    if moe_aux is not None:
+        auxes = moe_aux if isinstance(moe_aux, (list, tuple)) else [moe_aux]
+        for key in ("dropped_fraction", "expert_load"):
+            vals = [jnp.asarray(a[key], jnp.float32)
+                    for a in auxes if key in a]
+            # layers must agree on shape to average (mixed expert counts
+            # can't share one load vector — log those per layer instead)
+            if vals and all(v.shape == vals[0].shape for v in vals):
+                out[f"moe_{key}"] = sum(vals) / len(vals)
     return out

@@ -34,14 +34,20 @@ _METRIC = "bert_large_amp_o2_fused_lamb_samples_per_sec_per_chip"
 # (apex_tpu.serving continuous batching: decode steps/s + time-to-first-
 # token at a fixed request mix) instead of the training sweep; the serving
 # prefill/decode programs are ALSO dry-compiled by --compile-only as their
-# own rung. Each mode emits one JSON line under its own metric name so it
-# can never masquerade as a samples/sec measurement.
+# own rung. --moe: the MoE dispatch A/B rung — tokens/s of a full f+b
+# step over transformer.moe at a fixed (t, E, top_k, h, f) point, einsum
+# dispatch vs the sort-based grouped-matmul path (capacity parity mode
+# AND dropless), also dry-compiled by --compile-only as its own rung.
+# Each mode emits one JSON line under its own metric name so it can
+# never masquerade as a samples/sec measurement.
 _COMPILE_ONLY = "--compile-only" in sys.argv[1:]
 _AUTOTUNE = "--autotune" in sys.argv[1:]
 _SERVING = "--serving" in sys.argv[1:]
+_MOE = "--moe" in sys.argv[1:]
 _COMPILE_METRIC = "bert_large_compile_gate_rungs_ok"
 _AUTOTUNE_METRIC = "apex_tpu_autotune_entries_written"
 _SERVING_METRIC = "apex_tpu_serving_decode_steps_per_sec"
+_MOE_METRIC = "apex_tpu_moe_tokens_per_sec"
 
 
 def emit(payload: dict) -> None:
@@ -449,6 +455,115 @@ def _serving_compile_rung(on_cpu: bool, timeout_s: float) -> dict:
     return rung
 
 
+def _moe_setup(on_cpu: bool):
+    """Model + fixed sweep point for the MoE dispatch A/B rung. One
+    definition shared by the timed run (--moe) and the dry-compile gate.
+
+    The point is FIXED (t, E, top_k, h, f) so tokens/s is comparable
+    across rounds: CPU debug runs a toy; hardware runs a GPT-medium-class
+    MoE FFN where the einsum path's [t, E, C] dispatch tensor is the
+    dominant phantom cost."""
+    import dataclasses
+
+    import jax.numpy as jnp  # noqa: F811 — bench defers jax-heavy imports
+
+    from apex_tpu.transformer.moe import MoEConfig, moe_init
+
+    t, e, k, h, f = (512, 8, 2, 128, 256) if on_cpu else \
+        (8192, 8, 2, 1024, 4096)
+    cfg = MoEConfig(hidden=h, ffn=f, num_experts=e, top_k=k,
+                    capacity_factor=1.25, dtype=jnp.bfloat16)
+    params = moe_init(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (t, h), jnp.bfloat16)
+    dropless = dataclasses.replace(cfg, capacity_factor=None)
+    return cfg, dropless, params, x
+
+
+def _moe_steps(cfg, dropless, params, x):
+    """The three jitted f+b steps: einsum dispatch, grouped capacity
+    (identical drop set), grouped dropless (no phantom capacity FLOPs)."""
+    import jax.numpy as jnp  # noqa: F811
+
+    from apex_tpu.transformer.moe import moe_apply
+
+    def mk(c, grouped):
+        def loss(p, x):
+            y, aux = moe_apply(p, x, c, grouped=grouped)
+            return (jnp.sum(y.astype(jnp.float32) ** 2)
+                    + 0.01 * aux["load_balance"])
+        return jax.jit(jax.grad(loss))
+    return (("einsum", mk(cfg, False)), ("grouped", mk(cfg, True)),
+            ("dropless", mk(dropless, True)))
+
+
+def _moe_payload(on_cpu: bool) -> dict:
+    cfg, dropless, params, x = _moe_setup(on_cpu)
+    t = x.shape[0]
+    iters = 3 if on_cpu else 20
+    rows = {}
+    for name, step in _moe_steps(cfg, dropless, params, x):
+        g = step(params, x)                 # compile + warmup
+        jax.block_until_ready(jax.tree.leaves(g)[0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            g = step(params, x)
+        jax.block_until_ready(jax.tree.leaves(g)[0])
+        dt = (time.perf_counter() - t0) / iters
+        rows[name] = {"tokens_per_sec": round(t / dt, 1),
+                      "step_ms": round(dt * 1e3, 3)}
+    speedup = rows["dropless"]["tokens_per_sec"] / max(
+        rows["einsum"]["tokens_per_sec"], 1e-9)
+    return {
+        "metric": _MOE_METRIC,
+        "value": rows["dropless"]["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,
+        "ok": all(r["tokens_per_sec"] > 0 for r in rows.values()),
+        "moe": True,
+        "detail": {
+            "paths": rows,
+            "dropless_vs_einsum": round(speedup, 3),
+            "config": {
+                "tokens": t, "experts": cfg.num_experts,
+                "top_k": cfg.top_k, "hidden": cfg.hidden, "ffn": cfg.ffn,
+                "capacity_factor": cfg.capacity_factor,
+            },
+        },
+    }
+
+
+def _moe_compile_rungs(on_cpu: bool, timeout_s: float) -> list:
+    """Dry-compile the MoE dispatch steps as one gate rung PER PATH
+    (einsum / grouped / dropless — a per-rung verdict line for each, so
+    a compile regression names the dispatch path that broke it)."""
+    try:
+        cfg, dropless, params, x = _moe_setup(on_cpu)
+        steps = _moe_steps(cfg, dropless, params, x)
+    except Exception as e:  # noqa: BLE001 — setup failure fails the set
+        print(f"bench: compile-only rung moe: FAILED — marked skipped "
+              f"({type(e).__name__}: {str(e).splitlines()[0][:200]})",
+              file=sys.stderr, flush=True)
+        return [{"rung": "moe", "batch": None, "remat": "moe", "ok": False,
+                 "skipped": True, "error": str(e).splitlines()[0][:200]}]
+    rungs = []
+    for name, step in steps:
+        rung = {"rung": f"moe/{name}", "batch": None, "remat": f"moe_{name}"}
+        compile_s, err = _compile_with_timeout(step, (params, x), timeout_s)
+        if err is not None:
+            msg = ("compile hung" if err == "hung"
+                   else f"{type(err).__name__}: "
+                        f"{str(err).splitlines()[0][:200]}")
+            print(f"bench: compile-only rung moe/{name}: FAILED — marked "
+                  f"skipped ({msg})", file=sys.stderr, flush=True)
+            rung.update(ok=False, skipped=True, error=msg)
+        else:
+            print(f"bench: compile-only rung moe/{name}: OK "
+                  f"({compile_s:.1f}s)", file=sys.stderr, flush=True)
+            rung.update(ok=True, compile_s=round(compile_s, 1))
+        rungs.append(rung)
+    return rungs
+
+
 def main():
     from jax.sharding import Mesh, PartitionSpec as P
 
@@ -496,11 +611,22 @@ def main():
         })
         return
 
-    if _SERVING:
+    if _SERVING and not _COMPILE_ONLY:
         # serving rung: continuous-batching decode steps/s + TTFT at the
         # fixed request mix (apex_tpu.serving); its own metric name so it
-        # can never masquerade as a training samples/sec measurement
+        # can never masquerade as a training samples/sec measurement.
+        # `--serving --compile-only` falls through to the dry-compile
+        # gate below (which carries the serving rung) — never a timed rep
         emit(_serving_payload(on_cpu))
+        return
+
+    if _MOE and not _COMPILE_ONLY:
+        # MoE dispatch A/B rung: tokens/s of the einsum dispatch vs the
+        # sort-based grouped-matmul path (capacity parity + dropless) at
+        # the fixed sweep point; its own metric name, same discipline.
+        # `--moe --compile-only` falls through to the dry-compile gate
+        # below (which carries the per-path moe rungs) — never a timed rep
+        emit(_moe_payload(on_cpu))
         return
 
     if on_cpu:
@@ -813,13 +939,12 @@ def main():
     _apply_rung_env(())  # drop the last rung's lever overrides
 
     if _COMPILE_ONLY:
-        # the serving prefill/decode programs ride the gate as their own
-        # rung, so a serving compile regression costs seconds, not the
-        # measurement window (ISSUE-3 satellite)
-        compile_rungs.append(_serving_compile_rung(
-            on_cpu,
-            timeout_s=float(os.environ.get("BENCH_BATCH_TIMEOUT_S", "900")),
-        ))
+        # the serving prefill/decode programs and the MoE dispatch steps
+        # ride the gate as their own rungs, so a compile regression in
+        # either costs seconds, not the measurement window
+        gate_timeout = float(os.environ.get("BENCH_BATCH_TIMEOUT_S", "900"))
+        compile_rungs.append(_serving_compile_rung(on_cpu, gate_timeout))
+        compile_rungs.extend(_moe_compile_rungs(on_cpu, gate_timeout))
         emit(_compile_only_payload(compile_rungs, kernel_report))
         return
 

@@ -74,6 +74,13 @@ run overlap_ab    5400 '"ok": true' env \
 #      16-request mix (GPT-medium-class geometry, metric
 #      apex_tpu_serving_decode_steps_per_sec).
 run serving_bench 3600 '"ok": true' python bench.py --serving
+# 4d — MoE dispatch A/B rung (dropless-MoE PR): tokens/s of the einsum
+#      [t,E,C] dispatch vs the sort-based grouped-matmul path (capacity
+#      parity mode AND dropless) at the fixed GPT-medium-class sweep
+#      point (t=8192, E=8, top_k=2, h=1024, f=4096), metric
+#      apex_tpu_moe_tokens_per_sec. The three jitted steps already ride
+#      the compile-only gate above as their own "moe" rung.
+run moe_bench     3600 '"ok": true' python bench.py --moe
 # 5 — the WHOLE tpu tier in one invocation (19/19 + 5/5 goal)
 run tpu_full      3600 ' passed' env APEX_TPU_HW=1 python -m pytest tests/tpu -v
 # 6 — warm the driver's exact path last

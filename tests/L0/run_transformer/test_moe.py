@@ -8,9 +8,12 @@ the expert FFN touches slots independently, so the exchange must be a
 pure relayout.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.parallel.mesh import cpu_mesh
@@ -147,6 +150,157 @@ def test_moe_trains_and_balances():
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
     assert np.isfinite(np.asarray(jax.tree.leaves(params)[0])).all()
+
+
+# ---------------------------------------------------------------------------
+# grouped (sort-based) dispatch vs the einsum path
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("act", ["gelu", "swiglu"])
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_grouped_capacity_parity(act, top_k):
+    """APEX_TPU_MOE_GROUPED in capacity mode: outputs AND grads match the
+    einsum dispatch to fp32-accumulation tolerance with token-for-token
+    identical drop sets (the same priority-dispatch fits mask)."""
+    cfg = MoEConfig(hidden=H, ffn=F, num_experts=E, top_k=top_k,
+                    capacity_factor=0.75, act=act)  # tight: force drops
+    params = moe_init(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (96, H))
+
+    def loss(p, grouped):
+        y, aux = moe_apply(p, x, cfg, grouped=grouped)
+        return jnp.sum(y ** 2), aux
+
+    (le, auxe), ge = jax.value_and_grad(lambda p: loss(p, False),
+                                        has_aux=True)(params)
+    (lg, auxg), gg = jax.value_and_grad(lambda p: loss(p, True),
+                                        has_aux=True)(params)
+    # identical drop sets -> bitwise-equal dropped fraction
+    assert float(auxe["dropped_fraction"]) == \
+        float(auxg["dropped_fraction"]) > 0.0
+    np.testing.assert_allclose(float(lg), float(le), rtol=1e-5)
+    for name in ("router", "w1", "w2"):
+        np.testing.assert_allclose(np.asarray(gg[name]),
+                                   np.asarray(ge[name]),
+                                   rtol=1e-4, atol=1e-6, err_msg=name)
+    np.testing.assert_array_equal(np.asarray(auxe["expert_load"]),
+                                  np.asarray(auxg["expert_load"]))
+
+
+def test_grouped_env_gate(monkeypatch):
+    """The env gate routes moe_apply at trace time; with it unset the
+    layer is BITWISE the einsum path (the acceptance invariant)."""
+    cfg = MoEConfig(hidden=H, ffn=F, num_experts=E, top_k=2)
+    params = moe_init(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (48, H))
+    monkeypatch.delenv("APEX_TPU_MOE_GROUPED", raising=False)
+    y_def, _ = moe_apply(params, x, cfg)
+    y_ein, _ = moe_apply(params, x, cfg, grouped=False)
+    np.testing.assert_array_equal(np.asarray(y_def), np.asarray(y_ein))
+    monkeypatch.setenv("APEX_TPU_MOE_GROUPED", "1")
+    y_env, _ = moe_apply(params, x, cfg)
+    y_grp, _ = moe_apply(params, x, cfg, grouped=True)
+    np.testing.assert_array_equal(np.asarray(y_env), np.asarray(y_grp))
+    np.testing.assert_allclose(np.asarray(y_env), np.asarray(y_ein),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_grouped_dropless_honors_every_assignment():
+    """capacity_factor=None: no drops at all — equals the einsum path run
+    at a capacity no token can overflow, and the einsum path itself
+    cannot express it (raises without the grouped dispatch)."""
+    cfg = MoEConfig(hidden=H, ffn=F, num_experts=4, top_k=2,
+                    capacity_factor=None)
+    params = moe_init(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, H))
+    y, aux = jax.jit(lambda p, x: moe_apply(p, x, cfg, grouped=True))(
+        params, x)
+    assert float(aux["dropped_fraction"]) == 0.0
+    cfg_big = dataclasses.replace(cfg, capacity_factor=4.0)
+    y_ref, aux_ref = moe_apply(params, x, cfg_big, grouped=False)
+    assert float(aux_ref["dropped_fraction"]) == 0.0
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-6)
+    # grads flow through every assignment too
+    gd = jax.grad(lambda p: jnp.sum(
+        moe_apply(p, x, cfg, grouped=True)[0] ** 2))(params)
+    gb = jax.grad(lambda p: jnp.sum(
+        moe_apply(p, x, cfg_big, grouped=False)[0] ** 2))(params)
+    for name in ("router", "w1", "w2"):
+        np.testing.assert_allclose(np.asarray(gd[name]),
+                                   np.asarray(gb[name]),
+                                   rtol=1e-4, atol=1e-6, err_msg=name)
+    with pytest.raises(ValueError, match="dropless"):
+        moe_apply(params, x, cfg, grouped=False)
+    with pytest.raises(NotImplementedError, match="expert parallelism"):
+        moe_apply(params, x,
+                  dataclasses.replace(cfg, expert_axis="expert"),
+                  grouped=True)
+
+
+def test_grouped_expert_parallel_matches_einsum():
+    """EP grouped (capacity slots by scatter, gmm FFN over the received
+    rows, gather combine) vs the einsum EP path on the same shard_map
+    mesh: loss and all grads, including the replicated router's psum."""
+    cfg, params, x = _setup()
+    mesh = cpu_mesh({"expert": EP})
+
+    def run(grouped):
+        def body(params, x):
+            loss, g = jax.value_and_grad(lambda p: jnp.sum(
+                moe_apply(p, x, cfg, grouped=grouped)[0] ** 2))(params)
+            g["router"] = jax.lax.psum(g["router"], "expert")
+            return jax.lax.psum(loss, "expert"), g
+        return jax.jit(smap(body, mesh, (PSPEC, P("expert")),
+                            (P(), PSPEC)))(params, x)
+
+    loss_e, g_e = run(False)
+    loss_g, g_g = run(True)
+    np.testing.assert_allclose(float(loss_g), float(loss_e), rtol=1e-5)
+    for name in ("router", "w1", "w2"):
+        np.testing.assert_allclose(np.asarray(g_g[name]),
+                                   np.asarray(g_e[name]),
+                                   rtol=1e-4, atol=1e-6, err_msg=name)
+
+
+def test_moe_aux_through_step_metrics():
+    """The router-health satellite: step_metrics(moe_aux=...) surfaces
+    dropped_fraction and the per-expert load vector straight from the
+    aux the dispatch already computed."""
+    from apex_tpu.utils.metrics import step_metrics
+
+    cfg = MoEConfig(hidden=H, ffn=F, num_experts=4, top_k=1,
+                    capacity_factor=0.5)
+    params = moe_init(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, H))
+    _, aux = moe_apply(params, x, cfg)
+    m = step_metrics(loss=1.0, moe_aux=aux)
+    assert float(m["moe_dropped_fraction"]) == float(
+        aux["dropped_fraction"])
+    assert m["moe_expert_load"].shape == (4,)
+    np.testing.assert_allclose(float(jnp.sum(m["moe_expert_load"])), 1.0,
+                               rtol=1e-6)
+    # a list of per-layer auxes averages
+    m2 = step_metrics(moe_aux=[aux, aux])
+    np.testing.assert_allclose(np.asarray(m2["moe_expert_load"]),
+                               np.asarray(m["moe_expert_load"]),
+                               rtol=1e-6)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("t,e", [(512, 16), (1024, 32)])
+def test_grouped_parity_heavy_sweep(t, e):
+    """Heavy (t, E) sweep points for the grouped==einsum invariant —
+    slow-marked to keep tier-1 inside its budget (ROADMAP)."""
+    cfg = MoEConfig(hidden=32, ffn=64, num_experts=e, top_k=2,
+                    capacity_factor=1.0)
+    params = moe_init(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (t, 32))
+    ye, auxe = moe_apply(params, x, cfg, grouped=False)
+    yg, auxg = moe_apply(params, x, cfg, grouped=True)
+    assert float(auxe["dropped_fraction"]) == float(auxg["dropped_fraction"])
+    np.testing.assert_allclose(np.asarray(yg), np.asarray(ye),
+                               rtol=1e-4, atol=1e-6)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,241 @@
+"""Seeded fuzz of the ragged grouped-matmul kernel vs the segment oracle.
+
+Mirrors tests/L0/test_quantized_comms_fuzz.py: fixed-seed random samples
+over the configuration space (adversarial group-size distributions x
+dtypes x tile configs), each case asserting kernel/oracle parity in
+Pallas interpret mode for the forward, the transposed variant, tgmm, and
+the custom_vjp gradients against ``jax.grad`` of the oracle.
+
+The distributions are the ones the static work decomposition
+(_group_metadata) can get wrong: empty groups (skipped work items, zero
+drhs), one expert taking every token (span = whole grid), group sizes
+not a multiple of tile_t (masked partial tiles at every boundary), t not
+a multiple of 8 (sublane padding), and sum(group_sizes) < t (trailing
+rows must come out exactly zero).
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.grouped_matmul import (
+    _group_metadata,
+    gmm,
+    gmm_ref,
+    tgmm,
+    tgmm_ref,
+)
+
+_DTYPES = [jnp.float32, jnp.bfloat16]
+
+
+@pytest.fixture(autouse=True)
+def _interpret_kernels(monkeypatch):
+    monkeypatch.setenv("APEX_TPU_PALLAS_INTERPRET", "1")
+    # tiny tiles so every case runs multiple work tiles with ragged
+    # boundaries inside them (the machinery under test); the env override
+    # also pins the resolution path (env > cache > cost model)
+    monkeypatch.setenv("APEX_TPU_MOE_TILE_T", "8")
+    monkeypatch.setenv("APEX_TPU_MOE_TILE_F", "128")
+
+
+def _tol(dtype):
+    # not bitwise: the kernel accumulates per (tile, group) chunk, the
+    # oracle in one einsum — fp32 reassociation noise on O(10) values
+    return 1e-4 if dtype == jnp.float32 else 0.1
+
+
+def _md(a, b):
+    return float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                 - b.astype(jnp.float32))))
+
+
+def _sample(case: int):
+    rng = random.Random(9100 + case)
+    e = rng.choice([2, 4, 7])
+    t = rng.choice([13, 40, 67, 130])      # never a multiple of 8
+    shape = rng.choice(["empty_heavy", "one_takes_all", "uniform",
+                        "ragged", "short"])
+    if shape == "empty_heavy":
+        # one heavy group, one light, the rest empty
+        sizes = [0] * e
+        take = rng.randint(0, t // 2)
+        sizes[rng.randrange(e)] = t - take
+        sizes[rng.randrange(e)] += take
+        total = t
+    elif shape == "one_takes_all":
+        sizes = [0] * e
+        sizes[rng.randrange(e)] = t
+        total = t
+    elif shape == "uniform":
+        sizes = [t // e] * e
+        total = sum(sizes)
+    elif shape == "short":                  # sum(group_sizes) < t
+        sizes = [rng.randint(0, max(1, t // (2 * e))) for _ in range(e)]
+        total = sum(sizes)
+    else:
+        cuts = sorted(rng.randint(0, t) for _ in range(e - 1))
+        sizes = [b - a for a, b in zip([0] + cuts, cuts + [t])]
+        total = t
+    assert total <= t
+    return {
+        "t": t, "e": e, "h": rng.choice([40, 72, 128]),
+        "f": rng.choice([96, 160, 256]),
+        "sizes": jnp.array(sizes, jnp.int32),
+        "dtype": _DTYPES[case % len(_DTYPES)],
+    }
+
+
+def _case(case: int, p):
+    ks = jax.random.split(jax.random.PRNGKey(case), 4)
+    lhs = jax.random.normal(ks[0], (p["t"], p["h"]), p["dtype"])
+    rhs = jax.random.normal(ks[1], (p["e"], p["h"], p["f"]), p["dtype"])
+    lhs_t = jax.random.normal(ks[2], (p["t"], p["f"]), p["dtype"])
+    dout = jax.random.normal(ks[3], (p["t"], p["f"]), p["dtype"])
+    return lhs, rhs, lhs_t, dout
+
+
+@pytest.mark.parametrize("case", range(8))
+def test_fuzz_gmm_forward_and_transpose(case):
+    p = _sample(case)
+    lhs, rhs, lhs_t, _ = _case(case, p)
+    got = jax.jit(lambda l, r, g: gmm(l, r, g, use_pallas=True))(
+        lhs, rhs, p["sizes"])
+    ref = gmm_ref(lhs, rhs, p["sizes"])
+    assert _md(got, ref) < _tol(p["dtype"]), p
+    got_t = gmm(lhs_t, rhs, p["sizes"], transpose_rhs=True, use_pallas=True)
+    ref_t = gmm_ref(lhs_t, rhs, p["sizes"], transpose_rhs=True)
+    assert _md(got_t, ref_t) < _tol(p["dtype"]), p
+    # rows past sum(group_sizes) are the kernel's exact-zero contract
+    total = int(p["sizes"].sum())
+    if total < p["t"]:
+        assert float(jnp.max(jnp.abs(
+            got[total:].astype(jnp.float32)))) == 0.0, p
+
+
+@pytest.mark.parametrize("case", range(6))
+def test_fuzz_tgmm_vs_oracle(case):
+    p = _sample(50 + case)
+    lhs, _, _, dout = _case(50 + case, p)
+    got = jax.jit(lambda l, d, g: tgmm(l, d, g, use_pallas=True))(
+        lhs, dout, p["sizes"])
+    ref = tgmm_ref(lhs, dout, p["sizes"])
+    assert got.shape == (p["e"], p["h"], p["f"])
+    assert _md(got, ref) < _tol(p["dtype"]), p
+    # empty groups must come out exactly zero (their grid steps are
+    # never visited; the wrapper owns the zeroing)
+    empty = np.asarray(p["sizes"]) == 0
+    if empty.any():
+        assert float(jnp.max(jnp.abs(
+            got[np.flatnonzero(empty)].astype(jnp.float32)))) == 0.0, p
+
+
+@pytest.mark.parametrize("case", range(4))
+def test_fuzz_gmm_custom_vjp_matches_oracle_grad(case):
+    p = _sample(100 + case)
+    lhs, rhs, _, dout = _case(100 + case, p)
+
+    def loss_k(l, r):
+        y = gmm(l, r, p["sizes"], use_pallas=True)
+        return jnp.vdot(y.astype(jnp.float32), dout.astype(jnp.float32))
+
+    def loss_o(l, r):
+        y = gmm_ref(lhs=l, rhs=r, group_sizes=p["sizes"])
+        return jnp.vdot(y.astype(jnp.float32), dout.astype(jnp.float32))
+
+    gk = jax.jit(jax.grad(loss_k, argnums=(0, 1)))(lhs, rhs)
+    go = jax.grad(loss_o, argnums=(0, 1))(lhs, rhs)
+    for a, b, name in zip(gk, go, ("dlhs", "drhs")):
+        assert _md(a, b) < _tol(p["dtype"]), (name, p)
+
+
+def test_metadata_covers_every_tile_once_per_group():
+    """Structural invariants of the static work decomposition: every
+    (tile, group) intersection appears exactly once, sequences are
+    nondecreasing (the revisit-chain contract), and every row tile is
+    visited so the output is fully defined."""
+    for sizes, t_pad, tm in (
+        ([7, 0, 25, 5], 48, 8),
+        ([0, 0, 0, 0], 16, 8),
+        ([40, 0, 0, 0], 40, 8),
+        ([3, 11, 2, 9, 18], 48, 16),
+    ):
+        gs = jnp.array(sizes, jnp.int32)
+        e = len(sizes)
+        pt = t_pad // tm
+        wt, wg, offs = jax.jit(
+            lambda g: _group_metadata(g, t_pad, tm))(gs)
+        wt, wg, offs = map(np.asarray, (wt, wg, offs))
+        assert wt.shape == wg.shape == (pt + e + 1,)
+        assert wt[-1] == pt and wg[-1] == e       # sentinel row
+        seen = set()
+        visited_tiles = set()
+        for i in range(pt + e):
+            if wt[i] == pt:                        # unused slot
+                continue
+            visited_tiles.add(int(wt[i]))
+            if wg[i] < e:                          # real (tile, group) item
+                key = (int(wt[i]), int(wg[i]))
+                assert key not in seen, (sizes, key)
+                seen.add(key)
+                lo, hi = offs[wg[i]], offs[wg[i] + 1]
+                assert lo < hi                     # nonempty group
+                # the tile actually intersects the group's rows
+                assert lo < (wt[i] + 1) * tm and hi > wt[i] * tm
+        assert visited_tiles == set(range(pt)), (sizes, visited_tiles)
+        # nondecreasing group AND tile sequences (chain contract)
+        real = wt[:-1][wt[:-1] < pt]
+        assert (np.diff(real) >= 0).all(), sizes
+        assert (np.diff(wg[:-1].astype(int)) >= 0).all(), sizes
+
+
+@pytest.mark.parametrize("n_out", [384, 640])
+def test_output_width_not_a_tile_multiple(monkeypatch, n_out):
+    """Regression: padded output widths that are NOT a multiple of the
+    resolved tile (384/640 vs tile 256) must still fill every output
+    column — the grid floor-divides, so the wrapper has to pad the
+    output dim up to a tile multiple or trailing blocks come back as
+    uninitialized memory (found by review; the sampled f values all
+    happened to divide)."""
+    monkeypatch.setenv("APEX_TPU_MOE_TILE_T", "16")
+    monkeypatch.setenv("APEX_TPU_MOE_TILE_F", "256")
+    t, e, h = 40, 3, 64
+    ks = jax.random.split(jax.random.PRNGKey(n_out), 3)
+    lhs = jax.random.normal(ks[0], (t, h), jnp.float32)
+    rhs = jax.random.normal(ks[1], (e, h, n_out), jnp.float32)
+    sizes = jnp.array([17, 0, 23], jnp.int32)
+    got = gmm(lhs, rhs, sizes, use_pallas=True)
+    assert _md(got, gmm_ref(lhs, rhs, sizes)) < _tol(jnp.float32)
+    # tgmm pads BOTH trailing output dims (a=n_out via transposed use)
+    dout = jax.random.normal(ks[2], (t, n_out), jnp.float32)
+    got_g = tgmm(lhs, dout, sizes, use_pallas=True)
+    assert _md(got_g, tgmm_ref(lhs, dout, sizes)) < _tol(jnp.float32)
+    got_t = gmm(dout, rhs, sizes, transpose_rhs=True, use_pallas=True)
+    assert _md(got_t, gmm_ref(dout, rhs, sizes,
+                              transpose_rhs=True)) < _tol(jnp.float32)
+
+
+def test_env_tile_overrides_win(monkeypatch):
+    """APEX_TPU_MOE_TILE_T/F beat a pinned cache entry (env > cache >
+    cost model) and invalid values raise at the op layer."""
+    from apex_tpu.ops.grouped_matmul import _gmm_params
+    from apex_tpu.tuning import cache, shape_class
+
+    db = cache.TuneDB()
+    db.record(shape_class.moe_key(512, 4, 128, 256, jnp.bfloat16),
+              {"tile_t": 256, "tile_f": 256}, source="test")
+    with cache.pinned(db):
+        monkeypatch.setenv("APEX_TPU_MOE_TILE_T", "16")
+        monkeypatch.setenv("APEX_TPU_MOE_TILE_F", "384")
+        p = _gmm_params(512, 4, 128, 256, jnp.bfloat16)
+        assert (p["tile_t"], p["tile_f"]) == (16, 384)
+    monkeypatch.setenv("APEX_TPU_MOE_TILE_T", "12")  # not 8-aligned
+    with pytest.raises(ValueError):
+        _gmm_params(512, 4, 128, 256, jnp.bfloat16)
+    monkeypatch.setenv("APEX_TPU_MOE_TILE_T", "16")
+    monkeypatch.setenv("APEX_TPU_MOE_TILE_F", "100")  # not 128-aligned
+    with pytest.raises(ValueError):
+        _gmm_params(512, 4, 128, 256, jnp.bfloat16)

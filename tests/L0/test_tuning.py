@@ -24,6 +24,7 @@ def _clean_tuning_env(monkeypatch, tmp_path):
     inherited sweep env vars."""
     for var in ("APEX_TPU_FLASH_BLOCK", "APEX_TPU_FLASH_BLOCK_BWD",
                 "APEX_TPU_FLASH_STREAM", "APEX_TPU_LN_BLOCK_ROWS",
+                "APEX_TPU_MOE_TILE_T", "APEX_TPU_MOE_TILE_F",
                 "APEX_TPU_OPTIM_BLOCK_ROWS", "APEX_TPU_SOFTMAX_CHUNK",
                 "APEX_TPU_USE_PALLAS", "APEX_TPU_TUNE"):
         monkeypatch.delenv(var, raising=False)
@@ -338,6 +339,78 @@ def test_softmax_chunk_parity(monkeypatch):
 
 
 # ------------------------------------------------------------------
+# moe_grouped family: defaults + the env > cache > cost-model order
+# ------------------------------------------------------------------
+
+def test_moe_grouped_cost_model_defaults():
+    assert cost_model.moe_tile_f_default(4096) == 256
+    assert cost_model.moe_tile_f_default(96) == 128   # clamps to padded f
+    # GPT-medium-class experts fit the 512-row tile; wide hidden shrinks
+    assert cost_model.moe_tile_t_default(1024, 4096,
+                                         device="tpuv5lite") == 512
+    assert cost_model.moe_tile_t_default(8192, 8192,
+                                         device="tpuv5lite") < 512
+    # the oracle-fallback threshold: tiny routed-row classes go jnp
+    assert cost_model.moe_backend_default(64, 8, 1024, 4096) == "jnp"
+    assert cost_model.moe_backend_default(
+        cost_model.MOE_FALLBACK_ROWS, 8, 1024, 4096) == "pallas"
+
+
+def test_moe_grouped_resolution_order(monkeypatch):
+    """env > tune cache > cost model for the moe_grouped family — the
+    acceptance pin (same shape as the paged_decode/overlap_tp pins)."""
+    from apex_tpu.ops.grouped_matmul import _gmm_params
+
+    t, e, h, f = 4096, 8, 1024, 4096
+    # 1) empty cache -> pure cost-model defaults
+    with cache.pinned(cache.TuneDB()):
+        p = _gmm_params(t, e, h, f, jnp.bfloat16)
+        assert p == {"tile_t": 512, "tile_f": 256, "backend": "pallas"}
+    # 2) cache entry beats the cost model (field-wise)
+    db = cache.TuneDB()
+    db.record(shape_class.moe_key(t, e, h, f, jnp.bfloat16),
+              {"tile_t": 256, "backend": "jnp"}, source="test")
+    with cache.pinned(db):
+        p = _gmm_params(t, e, h, f, jnp.bfloat16)
+        assert (p["tile_t"], p["tile_f"]) == (256, 256)  # tf from model
+        assert p["backend"] == "jnp"
+        # 3) env beats the cache
+        monkeypatch.setenv("APEX_TPU_MOE_TILE_T", "128")
+        monkeypatch.setenv("APEX_TPU_MOE_TILE_F", "512")
+        p = _gmm_params(t, e, h, f, jnp.bfloat16)
+        assert (p["tile_t"], p["tile_f"]) == (128, 512)
+    # malformed cache values clamp to defaults, never crash
+    monkeypatch.delenv("APEX_TPU_MOE_TILE_T")
+    monkeypatch.delenv("APEX_TPU_MOE_TILE_F")
+    db = cache.TuneDB()
+    db.record(shape_class.moe_key(t, e, h, f, jnp.bfloat16),
+              {"tile_t": 100, "tile_f": "huge", "backend": "cuda"},
+              source="test")
+    with cache.pinned(db):
+        p = _gmm_params(t, e, h, f, jnp.bfloat16)
+        assert p == {"tile_t": 512, "tile_f": 256, "backend": "pallas"}
+
+
+def test_moe_grouped_auto_backend_routing(monkeypatch):
+    """A cached jnp pin routes auto mode to the segment oracle;
+    APEX_TPU_USE_PALLAS=1 beats the pin (env > cache > model)."""
+    from apex_tpu.ops import grouped_matmul as gm
+
+    monkeypatch.setattr(gm, "default_use_pallas", lambda fam: True)
+    t, e, h, f = 4096, 8, 1024, 4096
+    with cache.pinned(cache.TuneDB()):
+        assert gm._auto_use_kernel(t, e, h, f, jnp.bfloat16) is True
+        assert gm._auto_use_kernel(64, e, h, f, jnp.bfloat16) is False
+    db = cache.TuneDB()
+    db.record(shape_class.moe_key(t, e, h, f, jnp.bfloat16),
+              {"backend": "jnp"}, source="test")
+    with cache.pinned(db):
+        assert gm._auto_use_kernel(t, e, h, f, jnp.bfloat16) is False
+        monkeypatch.setenv("APEX_TPU_USE_PALLAS", "1")
+        assert gm._auto_use_kernel(t, e, h, f, jnp.bfloat16) is True
+
+
+# ------------------------------------------------------------------
 # registry validation
 # ------------------------------------------------------------------
 
@@ -355,6 +428,14 @@ def test_registry_validate_entry():
         registry.validate_entry("flash", {"backend": "cuda"})
     with pytest.raises(ValueError, match="multiple of 8"):
         registry.validate_entry("layer_norm", {"block_rows": 100})
+    registry.validate_entry("moe_grouped", {"tile_t": 256, "tile_f": 128,
+                                            "backend": "pallas"})
+    with pytest.raises(ValueError, match="multiple of 8"):
+        registry.validate_entry("moe_grouped", {"tile_t": 100})
+    with pytest.raises(ValueError, match="multiple of 128"):
+        registry.validate_entry("moe_grouped", {"tile_f": 64})
+    with pytest.raises(ValueError, match="backend"):
+        registry.validate_entry("moe_grouped", {"backend": "cuda"})
 
 
 # ------------------------------------------------------------------
