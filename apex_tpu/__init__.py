@@ -44,6 +44,7 @@ _SUBMODULES = (
     "transformer",
     "contrib",
     "models",
+    "observability",
     "serving",
     "testing",
     "tuning",

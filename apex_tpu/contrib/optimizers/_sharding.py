@@ -20,6 +20,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.observability import inc_counter
+from apex_tpu.utils.profiling import trace_range
+
 
 class FlatMeta(NamedTuple):
     treedef: object
@@ -119,17 +122,30 @@ def reduce_scatter_flat(flat, axis_name: str, *, mean: bool = True,
         from apex_tpu.parallel.overlap import quantized_comms_enabled
 
         quantized = quantized_comms_enabled()
-    if quantized:
-        from apex_tpu.parallel.quantized_collectives import (
-            quantized_psum_scatter,
-        )
+    # profiling seam (ref: nvtx around the per-bucket reduce-scatter
+    # hooks) + trace-time bytes-on-wire accounting (static sizes)
+    with trace_range("zero_reduce_scatter_flat"):
+        if quantized:
+            from apex_tpu.parallel.quantized_collectives import (
+                quantized_psum_scatter,
+                quantized_scatter_wire_bytes,
+            )
 
-        shard = quantized_psum_scatter(flat, axis_name)
-    else:
-        shard = lax.psum_scatter(
-            flat.reshape(n, flat.shape[0] // n), axis_name,
-            scatter_dimension=0, tiled=False,
-        )
+            inc_counter(
+                "comms/bytes_on_wire",
+                quantized_scatter_wire_bytes(flat.shape[0],
+                                             lax.axis_size(axis_name)),
+                path="zero", collective="psum_scatter", mode="int8")
+            shard = quantized_psum_scatter(flat, axis_name)
+        else:
+            inc_counter(
+                "comms/bytes_on_wire",
+                flat.shape[0] * flat.dtype.itemsize,
+                path="zero", collective="psum_scatter", mode="exact")
+            shard = lax.psum_scatter(
+                flat.reshape(n, flat.shape[0] // n), axis_name,
+                scatter_dimension=0, tiled=False,
+            )
     if mean:
         shard = shard / n
     return shard
@@ -157,20 +173,28 @@ def all_gather_flat(shard, axis_name: str, *, chunks: int = 1):
     idx = lax.axis_index(axis_name)
     s = shard.shape[0]
     chunks = max(1, min(int(chunks), s)) if s else 1
+    # the param-gather leg of the ZeRO bucket flush: one allreduce-sized
+    # payload per step (place-in-zeros + psum, see docstring)
+    inc_counter("comms/bytes_on_wire",
+                lax.axis_size(axis_name) * s * shard.dtype.itemsize,
+                path="zero", collective="allgather_params", mode="exact")
     if chunks == 1:
-        full = jnp.zeros((n * s,), shard.dtype)
-        full = lax.dynamic_update_slice_in_dim(full, shard, idx * s, 0)
-        return lax.psum(full, axis_name)
+        with trace_range("zero_allgather_params"):
+            full = jnp.zeros((n * s,), shard.dtype)
+            full = lax.dynamic_update_slice_in_dim(full, shard, idx * s, 0)
+            return lax.psum(full, axis_name)
     base = -(-s // chunks)  # ceil; ragged last piece
     full = jnp.zeros((n * s,), shard.dtype)
-    for off in range(0, s, base):
-        sz = min(base, s - off)
-        piece = lax.dynamic_slice_in_dim(shard, off, sz, 0)
-        buf = jnp.zeros((n * sz,), shard.dtype)
-        buf = lax.dynamic_update_slice_in_dim(buf, piece, idx * sz, 0)
-        buf = lax.psum(buf, axis_name)
-        gathered = buf.reshape(-1, sz)  # row r = rank r's piece
-        full = full.reshape(-1, s).at[:, off:off + sz].set(gathered).reshape(-1)
+    with trace_range("zero_allgather_params_chunked"):
+        for off in range(0, s, base):
+            sz = min(base, s - off)
+            piece = lax.dynamic_slice_in_dim(shard, off, sz, 0)
+            buf = jnp.zeros((n * sz,), shard.dtype)
+            buf = lax.dynamic_update_slice_in_dim(buf, piece, idx * sz, 0)
+            buf = lax.psum(buf, axis_name)
+            gathered = buf.reshape(-1, sz)  # row r = rank r's piece
+            full = full.reshape(-1, s).at[:, off:off + sz].set(
+                gathered).reshape(-1)
     return full
 
 

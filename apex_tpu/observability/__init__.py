@@ -1,0 +1,78 @@
+"""apex_tpu.observability — the unified telemetry subsystem.
+
+One pipeline for everything the library can tell an operator (see
+docs/observability.md):
+
+- ``registry``  — dependency-free counters/gauges/histograms with label
+                  support, snapshot/reset, env-gated
+                  (``APEX_TPU_METRICS_SINK``; disabled = near-zero
+                  overhead, jitted HLO bitwise-unchanged).
+- ``sinks``     — JSONL / CSV / in-memory sinks + ``flush_metrics``.
+- ``bridge``    — ``MetricsBuffer`` pytree carried in train/serve state,
+                  drained host-side with rate-limited non-blocking
+                  transfers (never forces a sync inside the step loop).
+- ``goodput``   — steps/s & tokens/s EMAs, compile-event detection via
+                  trace counters, overflow-skip fraction, compile-vs-run
+                  wall split.
+
+Built-in instrumentation records here: the serving engine (TTFT/TPOT
+histograms, queue depth, KV occupancy, admission/eviction counters), the
+DDP/ZeRO collective paths (bytes-on-wire, fp32 vs int8), the MoE grouped
+dispatch, and the tuning cache (hit/miss).
+
+``registry`` and ``sinks`` are stdlib-only and import eagerly;
+``bridge``/``goodput`` need jax and load lazily.
+"""
+
+from apex_tpu.observability.registry import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    TIME_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    inc_counter,
+    metrics_enabled,
+    observe,
+    set_gauge,
+)
+from apex_tpu.observability.sinks import (  # noqa: F401
+    MEMORY,
+    CSVSink,
+    JSONLSink,
+    MemorySink,
+    Sink,
+    flush_metrics,
+    sink_from_env,
+)
+
+__all__ = [
+    "CSVSink", "Counter", "DEFAULT_BUCKETS", "Gauge", "GoodputTracker",
+    "Histogram", "JSONLSink", "MEMORY", "MemorySink", "MetricsBuffer",
+    "MetricsDrainer", "MetricsRegistry", "Sink", "TIME_BUCKETS",
+    "accumulate", "default_registry", "flush_metrics", "inc_counter",
+    "init_buffer", "metrics_enabled", "observe", "set_gauge",
+    "sink_from_env",
+]
+
+_LAZY = {
+    "MetricsBuffer": "bridge",
+    "MetricsDrainer": "bridge",
+    "accumulate": "bridge",
+    "init_buffer": "bridge",
+    "GoodputTracker": "goodput",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module 'apex_tpu.observability' has no attribute {name!r}")
+    import importlib
+
+    m = importlib.import_module(f"apex_tpu.observability.{mod}")
+    val = getattr(m, name)
+    globals()[name] = val
+    return val

@@ -1,0 +1,162 @@
+"""Async device→host metrics bridge.
+
+The jit contract makes per-step logging expensive the naive way: pulling
+any scalar out of a jitted train step (``float(loss)``) forces a full
+device sync every step, serializing the pipeline the rest of the library
+works hard to keep full. The bridge splits the problem:
+
+* **Device side** — a :class:`MetricsBuffer` pytree carried in the train
+  or serve state. ``accumulate`` adds one step's scalar dict (the
+  ``utils.metrics.step_metrics`` dict, verbatim) into running sums plus a
+  step count — pure jnp, shapes fixed by the first step, so carrying the
+  buffer never changes the program's signature and a drained buffer swaps
+  in without a retrace (pinned by tests/L0/test_observability.py).
+* **Host side** — :class:`MetricsDrainer`: rate-limited (default every
+  32 steps, ``APEX_TPU_METRICS_INTERVAL``), and DOUBLE-BUFFERED with
+  non-blocking transfers: each drain kicks ``copy_to_host_async`` on the
+  current buffer, harvests the buffer it kicked an interval AGO (whose
+  transfer finished long since), and hands back a fresh zero buffer. The
+  host never waits on the step in flight — per-step logging adds no sync.
+
+Means land in the default registry as gauges named
+``<prefix>/<key>`` (vector values — e.g. ``moe_expert_load`` [E] — fan
+out per index as ``<prefix>/<key>/<i>``), which is how MoE router
+health and amp overflow counts flow into the same pipeline as the
+serving and comms metrics.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.observability.registry import (
+    MetricsRegistry,
+    default_registry,
+)
+
+__all__ = ["MetricsBuffer", "MetricsDrainer", "accumulate", "init_buffer"]
+
+# vector metrics fan out one gauge per element; cap the fan-out so a
+# buffer that accidentally carries a big activation can't flood the sink
+_MAX_VECTOR_FANOUT = 128
+
+
+class MetricsBuffer(NamedTuple):
+    """Device-side accumulator: ``sums[k]`` is the fp32 running sum of
+    metric ``k`` (any fixed shape, usually scalar), ``count`` the number
+    of accumulated steps. A NamedTuple-of-dict pytree, so it rides any
+    train/serve state container and donates cleanly."""
+
+    sums: Dict[str, jnp.ndarray]
+    count: jnp.ndarray  # i32[]
+
+
+def init_buffer(example: Dict[str, object]) -> MetricsBuffer:
+    """Zero buffer shaped like one step's metrics dict (e.g. the
+    ``step_metrics(...)`` of a representative step)."""
+    sums = {k: jnp.zeros(jnp.shape(v), jnp.float32)
+            for k, v in example.items()}
+    return MetricsBuffer(sums=sums, count=jnp.int32(0))
+
+
+def accumulate(buf: MetricsBuffer,
+               metrics: Dict[str, object]) -> MetricsBuffer:
+    """One step's metrics into the running sums (jit-safe; call inside
+    the step). The key set must match the buffer's — a drifting metric
+    dict would silently retrace, so mismatches fail loudly."""
+    missing = set(buf.sums) - set(metrics)
+    extra = set(metrics) - set(buf.sums)
+    if missing or extra:
+        raise KeyError(
+            f"MetricsBuffer key mismatch: step metrics are missing "
+            f"{sorted(missing)} and add {sorted(extra)}; init_buffer with "
+            f"the same dict the step emits")
+    sums = {k: buf.sums[k] + jnp.asarray(metrics[k], jnp.float32)
+            for k in buf.sums}
+    return MetricsBuffer(sums=sums, count=buf.count + 1)
+
+
+def _start_transfer(buf: MetricsBuffer) -> MetricsBuffer:
+    for leaf in jax.tree.leaves(buf):
+        start = getattr(leaf, "copy_to_host_async", None)
+        if start is not None:
+            start()
+    return buf
+
+
+class MetricsDrainer:
+    """Rate-limited drain of a :class:`MetricsBuffer` into the registry.
+
+    Usage::
+
+        drainer = MetricsDrainer(prefix="train")
+        for batch in data:
+            state = step(state, batch)          # accumulates into state.buf
+            state = state._replace(buf=drainer.drain(state.buf))
+        drainer.flush()                          # end of run: harvest all
+
+    ``drain`` returns the buffer to carry forward: on non-drain steps
+    that is the input unchanged; on drain steps it is a fresh zero buffer
+    (the drained one stays referenced here until its async copy is
+    harvested — hand the REPLACEMENT to the next donated step, never the
+    drained one)."""
+
+    def __init__(self, *, interval: Optional[int] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 prefix: str = "train"):
+        if interval is None:
+            interval = int(os.environ.get("APEX_TPU_METRICS_INTERVAL",
+                                          "32"))
+        self.interval = max(1, int(interval))
+        self.prefix = prefix
+        self._registry = registry
+        self._calls = 0
+        self._pending: Optional[MetricsBuffer] = None
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        return self._registry or default_registry()
+
+    # -- harvest: pending buffer (transfer already complete) --------
+    def _harvest(self) -> None:
+        buf, self._pending = self._pending, None
+        if buf is None:
+            return
+        count = int(np.asarray(buf.count))
+        if count == 0:
+            return
+        reg = self.registry
+        if not reg.enabled:
+            return
+        for key, s in buf.sums.items():
+            mean = np.asarray(s, np.float64) / count
+            name = f"{self.prefix}/{key}"
+            if mean.ndim == 0:
+                reg.gauge(name).set(float(mean))
+            else:
+                for i, v in enumerate(mean.reshape(-1)
+                                      [:_MAX_VECTOR_FANOUT]):
+                    reg.gauge(f"{name}/{i}").set(float(v))
+        reg.gauge(f"{self.prefix}/drained_steps").set(count)
+
+    def drain(self, buf: MetricsBuffer, *,
+              force: bool = False) -> MetricsBuffer:
+        """Maybe-drain ``buf``; returns the buffer for the next step."""
+        self._calls += 1
+        if not (force or self._calls % self.interval == 0):
+            return buf
+        self._harvest()                       # the interval-old transfer
+        if self.registry.enabled:
+            self._pending = _start_transfer(buf)
+        return jax.tree.map(jnp.zeros_like, buf)
+
+    def flush(self) -> None:
+        """End of run: harvest whatever transfer is still pending. (The
+        buffer the caller still holds can be force-drained first:
+        ``drainer.drain(buf, force=True); drainer.flush()``.)"""
+        self._harvest()

@@ -1,0 +1,301 @@
+"""Metrics registry — counters, gauges, fixed-bucket histograms.
+
+The reference keeps apex-style minimalist observability (loss-scale
+printouts, nvtx ranges); the rebuild outgrew it: autotuning, comms
+overlap, continuous-batching serving and grouped MoE each carried ad-hoc
+counters with no shared pipeline. This module is the one registry they
+all flow through.
+
+Design constraints (the jit contract):
+
+* **Dependency-free.** stdlib only — importable from anywhere in the
+  package (including tuning/cache.py, which loads before jax-heavy
+  modules) with no import cycles.
+* **Host-side only.** Instruments record python numbers. Nothing here is
+  ever traced: call sites inside jitted code record at TRACE time
+  (static shape arithmetic — e.g. bytes-on-wire per collective) or from
+  the host loop (serving TTFT, goodput). The jitted program's HLO is
+  bitwise-identical with metrics on or off — pinned by
+  tests/L0/test_observability.py.
+* **Disabled ⇒ near-zero overhead.** The module-level helpers
+  (``inc_counter``/``set_gauge``/``observe``) check the env gate first
+  and return immediately when no sink is configured — one dict lookup
+  per call on the disabled path.
+
+Env gate: ``APEX_TPU_METRICS_SINK`` — unset/empty/``0`` disables; any
+other value enables and names the sink (``jsonl``/``csv``/``memory``,
+see sinks.py). ``APEX_TPU_METRICS_PATH`` points file sinks at a path.
+Re-read at call time (same discipline as utils/profiling.py — a harness
+enabling metrics around one phase must not be ignored by an import-time
+latch).
+
+Labels: every instrument takes ``**labels`` (str -> str/int); each
+distinct label set is an independent series, like Prometheus. Histogram
+buckets are FIXED upper bounds chosen at instrument creation — no
+dynamic resizing, so ``observe`` is O(#buckets) worst case and
+allocation-free after the first sample.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Counter",
+    "DEFAULT_BUCKETS",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "TIME_BUCKETS",
+    "default_registry",
+    "inc_counter",
+    "metrics_enabled",
+    "observe",
+    "set_gauge",
+]
+
+# generic magnitude buckets (powers of 4 around 1.0)
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0625, 0.25, 1.0, 4.0, 16.0, 64.0, 256.0, 1024.0, 4096.0,
+)
+# latency buckets in seconds: 1 ms .. 60 s (TTFT/TPOT/step times)
+TIME_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def metrics_enabled() -> bool:
+    """The gate every recording helper consults, resolved at CALL time:
+    APEX_TPU_METRICS_SINK set to anything but ''/'0' enables."""
+    v = os.environ.get("APEX_TPU_METRICS_SINK")
+    return bool(v) and v != "0"
+
+
+def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Instrument:
+    """Shared label-series bookkeeping. Subclasses hold one value (or
+    histogram state) per distinct label set."""
+
+    kind = "instrument"
+
+    def __init__(self, name: str, registry: "MetricsRegistry"):
+        self.name = name
+        self._reg = registry
+        self._series: Dict[Tuple[Tuple[str, str], ...], object] = {}
+
+    def _enabled(self) -> bool:
+        return self._reg.enabled
+
+    def series(self) -> List[dict]:
+        out = []
+        for key, val in self._series.items():
+            out.append({"labels": dict(key), "value": val})
+        return out
+
+
+class Counter(_Instrument):
+    """Monotonic sum. ``inc(0)`` materializes the series at 0 (so a
+    dashboard sees the metric exists before its first event)."""
+
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        if not self._enabled():
+            return
+        if value < 0:
+            raise ValueError(f"counter {self.name}: negative inc {value}")
+        key = _label_key(labels)
+        with self._reg._lock:
+            self._series[key] = self._series.get(key, 0.0) + float(value)
+
+    def value(self, **labels) -> float:
+        return float(self._series.get(_label_key(labels), 0.0))
+
+
+class Gauge(_Instrument):
+    """Last-write-wins scalar."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        if not self._enabled():
+            return
+        with self._reg._lock:
+            self._series[_label_key(labels)] = float(value)
+
+    def value(self, **labels) -> Optional[float]:
+        v = self._series.get(_label_key(labels))
+        return None if v is None else float(v)
+
+
+class Histogram(_Instrument):
+    """Fixed-bucket histogram: per-bucket counts at the configured upper
+    bounds plus an implicit +Inf bucket, with sum/count (enough to
+    recover means and coarse quantiles; cumulative views are one scan
+    away)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, registry: "MetricsRegistry",
+                 buckets: Iterable[float] = DEFAULT_BUCKETS):
+        super().__init__(name, registry)
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs:
+            raise ValueError(f"histogram {self.name}: no buckets")
+        self.buckets = bs
+
+    def observe(self, value: float, **labels) -> None:
+        if not self._enabled():
+            return
+        value = float(value)
+        key = _label_key(labels)
+        with self._reg._lock:
+            st = self._series.get(key)
+            if st is None:
+                st = {"counts": [0] * (len(self.buckets) + 1),
+                      "sum": 0.0, "count": 0}
+                self._series[key] = st
+            st["counts"][bisect.bisect_left(self.buckets, value)] += 1
+            st["sum"] += value
+            st["count"] += 1
+
+    def count(self, **labels) -> int:
+        st = self._series.get(_label_key(labels))
+        return 0 if st is None else int(st["count"])
+
+    def sum(self, **labels) -> float:
+        st = self._series.get(_label_key(labels))
+        return 0.0 if st is None else float(st["sum"])
+
+    def series(self) -> List[dict]:
+        out = []
+        for key, st in self._series.items():
+            out.append({
+                "labels": dict(key),
+                "count": st["count"],
+                "sum": st["sum"],
+                "buckets": [[b, c] for b, c in
+                            zip(self.buckets + (float("inf"),),
+                                st["counts"])],
+            })
+        return out
+
+
+class MetricsRegistry:
+    """Instrument namespace + snapshot/reset.
+
+    ``enabled=None`` (the default registry) follows the
+    APEX_TPU_METRICS_SINK env gate at every call; True/False force it
+    (tests, bench harnesses that always want numbers)."""
+
+    def __init__(self, enabled: Optional[bool] = None):
+        self._enabled = enabled
+        self._lock = threading.RLock()
+        self._instruments: Dict[str, _Instrument] = {}
+
+    @property
+    def enabled(self) -> bool:
+        if self._enabled is not None:
+            return self._enabled
+        return metrics_enabled()
+
+    # -- instrument factories (get-or-create, type-checked) ----------
+    def _get(self, name: str, cls, **kw):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = cls(name, self, **kw)
+                self._instruments[name] = inst
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {inst.kind}")
+            return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str,
+                  buckets: Optional[Iterable[float]] = None) -> Histogram:
+        """``buckets=None`` = use the existing instrument's buckets (or
+        DEFAULT_BUCKETS on first creation). EXPLICIT buckets that differ
+        from an existing instrument's raise — a silent mismatch would
+        misbucket every later observation with no error."""
+        h = self._get(name, Histogram,
+                      buckets=DEFAULT_BUCKETS if buckets is None
+                      else buckets)
+        if buckets is not None:
+            want = tuple(sorted(float(b) for b in buckets))
+            if h.buckets != want:
+                raise ValueError(
+                    f"histogram {name!r} already registered with buckets "
+                    f"{h.buckets}, requested {want}")
+        return h
+
+    # -- snapshot / reset -------------------------------------------
+    def snapshot(self) -> dict:
+        """{name: {"type": ..., "series": [...]}} — plain python, safe to
+        json.dumps."""
+        with self._lock:
+            return {
+                name: {"type": inst.kind, "series": inst.series()}
+                for name, inst in self._instruments.items()
+                if inst._series
+            }
+
+    def records(self) -> List[dict]:
+        """Flat per-series records for sinks: one dict per (name, labels)
+        with a shared wall-clock timestamp."""
+        ts = round(time.time(), 3)
+        out = []
+        for name, snap in self.snapshot().items():
+            for s in snap["series"]:
+                rec = {"time": ts, "name": name, "type": snap["type"]}
+                rec.update(s)
+                out.append(rec)
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry every built-in instrumentation point
+    records into (serving engine, DDP/ZeRO comms, tuning cache, goodput).
+    Follows the env gate."""
+    return _DEFAULT
+
+
+# -- the hot-path helpers (single env check, then dispatch) -------------
+
+def inc_counter(name: str, value: float = 1.0, **labels) -> None:
+    if not metrics_enabled():
+        return
+    _DEFAULT.counter(name).inc(value, **labels)
+
+
+def set_gauge(name: str, value: float, **labels) -> None:
+    if not metrics_enabled():
+        return
+    _DEFAULT.gauge(name).set(value, **labels)
+
+
+def observe(name: str, value: float,
+            buckets: Optional[Iterable[float]] = None, **labels) -> None:
+    if not metrics_enabled():
+        return
+    _DEFAULT.histogram(name, buckets=buckets).observe(value, **labels)

@@ -1,0 +1,148 @@
+"""Metric sinks — where registry snapshots land.
+
+Three built-ins, selected by ``APEX_TPU_METRICS_SINK``:
+
+* ``jsonl``  — one JSON object per series per flush, appended to
+  ``APEX_TPU_METRICS_PATH`` (default ``/tmp/apex_tpu_metrics.jsonl``).
+  The format every harness in this repo already parses (bench.py's
+  one-line-JSON discipline).
+* ``csv``    — flat ``time,name,type,labels,value,count,sum`` rows to
+  ``APEX_TPU_METRICS_PATH`` (default ``/tmp/apex_tpu_metrics.csv``);
+  histogram buckets are elided (value = mean) — the spreadsheet view.
+* ``memory`` — records accumulate on a process-global list
+  (``MEMORY.records``); what tests and in-process consumers read.
+
+``flush_metrics()`` is the one pump: snapshot the registry, write the
+records, return them. Nothing flushes automatically — the owner of the
+loop decides when (bench.py flushes per emitted payload; serving and
+training loops call ``flush_metrics()`` wherever they already log).
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+import os
+from pathlib import Path
+from typing import List, Optional
+
+from apex_tpu.observability.registry import (
+    MetricsRegistry,
+    default_registry,
+    metrics_enabled,
+)
+
+__all__ = [
+    "CSVSink",
+    "JSONLSink",
+    "MEMORY",
+    "MemorySink",
+    "Sink",
+    "flush_metrics",
+    "sink_from_env",
+]
+
+
+class Sink:
+    """Write a batch of registry records somewhere."""
+
+    def write(self, records: List[dict]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class JSONLSink(Sink):
+    def __init__(self, path: os.PathLike | str):
+        self.path = Path(path)
+
+    def write(self, records: List[dict]) -> None:
+        if not records:
+            return
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        with self.path.open("a") as f:
+            for r in records:
+                f.write(json.dumps(r, sort_keys=True) + "\n")
+
+
+class CSVSink(Sink):
+    FIELDS = ("time", "name", "type", "labels", "value", "count", "sum")
+
+    def __init__(self, path: os.PathLike | str):
+        self.path = Path(path)
+
+    def write(self, records: List[dict]) -> None:
+        if not records:
+            return
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        header = not self.path.exists() or self.path.stat().st_size == 0
+        with self.path.open("a", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=self.FIELDS,
+                               extrasaction="ignore")
+            if header:
+                w.writeheader()
+            for r in records:
+                row = dict(r)
+                row["labels"] = json.dumps(r.get("labels", {}),
+                                           sort_keys=True)
+                if r.get("type") == "histogram" and r.get("count"):
+                    row["value"] = r["sum"] / r["count"]
+                w.writerow(row)
+
+
+class MemorySink(Sink):
+    def __init__(self):
+        self.records: List[dict] = []
+
+    def write(self, records: List[dict]) -> None:
+        self.records.extend(records)
+
+    def clear(self) -> None:
+        self.records.clear()
+
+    def dumps(self) -> str:
+        buf = io.StringIO()
+        for r in self.records:
+            buf.write(json.dumps(r, sort_keys=True) + "\n")
+        return buf.getvalue()
+
+
+# the process-global memory sink APEX_TPU_METRICS_SINK=memory flushes to
+MEMORY = MemorySink()
+
+
+def sink_from_env() -> Optional[Sink]:
+    """Resolve APEX_TPU_METRICS_SINK / APEX_TPU_METRICS_PATH into a sink,
+    or None when metrics are disabled. Unknown sink names raise — a typo
+    must not silently drop a production deployment's telemetry."""
+    if not metrics_enabled():
+        return None
+    kind = os.environ["APEX_TPU_METRICS_SINK"].strip().lower()
+    path = os.environ.get("APEX_TPU_METRICS_PATH")
+    if kind == "jsonl":
+        return JSONLSink(path or "/tmp/apex_tpu_metrics.jsonl")
+    if kind == "csv":
+        return CSVSink(path or "/tmp/apex_tpu_metrics.csv")
+    if kind == "memory":
+        return MEMORY
+    raise ValueError(
+        f"APEX_TPU_METRICS_SINK={kind!r}: unknown sink "
+        f"(known: jsonl, csv, memory)")
+
+
+def flush_metrics(registry: Optional[MetricsRegistry] = None,
+                  sink: Optional[Sink] = None,
+                  reset: bool = False) -> List[dict]:
+    """Snapshot ``registry`` (default: the process registry) into ``sink``
+    (default: resolved from env; no-op when disabled). Returns the
+    records written. ``reset=True`` clears the registry afterwards —
+    delta-style flushing for long-running loops."""
+    registry = registry or default_registry()
+    if sink is None:
+        sink = sink_from_env()
+        if sink is None:
+            return []
+    records = registry.records()
+    sink.write(records)
+    if reset:
+        registry.reset()
+    return records

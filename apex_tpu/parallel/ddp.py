@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.observability import inc_counter
 from apex_tpu.parallel.mesh import DATA_AXIS
 from apex_tpu.utils.profiling import trace_range
 
@@ -158,11 +159,24 @@ class DistributedDataParallel:
                         flat.size * flat.dtype.itemsize, flat.dtype):
                     from apex_tpu.parallel.quantized_collectives import (
                         quantized_psum,
+                        quantized_wire_bytes,
                     )
 
+                    # bytes-on-wire, recorded at TRACE time (sizes are
+                    # static): per traced step, not per execution — the
+                    # fp32-vs-int8 wire delta the int8 path exists for
+                    inc_counter(
+                        "comms/bytes_on_wire",
+                        quantized_wire_bytes(flat.size,
+                                             self.quantize_chunk),
+                        path="ddp", collective="psum", mode="int8")
                     flat = quantized_psum(flat, self.axis_name,
                                           chunk=self.quantize_chunk)
                 else:
+                    inc_counter(
+                        "comms/bytes_on_wire",
+                        flat.size * flat.dtype.itemsize,
+                        path="ddp", collective="psum", mode="exact")
                     flat = lax.psum(flat, self.axis_name)
                 flat = flat * post
             flat_buckets.append(flat)

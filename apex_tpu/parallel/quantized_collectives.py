@@ -51,7 +51,12 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["quantized_psum", "quantized_psum_scatter"]
+__all__ = [
+    "quantized_psum",
+    "quantized_psum_scatter",
+    "quantized_scatter_wire_bytes",
+    "quantized_wire_bytes",
+]
 
 DEFAULT_CHUNK = 256
 _QMAX = 127.0
@@ -63,6 +68,40 @@ _INT16_MAX_WORLD = 250
 def _wire_dtype(axis: str):
     return jnp.int16 if lax.axis_size(axis) <= _INT16_MAX_WORLD \
         else jnp.int32
+
+
+def quantized_wire_bytes(n: int, chunk: int = DEFAULT_CHUNK, *,
+                         error_compensation: bool = True,
+                         wire_itemsize: int = 2) -> int:
+    """Analytic payload bytes :func:`quantized_psum` moves for an
+    ``n``-element input: per pass, the zero-padded chunk grid on the wire
+    dtype plus one fp32 pmax-shared scale per chunk; two passes when
+    error-compensated. The observability bytes-on-wire counters (ddp.py,
+    contrib/optimizers/_sharding.py) and the analytic-match test both use
+    this — one formula, no drift."""
+    n = int(n)
+    chunk = max(1, min(int(chunk), n))
+    padded = -(-n // chunk) * chunk
+    n_chunks = padded // chunk
+    passes = 2 if error_compensation else 1
+    return passes * (padded * wire_itemsize + n_chunks * 4)
+
+
+def quantized_scatter_wire_bytes(n: int, world: int,
+                                 chunk: int = DEFAULT_CHUNK, *,
+                                 error_compensation: bool = True,
+                                 wire_itemsize: int = 2) -> int:
+    """Analytic payload bytes of :func:`quantized_psum_scatter` on a flat
+    ``n``-element payload over a ``world``-rank axis: chunk padding is
+    PER SHARD (chunk rows never straddle a shard boundary), scales are a
+    full pmax per pass."""
+    n, world = int(n), int(world)
+    shard = n // world
+    chunk = max(1, min(int(chunk), shard))
+    padded_shard = -(-shard // chunk) * chunk
+    n_chunks = world * (padded_shard // chunk)
+    passes = 2 if error_compensation else 1
+    return passes * (world * padded_shard * wire_itemsize + n_chunks * 4)
 
 
 def _chunk_view(flat32, chunk: int):

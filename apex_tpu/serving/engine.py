@@ -67,7 +67,15 @@ from apex_tpu.transformer.tensor_parallel.layers import (
 from apex_tpu.transformer.tensor_parallel.mappings import (
     copy_to_tensor_model_parallel_region,
 )
-from apex_tpu.utils.profiling import trace_range
+from apex_tpu.observability import (
+    TIME_BUCKETS,
+    default_registry,
+    inc_counter,
+    metrics_enabled,
+    observe,
+    set_gauge,
+)
+from apex_tpu.utils.profiling import host_trace_range, trace_range
 
 
 def _env_default(var: str, fallback: int) -> int:
@@ -362,6 +370,22 @@ class ServingEngine:
         stats = {"steps": 0, "prefills": 0, "decode_steps": 0,
                  "decode_tokens": 0, "prefill_s": 0.0, "decode_s": 0.0}
         waiting_since: Dict[object, float] = {}        # rid -> wall ts
+        # host-side telemetry (docs/observability.md): everything below
+        # records OUTSIDE the jitted programs, so the prefill/decode HLO
+        # and the two-compile contract are untouched with metrics on
+        kv_free_min = sched.free_blocks
+        if metrics_enabled():
+            # materialize the event counters at 0 so a quiet run still
+            # exports the full serving series set (the scheduler never
+            # preempts today; the counter is the dashboard's contract
+            # for when it does)
+            reg = default_registry()
+            for name in ("serving/admissions", "serving/evictions",
+                         "serving/preemptions",
+                         "serving/admission_blocked"):
+                reg.counter(name).inc(0)
+            set_gauge("serving/kv_blocks_total", s.num_blocks)
+            set_gauge("serving/kv_watermark", sched.watermark)
 
         def finish(slot):
             nonlocal cache
@@ -375,22 +399,32 @@ class ServingEngine:
             sched.tick(step)
             for r in list(sched._waiting):
                 waiting_since.setdefault(r.rid, time.perf_counter())
+            set_gauge("serving/queue_depth", len(sched._waiting))
             for slot, req, need in sched.admit():
                 tokens = jnp.zeros((1, s.max_prefill_len), jnp.int32
                                    ).at[0, : len(req.prompt)].set(
                     jnp.asarray(req.prompt, jnp.int32))
                 t0 = time.perf_counter()
-                cache, tok = self._prefill(
-                    self.params, cache, tokens, jnp.int32(slot),
-                    jnp.int32(len(req.prompt)), jnp.int32(need))
+                # host-side profiler seam: marks the dispatch+wait span
+                # in host traces without touching the compiled program
+                # (host_trace_range — a named_scope here would rename ops
+                # if this call is the one that traces)
+                with host_trace_range("serving.prefill_dispatch"):
+                    cache, tok = self._prefill(
+                        self.params, cache, tokens, jnp.int32(slot),
+                        jnp.int32(len(req.prompt)), jnp.int32(need))
                 stats["prefills"] += 1
                 tok = int(tok)                # host sync: timing honest
                 now = time.perf_counter()
                 stats["prefill_s"] += now - t0
                 gen[slot] = [tok]
+                ttft = now - waiting_since.get(req.rid, t0)
+                observe("serving/ttft_s", ttft, buckets=TIME_BUCKETS)
+                observe("serving/prefill_s", now - t0,
+                        buckets=TIME_BUCKETS)
                 out[req.rid] = {
                     "ttft_step": step, "steps": step,
-                    "ttft_s": now - waiting_since.get(req.rid, t0),
+                    "ttft_s": ttft,
                 }
                 if req.max_new_tokens == 1 or tok == s.eos_id:
                     finish(slot)
@@ -402,12 +436,17 @@ class ServingEngine:
                     tokens = tokens.at[slot].set(gen[slot][-1])
                 sched.grow_for_decode()       # host mirror of the device
                 t0 = time.perf_counter()
-                cache, nxt = self._decode(self.params, cache, tokens,
-                                          active)
+                with host_trace_range("serving.paged_decode_step"):
+                    cache, nxt = self._decode(self.params, cache, tokens,
+                                              active)
                 stats["decode_steps"] += 1
                 stats["decode_tokens"] += len(sched.running)
                 nxt = jax.device_get(nxt)     # host sync: timing honest
-                stats["decode_s"] += time.perf_counter() - t0
+                dt = time.perf_counter() - t0
+                stats["decode_s"] += dt
+                # one decode step = one token per active slot, so the
+                # step latency IS the per-token latency (TPOT)
+                observe("serving/tpot_s", dt, buckets=TIME_BUCKETS)
                 for slot in list(sched.running):
                     st = sched.running[slot]
                     tok = int(nxt[slot])
@@ -416,6 +455,11 @@ class ServingEngine:
                     if (len(gen[slot]) >= st.req.max_new_tokens
                             or tok == s.eos_id):
                         finish(slot)
+            kv_free_min = min(kv_free_min, sched.free_blocks)
+            set_gauge("serving/kv_blocks_free", sched.free_blocks)
+            set_gauge("serving/kv_occupancy",
+                      1.0 - sched.free_blocks / s.num_blocks)
+            set_gauge("serving/active_slots", len(sched.running))
             step += 1
         if sched.has_work():
             raise RuntimeError(
@@ -423,6 +467,13 @@ class ServingEngine:
         stats["steps"] = step
         stats["trace_counts"] = dict(self.trace_counts)
         stats["cache"] = cache
+        # low-watermark + throughput summary gauges for the whole run
+        set_gauge("serving/kv_blocks_free_min", kv_free_min)
+        if stats["decode_s"] > 0:
+            set_gauge("serving/decode_steps_per_sec",
+                      stats["decode_steps"] / stats["decode_s"])
+            set_gauge("serving/decode_tokens_per_sec",
+                      stats["decode_tokens"] / stats["decode_s"])
         out[None] = stats
         return out
 

@@ -33,6 +33,7 @@ import dataclasses
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
+from apex_tpu.observability import inc_counter
 from apex_tpu.serving.kv_cache import blocks_needed
 
 WAITING = "WAITING"
@@ -119,6 +120,9 @@ class Scheduler:
             req = self._waiting[0]
             need = blocks_needed(len(req.prompt), self.block_size)
             if self.free_blocks - need < self.watermark:
+                # the head-of-line request deferred by the watermark: the
+                # KV-pressure signal an operator sizes the pool by
+                inc_counter("serving/admission_blocked", 1)
                 break                         # FIFO: no skip-ahead
             self._waiting.popleft()
             slot = self._free_slots.pop(0)
@@ -126,6 +130,7 @@ class Scheduler:
             self.running[slot] = _Running(
                 req=req, slot=slot, n_blocks=need,
                 tokens_in_cache=len(req.prompt))
+            inc_counter("serving/admissions", 1)
             admitted.append((slot, req, need))
         return admitted
 
@@ -158,3 +163,4 @@ class Scheduler:
         self.free_blocks += st.n_blocks
         self._free_slots.append(slot)
         self._free_slots.sort()
+        inc_counter("serving/evictions", 1)

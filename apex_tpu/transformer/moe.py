@@ -65,6 +65,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.observability import inc_counter
+from apex_tpu.utils.profiling import trace_range
+
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
 def _grad_scale(x, s: float):
@@ -318,9 +321,21 @@ def _moe_grouped(params, x, logits, cfg: MoEConfig):
     einsum), ride the same two all_to_alls as the einsum path, the local
     expert FFN runs as a gmm over the received slot rows (uniform groups
     of p*C), and the combine is a gather + weighted sum."""
+    with trace_range("moe_grouped_dispatch"):
+        return _moe_grouped_body(params, x, logits, cfg)
+
+
+def _moe_grouped_body(params, x, logits, cfg: MoEConfig):
     from apex_tpu.ops.grouped_matmul import gmm
 
     t, h = x.shape
+    # trace-time dispatch accounting (static routing geometry): how many
+    # grouped-dispatch programs exist per traced step, and their shape
+    inc_counter("moe/grouped_dispatch", 1,
+                mode="dropless" if cfg.capacity_factor is None
+                else "capacity",
+                ep="1" if cfg.expert_axis is None
+                else str(lax.axis_size(cfg.expert_axis)))
     k, e = cfg.top_k, cfg.num_experts
     dropless = cfg.capacity_factor is None
     cap = None if dropless else cfg.capacity(t)

@@ -180,9 +180,24 @@ def pinned(db: Optional[TuneDB]):
 
 def lookup(key: str) -> Optional[dict]:
     """Tuned params for a class key, or None (-> cost-model default).
-    Respects pinning and APEX_TPU_TUNE=0."""
+    Respects pinning and APEX_TPU_TUNE=0.
+
+    Every resolution lands a hit/miss sample in the observability
+    registry (``tuning/lookups``, labels ``result`` + ``source``) —
+    lookups happen at TRACE time, so the counts answer "which shape
+    classes ran on cost-model defaults this build" without touching the
+    compiled program."""
+    from apex_tpu.observability.registry import inc_counter
+
     if _pinned_db is not None:
-        return _pinned_db.get(key)
+        params = _pinned_db.get(key)
+        inc_counter("tuning/lookups", 1, source="pinned",
+                    result="hit" if params is not None else "miss")
+        return params
     if not tuning_enabled():
+        inc_counter("tuning/lookups", 1, source="disabled", result="miss")
         return None
-    return active_db().get(key)
+    params = active_db().get(key)
+    inc_counter("tuning/lookups", 1, source="cache",
+                result="hit" if params is not None else "miss")
+    return params

@@ -94,8 +94,14 @@ def step_metrics(
         for key in ("dropped_fraction", "expert_load"):
             vals = [jnp.asarray(a[key], jnp.float32)
                     for a in auxes if key in a]
-            # layers must agree on shape to average (mixed expert counts
-            # can't share one load vector — log those per layer instead)
-            if vals and all(v.shape == vals[0].shape for v in vals):
+            if not vals:
+                continue
+            if all(v.shape == vals[0].shape for v in vals):
                 out[f"moe_{key}"] = sum(vals) / len(vals)
+            else:
+                # mixed expert counts can't share one averaged vector —
+                # emit per-layer keys instead of silently dropping the
+                # router-health signal
+                for i, v in enumerate(vals):
+                    out[f"moe_{key}/{i}"] = v
     return out

@@ -11,6 +11,7 @@ zero-cost when no trace is being captured.
 from __future__ import annotations
 
 import contextlib
+import functools
 import os
 from typing import Iterator, Optional
 
@@ -56,13 +57,32 @@ def trace_range(name: str) -> Iterator[None]:
         yield
 
 
+@contextlib.contextmanager
+def host_trace_range(name: str) -> Iterator[None]:
+    """TraceAnnotation-only variant of :func:`trace_range` for host loops
+    that dispatch into already-jitted functions. ``jax.named_scope``
+    would leak into any tracing the block happens to trigger (the FIRST
+    call of a jitted program traces inside the caller's context),
+    renaming ops in the compiled HLO — so this marks the host timeline
+    only, leaving every traced program bitwise-identical."""
+    if profiling_enabled():
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    else:
+        yield
+
+
 def annotate(name: str):
-    """Decorator form of :func:`trace_range`."""
+    """Decorator form of :func:`trace_range`. ``functools.wraps``
+    preserves the full wrapped-function identity (docstring, signature,
+    ``__wrapped__``) — a bare ``__name__`` copy dropped everything
+    introspection and ``inspect.signature`` need on decorated hot-path
+    fns."""
     def deco(fn):
+        @functools.wraps(fn)
         def wrapped(*a, **k):
             with trace_range(name):
                 return fn(*a, **k)
-        wrapped.__name__ = getattr(fn, "__name__", name)
         return wrapped
     return deco
 

@@ -50,7 +50,59 @@ _SERVING_METRIC = "apex_tpu_serving_decode_steps_per_sec"
 _MOE_METRIC = "apex_tpu_moe_tokens_per_sec"
 
 
+# -- observability: rung timings ride the telemetry registry ----------
+# Every measured row / payload lands gauges in a bench-local registry
+# (forced on — the env gate is for production loops, the bench always
+# wants numbers) and emit() flushes them through a JSONL sink next to
+# the BENCH_*.json artifacts (APEX_TPU_METRICS_PATH overrides). All
+# best-effort: telemetry must never cost the bench its one JSON line.
+_OBS_REG = None
+
+
+def _obs():
+    global _OBS_REG
+    if _OBS_REG is None:
+        from apex_tpu.observability import MetricsRegistry
+
+        _OBS_REG = MetricsRegistry(enabled=True)
+    return _OBS_REG
+
+
+def _obs_gauge(name: str, value, **labels) -> None:
+    try:
+        _obs().gauge(name).set(float(value), **labels)
+    except Exception as e:  # noqa: BLE001 — telemetry is best-effort
+        print(f"bench: metrics record failed: {e}", file=sys.stderr)
+
+
+def _obs_row(row: dict) -> None:
+    rung = f"b{row.get('batch')}@{row.get('remat')}"
+    for k in ("samples_per_sec", "step_ms", "mfu", "compile_s"):
+        if row.get(k) is not None:
+            _obs_gauge(f"bench/{k}", row[k], rung=rung)
+
+
+def _obs_flush() -> None:
+    # only if something recorded: the early error paths run before jax
+    # (and so before observability) is safely importable
+    if _OBS_REG is None:
+        return
+    try:
+        from apex_tpu.observability import JSONLSink, flush_metrics
+
+        path = os.environ.get("APEX_TPU_METRICS_PATH") \
+            or "BENCH_METRICS.jsonl"
+        flush_metrics(_OBS_REG, JSONLSink(path))
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: metrics flush failed: {e}", file=sys.stderr)
+
+
 def emit(payload: dict) -> None:
+    if _OBS_REG is not None:
+        _obs_gauge(f"bench/{payload.get('metric')}",
+                   payload.get("value", 0.0),
+                   ok=str(bool(payload.get("ok"))))
+        _obs_flush()
     print(json.dumps(payload), flush=True)
 
 
@@ -290,6 +342,12 @@ def _compile_with_timeout(step, args, timeout_s):
 
 def _compile_only_payload(rungs, kernels):
     ok_count = sum(1 for r in rungs if r.get("ok"))
+    for r in rungs:
+        name = r.get("rung") or f"b{r.get('batch')}@{r.get('remat')}"
+        _obs_gauge("bench/compile_rung_ok", 1.0 if r.get("ok") else 0.0,
+                   rung=str(name))
+        if r.get("compile_s") is not None:
+            _obs_gauge("bench/compile_s", r["compile_s"], rung=str(name))
     return {
         "metric": _COMPILE_METRIC,
         "value": float(ok_count),
@@ -385,6 +443,10 @@ def _serving_payload(on_cpu: bool) -> dict:
     stats = out.pop(None)
     ttfts = sorted(v["ttft_s"] for v in out.values())
     decode_sps = stats["decode_steps"] / max(stats["decode_s"], 1e-9)
+    _obs_gauge("bench/serving_decode_steps_per_sec", decode_sps)
+    _obs_gauge("bench/serving_ttft_mean_s", sum(ttfts) / len(ttfts))
+    _obs_gauge("bench/serving_ttft_p95_s",
+               ttfts[int(0.95 * (len(ttfts) - 1))])
     return {
         "metric": _SERVING_METRIC,
         "value": round(decode_sps, 2),
@@ -511,6 +573,8 @@ def _moe_payload(on_cpu: bool) -> dict:
         dt = (time.perf_counter() - t0) / iters
         rows[name] = {"tokens_per_sec": round(t / dt, 1),
                       "step_ms": round(dt * 1e3, 3)}
+        _obs_gauge("bench/moe_tokens_per_sec", rows[name]["tokens_per_sec"],
+                   path=name)
     speedup = rows["dropless"]["tokens_per_sec"] / max(
         rows["einsum"]["tokens_per_sec"], 1e-9)
     return {
@@ -530,6 +594,56 @@ def _moe_payload(on_cpu: bool) -> dict:
             },
         },
     }
+
+
+def _obs_compile_rung(on_cpu: bool, timeout_s: float) -> dict:
+    """Dry-compile a train step that carries a MetricsBuffer in its state
+    (the device side of the telemetry bridge): accumulate(step_metrics())
+    must lower and compile like any other rung, so an observability
+    regression costs seconds in the gate, not the measurement window."""
+    import jax.numpy as jnp  # noqa: F811 — bench defers jax-heavy imports
+
+    from apex_tpu.observability import accumulate, init_buffer
+    from apex_tpu.utils.metrics import step_metrics
+
+    rung = {"rung": "observability", "batch": None, "remat": "observability"}
+    try:
+        n = 128 if on_cpu else 1024
+        w = jnp.ones((n, n), jnp.float32)
+        x = jnp.ones((32, n), jnp.float32)
+
+        def loss(w):
+            return jnp.sum((x @ w) ** 2)
+
+        buf = init_buffer(step_metrics(loss=jnp.float32(0),
+                                       grads={"w": w}))
+
+        def step(w, buf):
+            val, g = jax.value_and_grad(loss)(w)
+            buf = accumulate(buf, step_metrics(loss=val, grads={"w": g}))
+            return w - 1e-3 * g, buf
+
+        compile_s, err = _compile_with_timeout(jax.jit(step), (w, buf),
+                                               timeout_s)
+        if err is not None:
+            msg = ("compile hung" if err == "hung"
+                   else f"{type(err).__name__}: "
+                        f"{str(err).splitlines()[0][:200]}")
+            print(f"bench: compile-only rung observability: FAILED — "
+                  f"marked skipped ({msg})", file=sys.stderr, flush=True)
+            rung.update(ok=False, skipped=True, error=msg)
+        else:
+            print(f"bench: compile-only rung observability: OK "
+                  f"({compile_s:.1f}s)", file=sys.stderr, flush=True)
+            rung.update(ok=True, compile_s=round(compile_s, 1))
+    except Exception as e:  # noqa: BLE001 — a failing rung is data
+        print(f"bench: compile-only rung observability: FAILED — marked "
+              f"skipped ({type(e).__name__}: "
+              f"{str(e).splitlines()[0][:200]})", file=sys.stderr,
+              flush=True)
+        rung.update(ok=False, skipped=True,
+                    error=str(e).splitlines()[0][:200])
+    return rung
 
 
 def _moe_compile_rungs(on_cpu: bool, timeout_s: float) -> list:
@@ -932,6 +1046,7 @@ def main():
         row["config"] = "toy-cpu" if on_cpu else "bert-large"
         row["remat"] = remat_name
         sweep.append(row)
+        _obs_row(row)
         if best is None or row["samples_per_sec"] > best["samples_per_sec"]:
             best = row
             _SO_FAR["best"] = row
@@ -945,6 +1060,7 @@ def main():
         gate_timeout = float(os.environ.get("BENCH_BATCH_TIMEOUT_S", "900"))
         compile_rungs.append(_serving_compile_rung(on_cpu, gate_timeout))
         compile_rungs.extend(_moe_compile_rungs(on_cpu, gate_timeout))
+        compile_rungs.append(_obs_compile_rung(on_cpu, gate_timeout))
         emit(_compile_only_payload(compile_rungs, kernel_report))
         return
 

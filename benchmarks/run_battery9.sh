@@ -81,6 +81,16 @@ run serving_bench 3600 '"ok": true' python bench.py --serving
 #      apex_tpu_moe_tokens_per_sec. The three jitted steps already ride
 #      the compile-only gate above as their own "moe" rung.
 run moe_bench     3600 '"ok": true' python bench.py --moe
+# 4e — observability smoke (telemetry PR): one DDP train step with the
+#      MetricsBuffer bridge + goodput tracker and a 3-request serving
+#      run, JSONL sink enabled, emitted records validated
+#      (__graft_entry__.dryrun_telemetry pins the CPU host mesh — no
+#      tunnel time beyond python startup). The MetricsBuffer train step
+#      also rides the overlap_gate compile-only item above as its own
+#      "observability" rung.
+run obs_smoke     1800 'telemetry leg: OK' env \
+                       XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+                       python -c 'import __graft_entry__ as g; g.dryrun_telemetry(8)'
 # 5 — the WHOLE tpu tier in one invocation (19/19 + 5/5 goal)
 run tpu_full      3600 ' passed' env APEX_TPU_HW=1 python -m pytest tests/tpu -v
 # 6 — warm the driver's exact path last
