@@ -1,0 +1,27 @@
+"""apex_tpu.analysis — static correctness tooling for the library itself.
+
+Three layers, one finding vocabulary, one CLI
+(``python -m apex_tpu.analysis``):
+
+* :mod:`apex_tpu.analysis.lint` — AST trace-hygiene linter (APX1xx):
+  env reads frozen at import, ad-hoc env parsing, host syncs in jitted
+  code, decorators without ``functools.wraps``, truthiness on traced
+  values.
+* :mod:`apex_tpu.analysis.auditors` — jaxpr auditors (APX2xx): donated
+  buffers referenced after donation, argument-signature drift that
+  retraces, collective/axis consistency over shard_map programs.
+* :mod:`apex_tpu.analysis.sanitizer` — Pallas kernel sanitizer (APX3xx):
+  BlockSpec/grid divisibility, VMEM budgets, index-map bounds at grid
+  corners, and the grouped-matmul revisit-chain replay — over every
+  registered tunable family's full candidate space.
+
+The analyzer is **self-hosted**: a tier-1 test runs it over the package
+and pins zero unsuppressed findings, so the suite lints every future PR.
+Suppress a reviewed site inline with ``# apexlint: disable=APX101`` (and
+a comment saying why). See docs/analysis.md for the rule catalog.
+"""
+
+from apex_tpu.analysis.findings import Finding, Rule, RULES  # noqa: F401
+from apex_tpu.analysis.cli import run  # noqa: F401
+
+__all__ = ["Finding", "Rule", "RULES", "run"]

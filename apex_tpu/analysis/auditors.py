@@ -1,0 +1,381 @@
+"""Jaxpr auditors (rules APX201-APX203): trace representative entry
+points and check invariants the type system cannot.
+
+Everything here works on ``jax.make_jaxpr`` output — tracing only, no
+compile, no devices beyond what the trace itself needs — so the audits
+run in seconds on CPU and are deterministic across backends.
+
+Three checks:
+
+* **APX201 use-after-donation** — walk a composite jaxpr (a host-level
+  harness that calls a donating jitted step); for every ``pjit`` equation
+  with ``donated_invars``, the donated operands must not be consumed by
+  any later equation or escape as outputs. This is the
+  ``observability/bridge.py`` double-buffer hazard class, checked
+  statically: the drainer must hand the *replacement* buffer to the next
+  donated step, never the one it kicked a transfer on.
+
+* **APX202 signature-drift** — trace the same entry with the "step 0"
+  and "step N" argument builders and require identical input avals
+  (shape, dtype, **weak_type**). A python ``1.0`` where step 0 passed
+  ``np.float32`` retraces every call — goodput.py catches it at runtime
+  via trace counters; this is the static complement.
+
+* **APX203 collective-consistency** — recursively walk every equation
+  (descending into ``pjit``/``shard_map``/control-flow sub-jaxprs):
+  collective primitives may only name axes the entry point declared
+  (mesh axes + shard_map binds), and every ``ppermute`` permutation must
+  be replica-consistent: sources unique, destinations unique, all ranks
+  in range. On hardware an inconsistent permutation deadlocks or
+  silently corrupts — it never raises.
+
+Entry points are :class:`EntryPoint` records; :func:`default_entry_points`
+builds the repo's representative set (train step, DDP bucket flush, ZeRO
+scatter flush, decomposed TP matmul, serving paged decode) sized to
+trace in well under a minute on CPU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.analysis.findings import Finding
+
+__all__ = ["EntryPoint", "audit_entry_point", "audit_entry_points",
+           "audit_donation", "audit_signature_drift", "audit_collectives",
+           "default_entry_points"]
+
+_COLLECTIVES = {"psum", "ppermute", "pbroadcast", "all_gather",
+                "all_to_all", "reduce_scatter", "psum_scatter", "pmax",
+                "pmin", "axis_index"}
+
+
+@dataclass
+class EntryPoint:
+    """One auditable program: ``fn(*args())`` must trace under
+    ``jax.make_jaxpr``. ``args_variant`` (optional) is the "step N"
+    argument builder for the drift check; ``axis_sizes`` the mesh axes
+    the program may legally name."""
+
+    name: str
+    fn: Callable
+    args: Callable[[], tuple]
+    args_variant: Optional[Callable[[], tuple]] = None
+    axis_sizes: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def tag(self) -> str:
+        return f"<audit:{self.name}>"
+
+
+# ---------------------------------------------------------------------------
+# APX201 — donated operand referenced after the donating call
+# ---------------------------------------------------------------------------
+
+def _donating_eqns(jaxpr):
+    for i, eqn in enumerate(jaxpr.eqns):
+        donated = eqn.params.get("donated_invars")
+        if donated and any(donated):
+            yield i, eqn, donated
+
+
+def audit_donation(closed_jaxpr, tag: str) -> List[Finding]:
+    """Donated invars of inner pjit equations must be dead afterwards."""
+    import jax.core as _core  # Literal lives here across 0.4.x
+
+    findings: List[Finding] = []
+    jaxpr = closed_jaxpr.jaxpr
+    for i, eqn, donated in _donating_eqns(jaxpr):
+        # scalar-prefetch style prefixes can offset donated_invars from
+        # invars; align from the right, the way pjit binds them
+        invars = eqn.invars[-len(donated):]
+        for dflag, var in zip(donated, invars):
+            if not dflag or isinstance(var, getattr(_core, "Literal", ())):
+                continue
+            used_later = any(
+                var in later.invars for later in jaxpr.eqns[i + 1:])
+            escapes = var in jaxpr.outvars
+            if used_later or escapes:
+                how = ("consumed by a later equation" if used_later
+                       else "returned as an output")
+                findings.append(Finding(
+                    "APX201", tag, 0,
+                    f"value donated to {eqn.params.get('name', '?')!r} is "
+                    f"{how} — the buffer may alias the callee's outputs; "
+                    f"carry the callee's replacement value instead "
+                    f"(the bridge double-buffer discipline)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# APX202 — argument-signature drift between "identical" steps
+# ---------------------------------------------------------------------------
+
+def _aval_token(aval) -> str:
+    weak = getattr(aval, "weak_type", False)
+    return f"{getattr(aval, 'str_short', lambda: str(aval))()}" + (
+        "~weak" if weak else "")
+
+
+def audit_signature_drift(fn, args0: tuple, args1: tuple, tag: str,
+                          jaxpr0=None) -> List[Finding]:
+    """``jaxpr0`` (optional) is a ClosedJaxpr already traced from
+    ``args0`` — the entry-point driver passes the one it has so the
+    expensive trace is not repeated."""
+    import jax
+
+    j0 = jaxpr0 if jaxpr0 is not None else jax.make_jaxpr(fn)(*args0)
+    j1 = jax.make_jaxpr(fn)(*args1)
+    a0 = [_aval_token(v.aval) for v in j0.jaxpr.invars]
+    a1 = [_aval_token(v.aval) for v in j1.jaxpr.invars]
+    findings: List[Finding] = []
+    if a0 != a1:
+        drift = [f"arg {i}: {x} -> {y}"
+                 for i, (x, y) in enumerate(zip(a0, a1)) if x != y]
+        if len(a0) != len(a1):
+            drift.append(f"arity {len(a0)} -> {len(a1)}")
+        findings.append(Finding(
+            "APX202", tag, 0,
+            "argument avals drift between step variants — every such "
+            "call retraces and recompiles (" + "; ".join(drift) + ")"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# APX203 — collective consistency over shard_map jaxprs
+# ---------------------------------------------------------------------------
+
+def _sub_jaxprs(eqn):
+    for key, val in eqn.params.items():
+        vals = val if isinstance(val, (list, tuple)) else (val,)
+        for v in vals:
+            if hasattr(v, "jaxpr"):        # ClosedJaxpr
+                yield v.jaxpr
+            elif hasattr(v, "eqns"):       # raw Jaxpr
+                yield v
+
+
+def _walk_eqns(jaxpr, axis_sizes: Dict[str, int], out: list):
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in _COLLECTIVES or name.startswith(("psum", "ppermute",
+                                                    "all_gather",
+                                                    "all_to_all",
+                                                    "reduce_scatter")):
+            out.append((eqn, dict(axis_sizes)))
+        scope = dict(axis_sizes)
+        mesh = eqn.params.get("mesh")
+        if mesh is not None and hasattr(mesh, "shape"):
+            try:
+                scope.update({str(k): int(v)
+                              for k, v in dict(mesh.shape).items()})
+            except Exception:
+                pass
+        for sub in _sub_jaxprs(eqn):
+            _walk_eqns(sub, scope, out)
+
+
+def _axes_of(eqn) -> Tuple[str, ...]:
+    for key in ("axes", "axis_name", "axis"):
+        v = eqn.params.get(key)
+        if v is None:
+            continue
+        if isinstance(v, (tuple, list)):
+            return tuple(a for a in v if isinstance(a, str))
+        if isinstance(v, str):
+            return (v,)
+    return ()
+
+
+def audit_collectives(closed_jaxpr, axis_sizes: Dict[str, int],
+                      tag: str) -> List[Finding]:
+    findings: List[Finding] = []
+    eqns: list = []
+    _walk_eqns(closed_jaxpr.jaxpr, dict(axis_sizes), eqns)
+    for eqn, scope in eqns:
+        prim = eqn.primitive.name
+        for ax in _axes_of(eqn):
+            if ax not in scope:
+                findings.append(Finding(
+                    "APX203", tag, 0,
+                    f"{prim} names axis {ax!r} but the entry point "
+                    f"declares only {sorted(scope) or '(no axes)'} — "
+                    f"an unbound collective axis"))
+        if prim == "ppermute":
+            perm = eqn.params.get("perm") or ()
+            axes = _axes_of(eqn)
+            n = scope.get(axes[0]) if axes and axes[0] in scope else None
+            srcs = [s for s, _ in perm]
+            dsts = [d for _, d in perm]
+            if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+                findings.append(Finding(
+                    "APX203", tag, 0,
+                    f"ppermute permutation {list(perm)} has duplicate "
+                    f"sources or destinations — not replica-consistent "
+                    f"(deadlocks or corrupts on hardware)"))
+            elif n is not None and any(
+                    not (0 <= r < n) for r in srcs + dsts):
+                findings.append(Finding(
+                    "APX203", tag, 0,
+                    f"ppermute permutation {list(perm)} references ranks "
+                    f"outside [0, {n}) on axis {axes[0]!r}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry-point driver
+# ---------------------------------------------------------------------------
+
+def audit_entry_point(ep: EntryPoint) -> List[Finding]:
+    import jax
+
+    findings: List[Finding] = []
+    try:
+        args0 = ep.args()
+        closed = jax.make_jaxpr(ep.fn)(*args0)
+    except Exception as e:  # noqa: BLE001 — a broken entry point is data
+        findings.append(Finding(
+            "APX202", ep.tag, 0,
+            f"entry point failed to trace: {type(e).__name__}: {e}"))
+        return findings
+    findings.extend(audit_donation(closed, ep.tag))
+    findings.extend(audit_collectives(closed, ep.axis_sizes, ep.tag))
+    if ep.args_variant is not None:
+        findings.extend(audit_signature_drift(
+            ep.fn, args0, ep.args_variant(), ep.tag, jaxpr0=closed))
+    return findings
+
+
+def audit_entry_points(eps: Optional[Sequence[EntryPoint]] = None
+                       ) -> List[Finding]:
+    if eps is None:
+        eps = default_entry_points()
+    findings: List[Finding] = []
+    for ep in eps:
+        findings.extend(audit_entry_point(ep))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# the repo's representative entry points
+# ---------------------------------------------------------------------------
+
+def default_entry_points() -> List[EntryPoint]:
+    """Small-but-real programs covering the subsystems the auditors were
+    built for. Shapes are deliberately tiny: make_jaxpr cost only."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import apex_tpu  # noqa: F401 — installs the jax.shard_map compat shim
+    shard_map = jax.shard_map
+
+    eps: List[EntryPoint] = []
+
+    # -- 1. train step: toy transformer loss + grads + sgd, donated ----
+    # the testing transformer is tensor-parallel by construction (vocab-
+    # parallel embedding psums over "model"), so the loss runs under a
+    # size-1 "model" shard_map exactly like the L0 model tests do
+    from apex_tpu.parallel.mesh import cpu_mesh
+    from apex_tpu.testing import (TransformerConfig, bert_loss,
+                                  param_specs, smap, transformer_init)
+
+    cfg = TransformerConfig(vocab_size=64, seq_len=16, hidden=32,
+                            layers=1, heads=2, causal=False,
+                            dtype=jnp.float32)
+    params0 = transformer_init(jax.random.PRNGKey(0), cfg)
+    tp_mesh1 = cpu_mesh({"model": 1})
+
+    def _loss(p, tokens, labels, mask):
+        return smap(
+            lambda p_, t_, l_, m_: bert_loss(p_, t_, l_, m_, cfg),
+            tp_mesh1, (param_specs(cfg), P(), P(), P()), P(),
+        )(p, tokens, labels, mask)
+
+    step = jax.jit(
+        lambda p, tokens, labels, mask: jax.tree.map(
+            lambda w, g: w - 1e-3 * g, p,
+            jax.grad(_loss)(p, tokens, labels, mask)),
+        donate_argnums=0)
+
+    def train_harness(p, tokens, labels, mask):
+        # the CORRECT protocol: carry the returned params, never touch
+        # the donated operand again
+        return step(p, tokens, labels, mask)
+
+    def _train_args(label_dtype=np.int32):
+        tokens = np.zeros((2, cfg.seq_len), np.int32)
+        labels = np.zeros((2, cfg.seq_len), label_dtype)
+        mask = np.ones((2, cfg.seq_len), bool)
+        return (params0, tokens, labels, mask)
+
+    eps.append(EntryPoint(
+        name="train_step", fn=train_harness, args=_train_args,
+        args_variant=_train_args, axis_sizes={"model": 1}))
+
+    # -- 2. DDP bucket flush: psum mean over the data axis -------------
+    n = max(1, len(jax.devices()))
+    mesh = Mesh(np.array(jax.devices()[:n]), ("data",))
+
+    def ddp_flush(g):
+        f = shard_map(
+            lambda x: jax.lax.psum(x, "data") / n,
+            mesh=mesh, in_specs=(P("data"),), out_specs=P("data"))
+        return f(g)
+
+    eps.append(EntryPoint(
+        name="ddp_bucket_flush", fn=ddp_flush,
+        args=lambda: (np.ones((n * 2, 8), np.float32),),
+        axis_sizes={"data": n}))
+
+    # -- 3. ZeRO scatter flush: psum_scatter over the flat bucket ------
+    def zero_flush(g):
+        f = shard_map(
+            lambda x: jax.lax.psum_scatter(x, "data", scatter_dimension=0,
+                                           tiled=True),
+            mesh=mesh, in_specs=(P(),), out_specs=P("data"))
+        return f(g)
+
+    eps.append(EntryPoint(
+        name="zero_scatter_flush", fn=zero_flush,
+        args=lambda: (np.ones((n * 4,), np.float32),),
+        axis_sizes={"data": n}))
+
+    # -- 4. decomposed TP collective matmul (the ppermute ring) --------
+    from apex_tpu.parallel import overlap
+
+    tp_mesh = Mesh(np.array(jax.devices()[:n]), ("tp",))
+
+    def tp_ring(x, w):
+        f = shard_map(
+            lambda xs, ws: overlap.all_gather_matmul(xs, ws, "tp", 0, 2),
+            mesh=tp_mesh, in_specs=(P("tp"), P()), out_specs=P("tp"))
+        return f(x, w)
+
+    eps.append(EntryPoint(
+        name="overlap_tp_matmul", fn=tp_ring,
+        args=lambda: (np.ones((n * 2, 8), np.float32),
+                      np.ones((8, 8), np.float32)),
+        axis_sizes={"tp": n}))
+
+    # -- 5. serving paged decode (jnp oracle path; dtype-drift pinned) -
+    from apex_tpu.ops.paged_attention import paged_attention_ref
+
+    def decode(q, kp, vp, tables, lengths):
+        return paged_attention_ref(q, kp, vp, tables, lengths)
+
+    def _decode_args(len_dtype=np.int32):
+        q = np.zeros((2, 4, 16), np.float32)
+        kp = np.zeros((8, 4, 2, 16), np.float32)
+        vp = np.zeros((8, 4, 2, 16), np.float32)
+        tables = np.zeros((2, 3), np.int32)
+        lengths = np.array([5, 0], len_dtype)
+        return (q, kp, vp, tables, lengths)
+
+    eps.append(EntryPoint(
+        name="serving_paged_decode", fn=jax.jit(decode),
+        args=_decode_args, args_variant=_decode_args))
+
+    return eps

@@ -1,0 +1,147 @@
+"""``python -m apex_tpu.analysis`` — run the three layers over a target.
+
+Usage::
+
+    python -m apex_tpu.analysis [PATHS...]        # default: the installed
+                                                  # apex_tpu package
+        --json                  machine-readable report on stdout
+        --no-lint / --no-audit / --no-sanitize
+                                skip a layer (default: all three run)
+        --full-sweep            exhaustive tunable-space sanitize (the
+                                `slow` CI lane; default is a seeded
+                                subsample per family)
+        --seed N                subsample seed (default 0)
+        --sample N              subsample size per family (default 24)
+        --strict                promote warn -> error (also via
+                                APEX_TPU_ANALYSIS_STRICT=1)
+        --show-suppressed       include pragma-suppressed findings in the
+                                text report
+        --list-rules            print the rule catalog and exit
+
+Exit codes are per-rule-layer bits: 1 = lint findings (APX1xx), 2 =
+auditor findings (APX2xx), 4 = sanitizer findings (APX3xx), OR-ed; 0 =
+clean. 64 = internal error. Per-rule counts ride the JSON report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from apex_tpu.analysis.findings import (
+    RULES,
+    Finding,
+    summarize,
+)
+from apex_tpu.utils.envvars import env_flag
+
+
+def _default_target() -> List[str]:
+    import apex_tpu
+
+    return [os.path.dirname(os.path.abspath(apex_tpu.__file__))]
+
+
+def run(paths: Optional[List[str]] = None, *, lint: bool = True,
+        audit: bool = True, sanitize: bool = True, full_sweep: bool = False,
+        seed: int = 0, sample: int = 24, strict: Optional[bool] = None
+        ) -> dict:
+    """Programmatic entry (the tier-1 self-run test and the graft leg
+    call this): returns the full report dict incl. findings + exit
+    code."""
+    if strict is None:
+        strict = bool(env_flag("APEX_TPU_ANALYSIS_STRICT", default=False))
+    findings: List[Finding] = []
+    stats: dict = {}
+    root = None
+    if lint:
+        from apex_tpu.analysis.lint import iter_py_files, lint_paths
+
+        targets = paths or _default_target()
+        root = os.path.commonpath([os.path.abspath(p) for p in targets]) \
+            if targets else None
+        if root is not None and os.path.isfile(root):
+            root = os.path.dirname(root)
+        findings.extend(lint_paths(targets, root))
+        stats["lint_files"] = len(iter_py_files(targets))
+    if audit:
+        from apex_tpu.analysis.auditors import (audit_entry_points,
+                                                default_entry_points)
+
+        eps = default_entry_points()
+        findings.extend(audit_entry_points(eps))
+        stats["audited_entry_points"] = len(eps)
+    if sanitize:
+        from apex_tpu.analysis.sanitizer import sanitize_families
+
+        san_findings, san_stats = sanitize_families(
+            full=full_sweep, seed=seed, sample=sample)
+        findings.extend(san_findings)
+        stats["sanitize"] = san_stats
+    report = summarize(findings, strict=strict)
+    report["strict"] = strict
+    report["stats"] = stats
+    report["findings"] = findings
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.analysis",
+        description="apex_tpu static analysis: trace-hygiene lint + "
+                    "jaxpr auditors + Pallas kernel sanitizer")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the apex_tpu "
+                         "package)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--no-lint", action="store_false", dest="lint")
+    ap.add_argument("--no-audit", action="store_false", dest="audit")
+    ap.add_argument("--no-sanitize", action="store_false", dest="sanitize")
+    ap.add_argument("--full-sweep", action="store_true")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sample", type=int, default=24)
+    ap.add_argument("--strict", action="store_true", default=None)
+    ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES.values():
+            print(f"{rule.id}  {rule.name}  [{rule.severity}]")
+            print(f"    {rule.doc}")
+        return 0
+
+    try:
+        report = run(args.paths or None, lint=args.lint, audit=args.audit,
+                     sanitize=args.sanitize, full_sweep=args.full_sweep,
+                     seed=args.seed, sample=args.sample,
+                     strict=args.strict)
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"apex_tpu.analysis: internal error: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 64
+
+    findings = report.pop("findings")
+    if args.as_json:
+        report["findings"] = [f.to_json() for f in findings]
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return report["exit_code"]
+
+    shown = 0
+    for f in findings:
+        if f.suppressed and not args.show_suppressed:
+            continue
+        if f.severity == "info":
+            continue
+        print(f.format())
+        shown += 1
+    info = sum(1 for f in findings
+               if f.severity == "info" and not f.suppressed)
+    print(f"apex_tpu.analysis: {report['errors']} finding(s), "
+          f"{report['suppressed']} suppressed, {info} info; "
+          f"exit {report['exit_code']}"
+          + (" [strict]" if report["strict"] else ""))
+    return report["exit_code"]

@@ -1,0 +1,228 @@
+"""Finding model + rule catalog + pragma handling for apex_tpu.analysis.
+
+One vocabulary for all three layers (AST linter, jaxpr auditors, Pallas
+kernel sanitizer): a :class:`Finding` is (rule, file, line, message,
+severity), a :class:`Rule` is the catalog entry behind it, and pragmas
+(``# apexlint: disable=APX101`` / ``disable=APX101,APX104`` /
+``disable=all``, inline on the offending line) suppress findings without
+deleting the evidence that a human looked.
+
+Severities:
+
+* ``error`` — a violated invariant; fails the CLI (exit-code bit of the
+  rule's layer).
+* ``warn``  — suspicious but sometimes legitimate; fails only under
+  ``APEX_TPU_ANALYSIS_STRICT=1`` (or ``--strict``).
+* ``info``  — inventory/telemetry (e.g. tunable-space candidates the
+  cost model itself would reject); never fails.
+
+Rule IDs are stable API: APX1xx = trace-hygiene lint, APX2xx = jaxpr
+auditors, APX3xx = kernel sanitizer. The catalog is the single source
+for ``--list-rules`` and docs/analysis.md.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = ["Finding", "Rule", "RULES", "Pragmas", "layer_bit"]
+
+ERROR = "error"
+WARN = "warn"
+INFO = "info"
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    severity: str
+    doc: str
+
+
+RULES: Dict[str, Rule] = {
+    r.id: r
+    for r in (
+        # ---- APX1xx: AST trace-hygiene lint --------------------------
+        Rule("APX101", "env-read-at-import", ERROR,
+             "os.environ / getenv read at module scope: the value is "
+             "frozen at import time, so a knob flipped between imports "
+             "and traces is silently ignored inside jitted/kernel code "
+             "(the PR-3 utils/profiling.py bug class). Re-read at call "
+             "time, or pragma a genuinely import-time site with a "
+             "comment saying why."),
+        Rule("APX102", "raw-env-parse", ERROR,
+             "int()/float() over an env read, or comparison of an env "
+             "read against '0'/'1', outside utils/envvars.py: use "
+             "env_int / env_flag so a malformed APEX_TPU_* value raises "
+             "an error naming the variable instead of a bare ValueError "
+             "deep in kernel code (or a typo silently meaning 'off')."),
+        Rule("APX103", "host-sync-in-jit", ERROR,
+             ".item(), jax.device_get, np.asarray/np.array, or float() "
+             "of a traced argument inside a jitted function or Pallas "
+             "kernel body: forces a device sync (or a trace-time "
+             "ConcretizationError) in a hot path. Move the readback to "
+             "the host loop (observability.bridge drains asynchronously) "
+             "or pragma a deliberate sync point."),
+        Rule("APX104", "missing-functools-wraps", ERROR,
+             "a decorator's inner wrapper (*args/**kwargs closure "
+             "calling the wrapped callable) lacks functools.wraps: the "
+             "wrapped function loses its name/docstring/signature (the "
+             "PR-5 profiling.annotate bug class)."),
+        Rule("APX105", "traced-truthiness", ERROR,
+             "Python bool() of a jnp expression (if/while/assert/and/or "
+             "directly on a jnp.* call or comparison) inside a jitted "
+             "function or kernel body: raises TracerBoolConversionError "
+             "at trace time, or silently freezes a data-dependent branch "
+             "if the value is concrete during tracing. Use lax.cond / "
+             "jnp.where / pl.when."),
+        # ---- APX2xx: jaxpr auditors ----------------------------------
+        Rule("APX201", "use-after-donation", ERROR,
+             "a value passed into a donated argument slot of a jitted "
+             "call is referenced again afterwards (later equation or "
+             "returned output): the buffer may already be aliased to the "
+             "callee's outputs — the observability/bridge.py "
+             "double-buffer hazard class."),
+        Rule("APX202", "signature-drift-retrace", ERROR,
+             "two argument sets that the caller treats as 'the same "
+             "step' trace to different input avals (dtype / weak_type / "
+             "shape drift): every such call retraces and recompiles, "
+             "the compile-time leak goodput.py detects at runtime — "
+             "this is the static pin."),
+        Rule("APX203", "collective-inconsistency", ERROR,
+             "a collective (psum / psum_scatter / ppermute / all_gather "
+             "/ all_to_all) names an axis missing from the declared "
+             "mesh, or a ppermute permutation is not replica-consistent "
+             "(duplicate sources/destinations or out-of-range ranks) — "
+             "the quantized_collectives/overlap invariant; on hardware "
+             "this deadlocks or corrupts, it does not error."),
+        # ---- APX3xx: Pallas kernel sanitizer -------------------------
+        Rule("APX301", "blockspec-divisibility", ERROR,
+             "grid x block does not tile the (padded) array exactly: "
+             "uncovered trailing blocks are emitted as garbage, "
+             "overhanging blocks read out of bounds. Every registered "
+             "tunable candidate must tile exactly or be rejected by the "
+             "registry's validity check."),
+        Rule("APX302", "vmem-budget", ERROR,
+             "the kernel's projected VMEM residency (block tiles + "
+             "scratch, double-buffered) exceeds the device's scoped "
+             "VMEM budget for a configuration the resolution chain "
+             "would actually pick (cost-model default or env-reachable "
+             "without rejection)."),
+        Rule("APX303", "indexmap-bounds", ERROR,
+             "a BlockSpec index map evaluated at a grid corner selects "
+             "a block outside the (padded) operand: the DMA reads or "
+             "writes out of bounds. Ragged index maps must clamp "
+             "(jnp.minimum / jnp.clip) exactly like the shipped "
+             "kernels do."),
+        Rule("APX304", "revisit-chain-race", ERROR,
+             "the revisit-chain accumulator protocol is violated for "
+             "some group distribution: an accumulate lands on an "
+             "uninitialized scratch (missed init), a tile's chain is "
+             "never flushed (garbage out), a tile is revisited after "
+             "its flush (write race), or a sentinel work item emits."),
+        Rule("APX305", "candidate-rejected", INFO,
+             "a tunable-space candidate is rejected by the registry "
+             "check or projected over the VMEM budget — inventory of "
+             "the space the autotuner must not sweep on this device; "
+             "never fails the run."),
+    )
+}
+
+
+def layer_bit(rule_id: str) -> int:
+    """Exit-code bit of a rule: lint (APX1xx) -> 1, auditors (APX2xx) ->
+    2, sanitizer (APX3xx) -> 4. The CLI exit code is the OR of the bits
+    of every rule with unsuppressed error-severity findings."""
+    if rule_id.startswith("APX1"):
+        return 1
+    if rule_id.startswith("APX2"):
+        return 2
+    return 4
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str                       # file, or pseudo-path like "<audit:...>"
+    line: int                       # 1-based; 0 = whole-file/entry finding
+    message: str
+    severity: str = ""              # defaults to the rule's catalog severity
+    suppressed: bool = False
+
+    def __post_init__(self):
+        if not self.severity:
+            self.severity = RULES[self.rule].severity
+
+    def format(self) -> str:
+        sup = " [suppressed]" if self.suppressed else ""
+        return (f"{self.path}:{self.line}: {self.rule} "
+                f"({RULES[self.rule].name}){sup}: {self.message}")
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "name": RULES[self.rule].name,
+            "path": self.path,
+            "line": self.line,
+            "severity": self.severity,
+            "suppressed": self.suppressed,
+            "message": self.message,
+        }
+
+
+_PRAGMA_RE = re.compile(r"#\s*apexlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+class Pragmas:
+    """Per-file inline suppression table: line -> set of rule ids (or
+    {"all"}). Built once per source file from the raw text, consulted by
+    every layer that can attribute a finding to a line."""
+
+    def __init__(self, source: str):
+        self.by_line: Dict[int, set] = {}
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = _PRAGMA_RE.search(text)
+            if m:
+                rules = {r.strip().upper() for r in m.group(1).split(",")
+                         if r.strip()}
+                self.by_line[i] = {"ALL" if r == "ALL" else r for r in rules}
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.by_line.get(line)
+        if not rules:
+            return False
+        return "ALL" in rules or rule.upper() in rules
+
+    def apply(self, findings: List[Finding]) -> List[Finding]:
+        for f in findings:
+            if self.suppressed(f.rule, f.line):
+                f.suppressed = True
+        return findings
+
+
+def summarize(findings: List[Finding], *, strict: bool = False) -> dict:
+    """Counts + exit code for a finding list. ``strict`` promotes warn ->
+    error (the APEX_TPU_ANALYSIS_STRICT semantics)."""
+    per_rule: Dict[str, int] = {}
+    exit_code = 0
+    n_err = n_sup = 0
+    for f in findings:
+        if f.suppressed:
+            n_sup += 1
+            continue
+        per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+        sev = f.severity
+        if strict and sev == WARN:
+            sev = ERROR
+        if sev == ERROR:
+            n_err += 1
+            exit_code |= layer_bit(f.rule)
+    return {
+        "per_rule": dict(sorted(per_rule.items())),
+        "errors": n_err,
+        "suppressed": n_sup,
+        "exit_code": exit_code,
+    }

@@ -1,0 +1,468 @@
+"""AST trace-hygiene linter (rules APX101-APX105).
+
+Pure-stdlib static analysis over the package source — no jax import, no
+tracing, so the whole-package self-run costs well under a second and can
+gate every PR. Each rule encodes one bug class a previous PR shipped and
+hand-fixed:
+
+* APX101 — env values frozen at import time inside trace paths (the
+  PR-3 ``utils/profiling.py`` fix).
+* APX102 — ad-hoc ``int(os.environ...)`` / ``== "1"`` knob parsing
+  (unified into ``utils/envvars.py`` by this PR).
+* APX103 — host syncs (``.item()``, ``jax.device_get``, ``np.asarray``,
+  ``float(arg)``) inside jitted functions / kernel bodies.
+* APX104 — decorators whose wrapper closure lacks ``functools.wraps``
+  (the PR-5 ``profiling.annotate`` fix).
+* APX105 — Python truthiness on jnp expressions inside traced code.
+
+"Jitted" is decided statically: a function is **hot** when it is
+decorated with ``jax.jit``/``pjit`` (bare or via ``functools.partial``),
+passed to ``jax.jit(...)`` anywhere in the same module, passed to
+``pl.pallas_call`` (directly or through ``functools.partial``), or named
+in :data:`HOT_PATHS`. Everything else is host code, where syncs are the
+point (the drainer's harvest, the engine's scheduler) — that scoping is
+the triage the rule catalog promises: of the ~113 host-sync call sites
+in the repo, the ones outside hot functions are the allowlist.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set
+
+from apex_tpu.analysis.findings import Finding, Pragmas
+
+__all__ = ["lint_source", "lint_file", "lint_paths", "iter_py_files",
+           "HOT_PATHS"]
+
+# Known-hot host functions that are not statically jit-detectable
+# (qualified "<module suffix>:<function name>"). Kept deliberately short:
+# the rule's value is precision, not recall-by-listing.
+HOT_PATHS: Set[str] = set()
+
+# modules allowed to touch os.environ int/flag parsing directly
+_ENV_HELPER_MODULES = ("utils/envvars.py",)
+
+
+def _is_env_helper_module(path: str, rel: str) -> bool:
+    """True for utils/envvars.py however the lint target was rooted —
+    the repo-relative path narrows when the CLI is pointed at a
+    subdirectory (``apex_tpu/utils`` makes rel just ``envvars.py``), so
+    the absolute path is consulted too."""
+    posix = os.path.abspath(path).replace(os.sep, "/")
+    rel_posix = rel.replace(os.sep, "/")
+    return (rel_posix.endswith(_ENV_HELPER_MODULES)
+            or any(posix.endswith("/" + m) for m in _ENV_HELPER_MODULES))
+
+
+def _is_env_read(node: ast.AST) -> bool:
+    """os.environ.get(...) / os.getenv(...) / os.environ[...] /
+    environ.get(...) — any expression whose value comes from the
+    process environment."""
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            # os.environ.get / environ.get
+            if f.attr == "get" and _is_environ(f.value):
+                return True
+            # os.getenv
+            if f.attr == "getenv" and isinstance(f.value, ast.Name) \
+                    and f.value.id == "os":
+                return True
+        if isinstance(f, ast.Name) and f.id == "getenv":
+            return True
+    if isinstance(node, ast.Subscript) and _is_environ(node.value):
+        return True
+    return False
+
+
+def _is_environ(node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return True
+    if isinstance(node, ast.Name) and node.id == "environ":
+        return True
+    return False
+
+
+def _contains_env_read(node: ast.AST) -> Optional[ast.AST]:
+    for sub in ast.walk(node):
+        if _is_env_read(sub):
+            return sub
+    return None
+
+
+def _module_scope_env_read(stmt: ast.AST) -> Optional[ast.AST]:
+    """First env read evaluated AT MODULE SCOPE inside ``stmt`` — reads
+    inside nested function/lambda bodies run at call time, not at
+    import, so they are skipped (a function defined under a top-level
+    try/if still reads at call time); class bodies DO execute at
+    import and are descended into."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        return None
+    if _is_env_read(stmt):
+        return stmt
+    for child in ast.iter_child_nodes(stmt):
+        hit = _module_scope_env_read(child)
+        if hit is not None:
+            return hit
+    return None
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression ("jax.jit",
+    "functools.partial", ...); "" when not a plain name chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_PALLAS_CALL_NAMES = {"pl.pallas_call", "pallas_call",
+                      "pallas.pallas_call"}
+_SYNC_ATTRS = {"item", "block_until_ready"}
+_DEVICE_GET = {"jax.device_get", "device_get"}
+_NP_SYNC = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+            "onp.asarray", "onp.array"}
+_JNP_PREFIXES = ("jnp.", "jax.numpy.", "lax.", "jax.lax.")
+
+
+def _first_arg_names(call: ast.Call) -> List[str]:
+    """Names plausibly designating the function being wrapped: the first
+    positional arg of jax.jit(...) / pl.pallas_call(...), looking
+    through functools.partial."""
+    if not call.args:
+        return []
+    a = call.args[0]
+    if isinstance(a, ast.Name):
+        return [a.id]
+    if isinstance(a, ast.Call) and _dotted(a.func) in (
+            "functools.partial", "partial") and a.args:
+        inner = a.args[0]
+        if isinstance(inner, ast.Name):
+            return [inner.id]
+    return []
+
+
+def _collect_hot_names(tree: ast.Module) -> Set[str]:
+    """Function names that are jitted or pallas-called anywhere in the
+    module (assignment-style ``step = jax.jit(body, ...)`` and call-style
+    ``pl.pallas_call(functools.partial(kernel, ...), ...)``)."""
+    hot: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name in _JIT_NAMES or name in _PALLAS_CALL_NAMES:
+                hot.update(_first_arg_names(node))
+    return hot
+
+
+def _is_hot_def(fn: ast.AST, hot_names: Set[str], module_tag: str) -> bool:
+    assert isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+    for dec in fn.decorator_list:
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        name = _dotted(d)
+        if name in _JIT_NAMES:
+            return True
+        # functools.partial(jax.jit, ...) as a decorator
+        if isinstance(dec, ast.Call) and name in ("functools.partial",
+                                                  "partial"):
+            if dec.args and _dotted(dec.args[0]) in _JIT_NAMES:
+                return True
+    if fn.name in hot_names:
+        return True
+    if f"{module_tag}:{fn.name}" in HOT_PATHS:
+        return True
+    return False
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel                 # repo-relative, for allowlists
+        self.findings: List[Finding] = []
+        self.tree = ast.parse(source, filename=path)
+        self.hot_names = _collect_hot_names(self.tree)
+        self._fn_stack: List[ast.AST] = []
+        self._hot_depth = 0
+        # per-function-frame names assigned directly from an env read
+        # ("env = os.environ.get(...)") — the aliases APX102 follows
+        self._env_aliases: List[Set[str]] = []
+
+    # -- helpers ----------------------------------------------------
+    def _add(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(
+            Finding(rule, self.path, getattr(node, "lineno", 0), msg))
+
+    def run(self) -> List[Finding]:
+        self._module_scope_env_reads()
+        self.visit(self.tree)
+        return self.findings
+
+    # -- APX101: env reads at module scope ---------------------------
+    def _module_scope_env_reads(self) -> None:
+        for stmt in self.tree.body:
+            hit = _module_scope_env_read(stmt)
+            if hit is not None:
+                self._add(
+                    "APX101", hit,
+                    "environment read at module scope — the value is "
+                    "frozen at import time; re-read it at call time "
+                    "(utils/envvars.env_int / env_flag) or pragma an "
+                    "intentionally import-time site")
+
+    # -- function tracking -------------------------------------------
+    def _visit_fn(self, node) -> None:
+        hot = _is_hot_def(node, self.hot_names, self.rel)
+        self._fn_stack.append(node)
+        self._env_aliases.append(set())
+        self._hot_depth += 1 if hot else 0
+        self._check_missing_wraps(node)
+        self.generic_visit(node)
+        self._hot_depth -= 1 if hot else 0
+        self._env_aliases.pop()
+        self._fn_stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    @property
+    def _in_hot(self) -> bool:
+        return self._hot_depth > 0
+
+    # -- APX104: decorator missing functools.wraps --------------------
+    def _check_missing_wraps(self, fn) -> None:
+        """Fire when ``fn`` returns an inner *args/**kwargs closure that
+        calls one of ``fn``'s parameters and the closure carries no
+        functools.wraps — the classic hand-rolled decorator shape. HOFs
+        with explicit-signature inner functions (step builders,
+        index-map factories) deliberately do not match."""
+        params = {a.arg for a in fn.args.args + fn.args.posonlyargs
+                  + fn.args.kwonlyargs}
+        if not params:
+            return
+        inner_defs = {n.name: n for n in fn.body
+                      if isinstance(n, ast.FunctionDef)}
+        returned: List[ast.FunctionDef] = []
+        for stmt in fn.body:
+            if isinstance(stmt, ast.Return) and \
+                    isinstance(stmt.value, ast.Name) and \
+                    stmt.value.id in inner_defs:
+                returned.append(inner_defs[stmt.value.id])
+        for inner in returned:
+            if not (inner.args.vararg and inner.args.kwarg):
+                continue
+            calls_param = any(
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id in params
+                for sub in ast.walk(inner))
+            if not calls_param:
+                continue
+            has_wraps = any(
+                _dotted(d.func if isinstance(d, ast.Call) else d)
+                in ("functools.wraps", "wraps")
+                for d in inner.decorator_list)
+            if not has_wraps:
+                self._add(
+                    "APX104", inner,
+                    f"wrapper {inner.name!r} returned by {fn.name!r} "
+                    f"calls the wrapped function but is not decorated "
+                    f"with functools.wraps — name/docstring/signature "
+                    f"of every wrapped function are lost")
+
+    def _is_env_alias(self, node: ast.AST) -> bool:
+        if _is_env_read(node):
+            return True
+        if isinstance(node, ast.Name):
+            return any(node.id in frame for frame in self._env_aliases)
+        return False
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._env_aliases and _contains_env_read(node.value) is not None:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self._env_aliases[-1].add(tgt.id)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if self._env_aliases and node.value is not None \
+                and _contains_env_read(node.value) is not None \
+                and isinstance(node.target, ast.Name):
+            self._env_aliases[-1].add(node.target.id)
+        self.generic_visit(node)
+
+    def visit_NamedExpr(self, node: ast.NamedExpr) -> None:
+        # walrus: (v := os.environ.get(...)) aliases v for the frame
+        if self._env_aliases and _contains_env_read(node.value) is not None \
+                and isinstance(node.target, ast.Name):
+            self._env_aliases[-1].add(node.target.id)
+        self.generic_visit(node)
+
+    # -- expression-level rules ---------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        self._check_raw_env_parse(node)
+        if self._in_hot:
+            self._check_host_sync(node)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        self._check_env_flag_compare(node)
+        self.generic_visit(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_truthiness(node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_truthiness(node.test)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._check_truthiness(node.test)
+        self.generic_visit(node)
+
+    def visit_BoolOp(self, node: ast.BoolOp) -> None:
+        for v in node.values:
+            self._check_truthiness(v, in_boolop=True)
+        self.generic_visit(node)
+
+    # APX102a: int()/float() directly over an env read
+    def _check_raw_env_parse(self, node: ast.Call) -> None:
+        if _is_env_helper_module(self.path, self.rel):
+            return
+        if isinstance(node.func, ast.Name) and node.func.id in ("int",
+                                                                "float"):
+            for a in node.args:
+                if self._is_env_alias(a) or (
+                        isinstance(a, (ast.BoolOp, ast.IfExp))
+                        and any(self._is_env_alias(v)
+                                for v in ast.walk(a)
+                                if isinstance(v, (ast.Name, ast.Call,
+                                                  ast.Subscript)))):
+                    self._add(
+                        "APX102", node,
+                        f"raw {node.func.id}() over an environment read "
+                        f"— use apex_tpu.utils.envvars.env_int so a "
+                        f"malformed value raises an error naming the "
+                        f"variable")
+
+    # APX102b: env read compared against '0'/'1'
+    def _check_env_flag_compare(self, node: ast.Compare) -> None:
+        if _is_env_helper_module(self.path, self.rel):
+            return
+        sides = [node.left] + list(node.comparators)
+        if not any(self._is_env_alias(s) for s in sides):
+            return
+        if any(isinstance(s, ast.Constant) and s.value in ("0", "1")
+               for s in sides):
+            self._add(
+                "APX102", node,
+                "flag parse by string comparison over an environment "
+                "read — use apex_tpu.utils.envvars.env_flag so a typo'd "
+                "gate value raises instead of silently meaning 'off'")
+
+    # APX103: host syncs inside hot functions
+    def _check_host_sync(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _SYNC_ATTRS:
+            self._add(
+                "APX103", node,
+                f".{fn.attr}() inside a jitted function or kernel body "
+                f"forces a device sync (or fails at trace time) — hoist "
+                f"the readback to the host loop")
+            return
+        name = _dotted(fn)
+        if name in _DEVICE_GET or name in _NP_SYNC:
+            self._add(
+                "APX103", node,
+                f"{name}() inside a jitted function or kernel body "
+                f"pulls the value to the host every step — accumulate "
+                f"on device (observability.bridge) and drain "
+                f"asynchronously instead")
+            return
+        if isinstance(fn, ast.Name) and fn.id == "float" and node.args:
+            a = node.args[0]
+            if isinstance(a, ast.Name) and self._is_param(a.id):
+                self._add(
+                    "APX103", node,
+                    f"float({a.id}) of a traced argument inside a "
+                    f"jitted function — a host conversion that syncs "
+                    f"(or raises) at trace time")
+
+    def _is_param(self, name: str) -> bool:
+        for fn in reversed(self._fn_stack):
+            args = fn.args
+            for a in (args.args + args.posonlyargs + args.kwonlyargs):
+                if a.arg == name:
+                    return True
+        return False
+
+    # APX105: truthiness of jnp expressions in hot scope
+    def _check_truthiness(self, test: ast.AST,
+                          in_boolop: bool = False) -> None:
+        if not self._in_hot:
+            return
+        node: Optional[ast.AST] = None
+        if isinstance(test, ast.Call) and _dotted(test.func).startswith(
+                _JNP_PREFIXES):
+            node = test
+        elif isinstance(test, ast.Compare):
+            sides = [test.left] + list(test.comparators)
+            if any(isinstance(s, ast.Call)
+                   and _dotted(s.func).startswith(_JNP_PREFIXES)
+                   for s in sides):
+                node = test
+        if node is not None:
+            self._add(
+                "APX105", node,
+                "Python truthiness of a jnp expression inside a jitted "
+                "function or kernel body — TracerBoolConversionError at "
+                "trace time (or a silently frozen branch); use "
+                "lax.cond / jnp.where / pl.when")
+
+
+def lint_source(source: str, path: str, rel: Optional[str] = None
+                ) -> List[Finding]:
+    """Lint one source string; pragmas applied. ``rel`` is the
+    repo-relative path used for allowlists (defaults to ``path``)."""
+    linter = _Linter(path, rel or path, source)
+    return Pragmas(source).apply(linter.run())
+
+
+def lint_file(path: str, root: Optional[str] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    rel = os.path.relpath(path, root) if root else path
+    return lint_source(source, path, rel)
+
+
+def iter_py_files(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"
+                               and not d.startswith(".")]
+                out.extend(os.path.join(dirpath, f)
+                           for f in sorted(filenames)
+                           if f.endswith(".py"))
+    return sorted(out)
+
+
+def lint_paths(paths: List[str], root: Optional[str] = None
+               ) -> List[Finding]:
+    """Lint every .py under ``paths`` (dirs walked recursively)."""
+    findings: List[Finding] = []
+    for f in iter_py_files(paths):
+        findings.extend(lint_file(f, root))
+    return findings

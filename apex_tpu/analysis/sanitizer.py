@@ -1,0 +1,785 @@
+"""Pallas kernel sanitizer (rules APX301-APX305): statically validate
+every registered tunable family over its whole candidate space.
+
+The fuzz suites (test_tuning_fuzz, test_grouped_matmul_fuzz) prove
+point-wise numerical correctness of *sampled* configurations; the
+sanitizer closes the other half: for EVERY candidate the registry can
+emit (the space the autotuner sweeps and the tune cache can pin), verify
+the kernel *geometry* — before any of it runs on hardware:
+
+* **APX301 blockspec-divisibility** — grid x block tiles the padded
+  operand exactly (no uncovered trailing blocks = garbage out, no
+  overhang = OOB DMA).
+* **APX302 vmem-budget** — projected VMEM residency (block tiles +
+  scratch, double-buffered where the pipeline does) against the device
+  budget from ``tuning.cost_model.device_spec`` — but only for
+  configurations the resolution chain would actually *select* (the
+  cost-model default, or an env override the op layer accepts).
+  Candidates that merely exist in the sweep space and bust the budget
+  are APX305 inventory, not errors: the autotuner's probe rejects them.
+* **APX303 indexmap-bounds** — the BlockSpec index maps, modeled as
+  plain-integer functions, evaluated at every grid corner (and for the
+  ragged families at adversarial scalar-prefetch contents): the selected
+  block must stay inside the padded operand. The shipped kernels clamp
+  (``jnp.minimum`` / ``jnp.clip``); a candidate geometry without the
+  clamp fails here.
+* **APX304 revisit-chain-race** — an instrumented replay of the
+  grouped-matmul work schedule (``ops.grouped_matmul._group_metadata``,
+  the real function, on the real adversarial group distributions): walk
+  the grid in pipeline order and check the accumulator protocol — init
+  by first visitor, flush by last, no accumulate-before-init
+  (uninitialized read), no revisit-after-flush (write race), sentinels
+  never emit.
+
+Geometry is modeled, not introspected: each family's :class:`KernelGeom`
+builder mirrors the corresponding kernel's grid/BlockSpec construction
+(``_gmm_pallas``, ``_decode_pallas``, ``attention`` block rules). The
+tier-1 suite pins the models against the kernels' own constructors where
+they are importable, and the deliberately-broken-fixture test proves the
+checks reject what they should.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from apex_tpu.analysis.findings import Finding
+
+__all__ = ["BlockGeom", "KernelGeom", "check_geometry", "FAMILIES",
+           "sanitize_family", "sanitize_families", "replay_gmm_schedule",
+           "replay_tgmm_schedule"]
+
+
+def _ceil(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _pad128(n: int) -> int:
+    return max(128, _ceil(n, 128) * 128)
+
+
+def _pad_to(n: int, q: int) -> int:
+    return max(q, _ceil(max(n, 1), q) * q)
+
+
+# ---------------------------------------------------------------------------
+# geometry model + generic checks
+# ---------------------------------------------------------------------------
+
+@dataclass
+class BlockGeom:
+    """One operand's BlockSpec model: ``block`` element shape,
+    ``array`` the padded operand shape, ``index_map`` a plain-int
+    function of the grid indices returning BLOCK indices (exactly the
+    BlockSpec contract). ``ragged_dims`` marks dims whose index comes
+    from scalar-prefetch contents — those are checked against the
+    adversarial tables the family supplies, not against corners only."""
+
+    name: str
+    block: Tuple[int, ...]
+    array: Tuple[int, ...]
+    index_map: Callable[..., Tuple[int, ...]]
+
+
+@dataclass
+class KernelGeom:
+    """One kernel instance's geometry: grid + operand blocks + scratch."""
+
+    family: str
+    grid: Tuple[int, ...]
+    blocks: List[BlockGeom]
+    vmem_bytes: int = 0
+    vmem_budget: int = 0
+    # grid-index tuples beyond the corners worth probing (ragged probes)
+    extra_probes: List[Tuple[int, ...]] = field(default_factory=list)
+    tag: str = "<sanitize>"
+
+
+def _grid_corners(grid: Tuple[int, ...]) -> Iterable[Tuple[int, ...]]:
+    """First/last index along every grid axis — 2^rank corner probes,
+    plus a mid point per axis when the axis is long enough."""
+    axes = []
+    for n in grid:
+        pts = {0, n - 1}
+        if n > 2:
+            pts.add(n // 2)
+        axes.append(sorted(pts))
+    return itertools.product(*axes)
+
+
+def check_geometry(geom: KernelGeom) -> List[Finding]:
+    """The generic APX301/302/303 checks over one modeled kernel."""
+    findings: List[Finding] = []
+    tag = geom.tag
+
+    for bg in geom.blocks:
+        if len(bg.block) != len(bg.array):
+            findings.append(Finding(
+                "APX301", tag, 0,
+                f"{geom.family}/{bg.name}: block rank {len(bg.block)} != "
+                f"operand rank {len(bg.array)}"))
+            continue
+        for d, (b, a) in enumerate(zip(bg.block, bg.array)):
+            if b <= 0:
+                findings.append(Finding(
+                    "APX301", tag, 0,
+                    f"{geom.family}/{bg.name}: block dim {d} is {b}"))
+            elif a % b:
+                findings.append(Finding(
+                    "APX301", tag, 0,
+                    f"{geom.family}/{bg.name}: padded operand dim {d} "
+                    f"({a}) is not a multiple of the block dim ({b}) — "
+                    f"trailing elements are never covered by a whole "
+                    f"block"))
+
+    probes = list(_grid_corners(geom.grid)) + list(geom.extra_probes)
+    for bg in geom.blocks:
+        if len(bg.block) != len(bg.array):
+            continue
+        bad = None
+        for idx in probes:
+            try:
+                bidx = bg.index_map(*idx)
+            except Exception as e:  # noqa: BLE001 — a raising map is a bug
+                findings.append(Finding(
+                    "APX303", tag, 0,
+                    f"{geom.family}/{bg.name}: index map raised at grid "
+                    f"index {idx}: {type(e).__name__}: {e}"))
+                bad = True
+                break
+            if len(bidx) != len(bg.block):
+                findings.append(Finding(
+                    "APX303", tag, 0,
+                    f"{geom.family}/{bg.name}: index map at grid index "
+                    f"{idx} returned {len(bidx)} block indices for a "
+                    f"rank-{len(bg.block)} block — dims beyond the "
+                    f"returned arity would go unchecked"))
+                bad = True
+                break
+            for d, (bi, b, a) in enumerate(zip(bidx, bg.block, bg.array)):
+                if bi < 0 or (bi + 1) * b > a:
+                    bad = (idx, d, bi)
+                    break
+            if isinstance(bad, tuple):
+                idx, d, bi = bad
+                findings.append(Finding(
+                    "APX303", tag, 0,
+                    f"{geom.family}/{bg.name}: index map at grid index "
+                    f"{idx} selects block {bi} on dim {d} — elements "
+                    f"[{bi * bg.block[d]}, {(bi + 1) * bg.block[d]}) "
+                    f"outside the padded operand dim of {bg.array[d]} "
+                    f"(missing clamp?)"))
+                break
+        if bad:
+            continue
+
+    if geom.vmem_budget and geom.vmem_bytes > geom.vmem_budget:
+        findings.append(Finding(
+            "APX302", tag, 0,
+            f"{geom.family}: projected VMEM residency "
+            f"{geom.vmem_bytes / 2**20:.2f} MiB exceeds the device "
+            f"budget {geom.vmem_budget / 2**20:.2f} MiB"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# revisit-chain replay (APX304) — grouped matmul work schedules
+# ---------------------------------------------------------------------------
+
+def _metadata_np(group_sizes: Sequence[int], t_pad: int, tile_t: int):
+    """The REAL work-list builder (ops.grouped_matmul._group_metadata),
+    evaluated to host ints — the replay instruments the exact schedule
+    the kernel's index maps will see."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.ops.grouped_matmul import _group_metadata
+
+    wt, wg, offs = _group_metadata(
+        jnp.asarray(list(group_sizes), dtype=jnp.int32), t_pad, tile_t)
+    return (np.asarray(wt).tolist(), np.asarray(wg).tolist(),
+            np.asarray(offs).tolist())
+
+
+def replay_gmm_schedule(group_sizes: Sequence[int], t: int, tile_t: int,
+                        tag: str = "<sanitize:moe_grouped>"
+                        ) -> List[Finding]:
+    """Instrumented replay of the gmm kernel's accumulator protocol.
+
+    Walks work items in grid order tracking per-OUT-TILE state
+    (uninit -> accumulating -> flushed), mirroring ``_gmm_kernel``:
+    init when ``prev_tile != tile``, accumulate every step, flush when
+    ``next_tile != tile``. Violations are exactly the write-race /
+    uninitialized-read classes the rule documents."""
+    e = len(group_sizes)
+    t_pad = _pad_to(t, tile_t)
+    pt = t_pad // tile_t
+    wt, wg, offs = _metadata_np(group_sizes, t_pad, tile_t)
+    findings: List[Finding] = []
+
+    def add(msg):
+        findings.append(Finding("APX304", tag, 0, msg))
+
+    n = len(wt) - 1                      # last entry is the sentinel
+    if wt[n] != pt or wg[n] != e:
+        add(f"sentinel work item is (tile={wt[n]}, group={wg[n]}), "
+            f"expected ({pt}, {e}) — the kernels' i+1 peek reads junk")
+    flushed = set()
+    acc_tile = None                      # tile currently accumulating
+    acc_init = False
+    for i in range(n):
+        tile = wt[i]
+        prev_tile = wt[i - 1] if i > 0 else -1
+        init = prev_tile != tile
+        emit = wt[i + 1] != tile
+        real = tile < pt
+        if init:
+            acc_tile, acc_init = tile, True
+        else:
+            if acc_tile != tile or not acc_init:
+                add(f"work item {i} accumulates into tile {tile} without "
+                    f"an init (scratch holds tile {acc_tile}) — "
+                    f"uninitialized read")
+        if real and tile in flushed and init:
+            add(f"work item {i} re-opens tile {tile} after its flush — "
+                f"write race on the output block")
+        if emit:
+            if real:
+                if tile in flushed:
+                    add(f"work item {i} flushes tile {tile} twice")
+                flushed.add(tile)
+            acc_init = False
+        if not real and emit and wg[i] < e:
+            add(f"work item {i} emits through the sentinel tile with a "
+                f"real group {wg[i]}")
+    missing = set(range(pt)) - flushed
+    if missing:
+        add(f"output tiles {sorted(missing)} are never flushed — they "
+            f"would contain garbage (t={t}, tile_t={tile_t}, "
+            f"groups={list(group_sizes)})")
+    # masks must partition each tile's rows among its visiting groups
+    for g in range(e):
+        lo, hi = offs[g], offs[g + 1]
+        if hi < lo:
+            add(f"group {g} has negative extent [{lo}, {hi})")
+    if offs[e] > t_pad:
+        add(f"group offsets end at {offs[e]} > padded rows {t_pad}")
+    return findings
+
+
+def replay_tgmm_schedule(group_sizes: Sequence[int], t: int, tile_t: int,
+                         tag: str = "<sanitize:moe_grouped>"
+                         ) -> List[Finding]:
+    """Same replay for the tgmm kernel, whose chain is keyed on the
+    GROUP: init when ``prev_group != group``, flush when
+    ``next_group != group`` and the group is real; empty groups are
+    never visited (the wrapper zeroes their output blocks)."""
+    e = len(group_sizes)
+    t_pad = _pad_to(t, tile_t)
+    wt, wg, offs = _metadata_np(group_sizes, t_pad, tile_t)
+    findings: List[Finding] = []
+
+    def add(msg):
+        findings.append(Finding("APX304", tag, 0, msg))
+
+    n = len(wg) - 1
+    emitted = set()
+    for i in range(n):
+        g = wg[i]
+        prev_g = wg[i - 1] if i > 0 else -1
+        emit_now = (wg[i + 1] != g) and (g < e)
+        if emit_now:
+            if g in emitted:
+                add(f"work item {i} emits group {g} twice — write race "
+                    f"on the output block")
+            emitted.add(g)
+        if g < e and prev_g != g and g in emitted and not emit_now:
+            add(f"work item {i} re-opens group {g} after its emit")
+    expected = {g for g in range(e) if group_sizes[g] > 0}
+    missing = expected - emitted
+    if missing:
+        add(f"nonempty groups {sorted(missing)} never emit their output "
+            f"block (t={t}, tile_t={tile_t}, groups={list(group_sizes)})")
+    extra = emitted - expected
+    if extra:
+        add(f"empty groups {sorted(extra)} emit — they would overwrite "
+            f"the wrapper's zero contract")
+    return findings
+
+
+# the adversarial group distributions the fuzz suite established
+def _group_distributions(e: int, t: int, rng: random.Random
+                         ) -> List[List[int]]:
+    dists = [
+        [0] * e,                                   # nothing routed
+        [t] + [0] * (e - 1),                       # one takes all
+        [0] * (e - 1) + [t],                       # last takes all
+        [t // e] * e,                              # uniform
+    ]
+    # ragged random split summing to <= t (exercises trailing tiles)
+    cut = sorted(rng.randrange(t + 1) for _ in range(e - 1))
+    rag = [b - a for a, b in zip([0] + cut, cut + [rng.randrange(t, t + 1)])]
+    dists.append(rag)
+    # non-tile-aligned boundaries; trim from the tail until the gmm
+    # contract (sum(group_sizes) <= t) holds for ANY (t, e)
+    odd = [max(0, t // e + (7 if i % 2 else -7)) for i in range(e)]
+    over, i = sum(odd) - t, e - 1
+    while over > 0 and i >= 0:
+        take = min(over, odd[i])
+        odd[i] -= take
+        over -= take
+        i -= 1
+    dists.append(odd)
+    return dists
+
+
+# ---------------------------------------------------------------------------
+# family models
+# ---------------------------------------------------------------------------
+
+def _vmem_budget(device: str = "cpu") -> int:
+    from apex_tpu.tuning import cost_model
+
+    _, _, vmem = cost_model.device_spec(device)
+    return int(vmem)
+
+
+@dataclass
+class Family:
+    name: str
+    registry_key: str
+    shapes: Callable[[], List[dict]]
+    # (params, features) -> KernelGeom | None (None = no kernel, e.g.
+    # jnp backend or a pure host-side knob) ; may raise for broken input
+    build: Callable[[dict, dict], Optional[KernelGeom]]
+    # features for which a params dict is the RESOLVED default
+    # (cost-model output) rather than a swept candidate
+    default_params: Optional[Callable[[dict], dict]] = None
+    # extra family-specific checks: (params, features, tag) -> findings
+    extra: Optional[Callable[[dict, dict, str], List[Finding]]] = None
+
+
+def _tag(family: str, features: dict, params: dict) -> str:
+    feat = ",".join(f"{k}={v}" for k, v in sorted(features.items()))
+    par = ",".join(f"{k}={v}" for k, v in sorted(params.items()))
+    return f"<sanitize:{family}|{feat}|{par}>"
+
+
+# -- flash attention -------------------------------------------------------
+
+def _flash_shapes() -> List[dict]:
+    from apex_tpu.tuning import cost_model
+
+    out = []
+    for row in cost_model.iter_flash_ladder():
+        for bwd in (False, True):
+            out.append({"sq": row["sq"], "sk": row["sk"], "d": row["d"],
+                        "dt": "bf16", "bwd": bwd})
+    return out
+
+
+def _flash_build(params: dict, features: dict) -> Optional[KernelGeom]:
+    from apex_tpu.tuning import cost_model
+
+    if params.get("backend") == "jnp":
+        return None
+    sq, sk, d = features["sq"], features["sk"], features["d"]
+    bwd = features["bwd"]
+    streaming = max(sq, sk) > cost_model.STREAM_SEQ
+    sqp, skp = _pad128(sq), _pad128(sk)
+    bq = min(params["block_q"], sqp)
+    bk = min(params["block_k"], skp)
+    # the op layer pads sequences up to block multiples
+    sqp, skp = _pad_to(sqp, bq), _pad_to(skp, bk)
+    nq, nk = sqp // bq, skp // bk
+    bh = 4  # batch*heads instances — any positive count; geometry per-instance
+    grid = (bh, nq, nk) if streaming else (bh, nq)
+    blocks = [
+        BlockGeom("q", (1, bq, d), (bh, sqp, d),
+                  (lambda b, i, k=0: (b, i, 0)) if streaming
+                  else (lambda b, i: (b, i, 0))),
+        BlockGeom("out", (1, bq, d), (bh, sqp, d),
+                  (lambda b, i, k=0: (b, i, 0)) if streaming
+                  else (lambda b, i: (b, i, 0))),
+    ]
+    if streaming:
+        blocks.append(BlockGeom("kv", (1, bk, d), (bh, skp, d),
+                                lambda b, i, k: (b, k, 0)))
+    else:
+        # resident family: the whole padded K/V row is the block
+        blocks.append(BlockGeom("kv", (1, skp, d), (bh, skp, d),
+                                lambda b, i: (b, 0, 0)))
+    bytes_el = 2 if features["dt"] in ("bf16", "f16") else 4
+    vmem = cost_model.flash_vmem_bytes(sq, sk, d, bytes_el, bq, bk,
+                                       streaming=streaming, bwd=bwd)
+    return KernelGeom("flash", grid, blocks, vmem_bytes=int(vmem),
+                      vmem_budget=_vmem_budget(),
+                      tag=_tag("flash", features, params))
+
+
+def _flash_defaults(features: dict) -> dict:
+    from apex_tpu.tuning import cost_model
+
+    streaming = max(features["sq"], features["sk"]) > cost_model.STREAM_SEQ
+    return {
+        "block_q": cost_model.flash_block_default(
+            features["sq"], streaming, features["bwd"]),
+        "block_k": cost_model.flash_block_default(
+            features["sk"], streaming, features["bwd"]),
+    }
+
+
+# -- layer norm / rms norm -------------------------------------------------
+
+def _ln_shapes() -> List[dict]:
+    return [{"rows": r, "hidden": h}
+            for r in (128, 4096) for h in (1024, 8192)]
+
+
+def _ln_build(params: dict, features: dict) -> KernelGeom:
+    rows_total = _pad_to(features["rows"], params["block_rows"])
+    br, h = params["block_rows"], features["hidden"]
+    n = rows_total // br
+    vmem = br * h * 4 * 3            # bwd holds x, dy, dx fp32 row tiles
+    return KernelGeom(
+        "layer_norm", (n,),
+        [BlockGeom("x", (br, h), (rows_total, h), lambda i: (i, 0)),
+         BlockGeom("out", (br, h), (rows_total, h), lambda i: (i, 0))],
+        vmem_bytes=vmem, vmem_budget=_vmem_budget(),
+        tag=_tag("layer_norm", features, params))
+
+
+def _ln_defaults(features: dict) -> dict:
+    from apex_tpu.tuning import cost_model
+
+    return {"block_rows": cost_model.ln_block_rows_default(
+        features["hidden"])}
+
+
+# -- optimizer flat kernels ------------------------------------------------
+
+def _optim_shapes() -> List[dict]:
+    return [{"n": n, "n_tiles": tiles}
+            for n in (8192, 1 << 22) for tiles in (2, 7)]
+
+
+def _optim_build(params: dict, features: dict) -> KernelGeom:
+    br = params["block_rows"]
+    rows = _pad_to(_ceil(features["n"], 128), br)
+    n = rows // br
+    vmem = br * 128 * 4 * features["n_tiles"] * 2   # double-buffered
+    return KernelGeom(
+        "optim_flat", (n,),
+        [BlockGeom("flat", (br, 128), (rows, 128), lambda i: (i, 0))],
+        vmem_bytes=vmem, vmem_budget=_vmem_budget(),
+        tag=_tag("optim_flat", features, params))
+
+
+def _optim_defaults(features: dict) -> dict:
+    from apex_tpu.tuning import cost_model
+
+    return {"block_rows": cost_model.optim_block_rows_default(
+        features["n_tiles"])}
+
+
+# -- softmax row tiling (host-side lax.map tiling — no Pallas kernel) ------
+
+def _softmax_shapes() -> List[dict]:
+    return [{"rows": r, "cols": c} for r in (512, 16384) for c in (128,)]
+
+
+def _softmax_build(params: dict, features: dict) -> Optional[KernelGeom]:
+    c = params["row_chunk"]
+    if c <= 0:
+        return None                   # untiled: one fused XLA pass
+    rows = _pad_to(features["rows"], c)
+    return KernelGeom(
+        "softmax", (rows // c,),
+        [BlockGeom("rows", (c, features["cols"]),
+                   (rows, features["cols"]), lambda i: (i, 0))],
+        vmem_bytes=0, vmem_budget=0,
+        tag=_tag("softmax", features, params))
+
+
+# -- overlap_tp ring chunking (collective schedule — no Pallas kernel) -----
+
+def _overlap_shapes() -> List[dict]:
+    return [{"rows_local": r, "n_ranks": n}
+            for r in (1, 8, 512) for n in (1, 4, 8)]
+
+
+def _overlap_build(params: dict, features: dict) -> None:
+    return None
+
+
+def _overlap_extra(params: dict, features: dict, tag: str
+                   ) -> List[Finding]:
+    """The ring schedule's own invariants: the split covers the local
+    rows exactly and every hop's ppermute is a bijection (the APX203
+    invariant, checked over the static schedule here)."""
+    from apex_tpu.parallel.overlap import _perm, _split_points
+
+    findings: List[Finding] = []
+    rows, n = features["rows_local"], features["n_ranks"]
+    chunks = params["chunks"]
+    pieces = _split_points(rows, chunks)
+    covered = sum(size for _, size in pieces)
+    if rows and covered != rows:
+        findings.append(Finding(
+            "APX301", tag, 0,
+            f"overlap_tp: ring pieces cover {covered} of {rows} local "
+            f"rows (chunks={chunks})"))
+    if rows and pieces:
+        ends = [o + s for o, s in pieces]
+        starts = [o for o, _ in pieces[1:]] + [rows]
+        if ends != starts or pieces[0][0] != 0:
+            findings.append(Finding(
+                "APX301", tag, 0,
+                f"overlap_tp: ring pieces {pieces} overlap or leave gaps "
+                f"over {rows} rows"))
+    for direction in (1, -1):
+        perm = _perm(n, direction)
+        srcs, dsts = [s for s, _ in perm], [d for _, d in perm]
+        if sorted(srcs) != list(range(n)) or sorted(dsts) != list(range(n)):
+            findings.append(Finding(
+                "APX203", tag, 0,
+                f"overlap_tp: ring permutation {perm} is not a bijection "
+                f"over {n} ranks"))
+    return findings
+
+
+# -- paged decode ----------------------------------------------------------
+
+def _paged_shapes() -> List[dict]:
+    return [{"slots": s, "max_blocks": mb, "bs": 16, "group": g, "d": 64,
+             "nb": 32}
+            for s in (4,) for mb in (1, 7) for g in (1, 4)]
+
+
+def _paged_build(params: dict, features: dict) -> Optional[KernelGeom]:
+    if params.get("backend") == "jnp":
+        return None
+    s_n, mb = features["slots"], features["max_blocks"]
+    bs, group, d = features["bs"], features["group"], features["d"]
+    nb = features["nb"]
+    hkv = 2
+    fetch = min(params["kv_fetch"], max(1, mb))
+    rows = max(params["block_rows"], _pad_to(group, 8))
+    nj = _ceil(mb, fetch)
+    # adversarial block table: first/last pool pages + the clamp target
+    table = [(si * 7 + j * 3) % nb for si in range(s_n) for j in range(mb)]
+    flat_len = len(table)
+
+    def page_map(i):
+        def index(s, h, j):
+            flat = min(max(s * mb + j * fetch + i, 0), flat_len - 1)
+            return (table[flat], 0, h, 0)
+        return index
+
+    blocks = [BlockGeom("q", (1, 1, rows, d), (s_n, hkv, rows, d),
+                        lambda s, h, j: (s, h, 0, 0)),
+              BlockGeom("out", (1, 1, rows, d), (s_n, hkv, rows, d),
+                        lambda s, h, j: (s, h, 0, 0))]
+    for i in range(fetch):
+        blocks.append(BlockGeom(f"k{i}", (1, bs, 1, d), (nb, bs, hkv, d),
+                                page_map(i)))
+        blocks.append(BlockGeom(f"v{i}", (1, bs, 1, d), (nb, bs, hkv, d),
+                                page_map(i)))
+    bytes_el = 2
+    vmem = fetch * 2 * bs * d * bytes_el * 2 + rows * d * 4 + 2 * rows * 4
+    return KernelGeom(
+        "paged_decode", (s_n, hkv, nj), blocks,
+        vmem_bytes=vmem, vmem_budget=_vmem_budget(),
+        tag=_tag("paged_decode", features, params))
+
+
+def _paged_defaults(features: dict) -> dict:
+    from apex_tpu.tuning import cost_model
+
+    return {
+        "block_rows": cost_model.paged_block_rows_default(
+            features["group"]),
+        "kv_fetch": cost_model.paged_kv_fetch_default(
+            features["bs"], features["d"]),
+    }
+
+
+# -- grouped matmul (dropless MoE) -----------------------------------------
+
+def _moe_shapes() -> List[dict]:
+    return [{"t": t, "e": e, "h": 256, "f": 384}
+            for t in (8, 1024) for e in (4, 8)]
+
+
+def _moe_build(params: dict, features: dict) -> Optional[KernelGeom]:
+    if params.get("backend") == "jnp":
+        return None
+    t, e = features["t"], features["e"]
+    h, f = features["h"], features["f"]
+    tile_t = params["tile_t"]
+    tile_f = min(params["tile_f"], _pad128(f))
+    k_pad = _pad128(h)
+    f_pad = _ceil(_pad128(f), tile_f) * tile_f
+    t_pad = _pad_to(t, tile_t)
+    pt = t_pad // tile_t
+    nf = f_pad // tile_f
+    # adversarial work-list contents for the ragged index-map probes:
+    # real tiles/groups up front, sentinel values (pt / e) behind — the
+    # exact extremes _group_metadata emits
+    work_tile = list(range(pt)) + [pt] * (e + 1)
+    work_group = list(range(e)) + [e] * (pt + 1)
+    # grid minor axis walks the work list; index maps CLAMP exactly like
+    # _gmm_pallas (tile -> pt-1, group -> e-1)
+    blocks = [
+        BlockGeom("lhs", (tile_t, k_pad), (t_pad, k_pad),
+                  lambda j, i: (min(work_tile[i], pt - 1), 0)),
+        BlockGeom("rhs", (1, k_pad, tile_f), (e, k_pad, f_pad),
+                  lambda j, i: (min(work_group[i], e - 1), 0, j)),
+        BlockGeom("out", (tile_t, tile_f), (t_pad, f_pad),
+                  lambda j, i: (min(work_tile[i], pt - 1), j)),
+    ]
+    dtype_bytes = 2
+    vmem = (2 * (tile_t * k_pad + k_pad * tile_f + tile_t * tile_f)
+            * dtype_bytes + tile_t * tile_f * 4)
+    return KernelGeom(
+        "moe_grouped", (nf, pt + e), blocks,
+        vmem_bytes=vmem, vmem_budget=_vmem_budget(),
+        tag=_tag("moe_grouped", features, params))
+
+
+def _moe_defaults(features: dict) -> dict:
+    from apex_tpu.tuning import cost_model
+
+    return {
+        "tile_t": cost_model.moe_tile_t_default(features["h"],
+                                                features["f"]),
+        "tile_f": cost_model.moe_tile_f_default(features["f"]),
+    }
+
+
+def _moe_extra(params: dict, features: dict, tag: str) -> List[Finding]:
+    """The APX304 revisit-chain replay over the adversarial group
+    distributions, for both gmm (tile-keyed) and tgmm (group-keyed)."""
+    if params.get("backend") == "jnp":
+        return []
+    rng = random.Random(f"{features['t']}:{features['e']}:"
+                        f"{params['tile_t']}")
+    findings: List[Finding] = []
+    for dist in _group_distributions(features["e"], features["t"], rng):
+        findings.extend(replay_gmm_schedule(
+            dist, features["t"], params["tile_t"], tag))
+        findings.extend(replay_tgmm_schedule(
+            dist, features["t"], params["tile_t"], tag))
+    return findings
+
+
+FAMILIES: Dict[str, Family] = {
+    f.name: f
+    for f in (
+        Family("flash", "flash", _flash_shapes, _flash_build,
+               _flash_defaults),
+        Family("layer_norm", "layer_norm", _ln_shapes, _ln_build,
+               _ln_defaults),
+        Family("optim", "optim_flat", _optim_shapes, _optim_build,
+               _optim_defaults),
+        Family("softmax", "softmax", _softmax_shapes, _softmax_build),
+        Family("paged_decode", "paged_decode", _paged_shapes,
+               _paged_build, _paged_defaults),
+        Family("moe_grouped", "moe_grouped", _moe_shapes, _moe_build,
+               _moe_defaults, extra=_moe_extra),
+        Family("overlap_tp", "overlap_tp", _overlap_shapes,
+               _overlap_build, extra=_overlap_extra),
+    )
+}
+
+
+# ---------------------------------------------------------------------------
+# sweep driver
+# ---------------------------------------------------------------------------
+
+def _candidate_space(registry_key: str) -> List[dict]:
+    from apex_tpu.tuning.registry import TUNABLES
+
+    t = TUNABLES[registry_key]
+    keys = sorted(t.params)
+    out = []
+    for combo in itertools.product(*(t.params[k] for k in keys)):
+        out.append(dict(zip(keys, combo)))
+    return out
+
+
+def sanitize_family(name: str, *, full: bool = False, seed: int = 0,
+                    sample: int = 24) -> Tuple[List[Finding], dict]:
+    """Sweep one family: every (shape, candidate) pair when ``full``,
+    else a seeded subsample of ``sample`` pairs (tier-1 budget). Returns
+    (findings, stats)."""
+    from apex_tpu.tuning.registry import TUNABLES
+
+    fam = FAMILIES[name]
+    reg = TUNABLES[fam.registry_key]
+    shapes = fam.shapes()
+    cands = _candidate_space(fam.registry_key)
+    pairs = [(s, c) for s in shapes for c in cands]
+    if fam.default_params is not None:
+        pairs += [(s, fam.default_params(s)) for s in shapes]
+    if not full and len(pairs) > sample:
+        rng = random.Random((seed, name).__repr__())
+        keep = rng.sample(range(len(pairs)), sample)
+        # defaults always stay in the subsample
+        n_def = len(shapes) if fam.default_params is not None else 0
+        keep = sorted(set(keep) | set(range(len(pairs) - n_def,
+                                            len(pairs))))
+        pairs = [pairs[i] for i in keep]
+
+    findings: List[Finding] = []
+    stats = {"family": name, "checked": 0, "rejected": 0, "kernels": 0}
+    n_def = len(shapes) if fam.default_params is not None else 0
+    for k, (features, params) in enumerate(pairs):
+        is_default = k >= len(pairs) - n_def
+        tag = _tag(name, features, params)
+        if reg.check is not None:
+            err = reg.check({p: v for p, v in params.items()
+                             if p in reg.params}, features)
+            if err:
+                findings.append(Finding(
+                    "APX305", tag, 0,
+                    f"candidate rejected by the registry check: {err}"))
+                stats["rejected"] += 1
+                continue
+        stats["checked"] += 1
+        geom = fam.build(params, features)
+        if geom is not None:
+            stats["kernels"] += 1
+            geo_findings = check_geometry(geom)
+            if not is_default:
+                # swept candidates busting VMEM are inventory (APX305):
+                # the autotune probe rejects them before any cache pin
+                geo_findings = [
+                    Finding("APX305", f.path, f.line,
+                            "candidate over the VMEM budget (autotune "
+                            "probe would reject): " + f.message)
+                    if f.rule == "APX302" else f
+                    for f in geo_findings
+                ]
+            findings.extend(geo_findings)
+        if fam.extra is not None:
+            findings.extend(fam.extra(params, features, tag))
+    return findings, stats
+
+
+def sanitize_families(names: Optional[Sequence[str]] = None, *,
+                      full: bool = False, seed: int = 0,
+                      sample: int = 24
+                      ) -> Tuple[List[Finding], List[dict]]:
+    if names is None:
+        names = sorted(FAMILIES)
+    findings: List[Finding] = []
+    stats: List[dict] = []
+    for n in names:
+        f, s = sanitize_family(n, full=full, seed=seed, sample=sample)
+        findings.extend(f)
+        stats.append(s)
+    return findings, stats

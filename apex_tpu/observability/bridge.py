@@ -27,13 +27,13 @@ serving and comms metrics.
 
 from __future__ import annotations
 
-import os
 from typing import Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.utils.envvars import env_int
 from apex_tpu.observability.registry import (
     MetricsRegistry,
     default_registry,
@@ -110,8 +110,7 @@ class MetricsDrainer:
                  registry: Optional[MetricsRegistry] = None,
                  prefix: str = "train"):
         if interval is None:
-            interval = int(os.environ.get("APEX_TPU_METRICS_INTERVAL",
-                                          "32"))
+            interval = env_int("APEX_TPU_METRICS_INTERVAL", default=32)
         self.interval = max(1, int(interval))
         self.prefix = prefix
         self._registry = registry

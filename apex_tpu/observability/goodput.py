@@ -31,6 +31,7 @@ and the ``goodput/compiles`` counter in the default registry.
 from __future__ import annotations
 
 import contextlib
+import functools
 import math
 import time
 from typing import Iterator, Optional
@@ -70,6 +71,7 @@ class GoodputTracker:
         """Wrap the step body BEFORE jax.jit: the wrapper's python body
         executes only while XLA traces, so re-traces are observable as
         counter movement (zero cost on the compiled dispatch path)."""
+        @functools.wraps(fn)
         def traced(*args, **kwargs):
             self._trace_events += 1
             return fn(*args, **kwargs)

@@ -39,10 +39,11 @@ allocation-free after the first sample.
 from __future__ import annotations
 
 import bisect
-import os
 import threading
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
+
+from apex_tpu.utils.envvars import env_str
 
 __all__ = [
     "Counter",
@@ -72,8 +73,8 @@ TIME_BUCKETS: Tuple[float, ...] = (
 def metrics_enabled() -> bool:
     """The gate every recording helper consults, resolved at CALL time:
     APEX_TPU_METRICS_SINK set to anything but ''/'0' enables."""
-    v = os.environ.get("APEX_TPU_METRICS_SINK")
-    return bool(v) and v != "0"
+    v = env_str("APEX_TPU_METRICS_SINK")
+    return v is not None and v != "0"
 
 
 def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:

@@ -2,22 +2,12 @@
 
 from __future__ import annotations
 
-import os
-
 import jax
 
-
-def env_int(var: str, *, quantum: int = 1):
-    """Validated integer env override (None when unset/empty): positive
-    multiple of ``quantum`` or a loud ValueError — the op-layer knob
-    contract (APEX_TPU_PAGED_*, APEX_TPU_MOE_TILE_*)."""
-    env = os.environ.get(var)
-    if not env:
-        return None
-    v = int(env)
-    if v <= 0 or v % quantum:
-        raise ValueError(f"{var}={v} must be a positive multiple of {quantum}")
-    return v
+# canonical validated env parsing (utils/envvars.py); re-exported here
+# because the whole kernel layer historically imports env_int from this
+# module
+from apex_tpu.utils.envvars import env_flag, env_int  # noqa: F401
 
 
 def on_tpu() -> bool:
@@ -30,9 +20,9 @@ def on_tpu() -> bool:
 def pallas_interpret() -> bool:
     """Run Pallas kernels in interpret mode off-TPU (CPU tests) unless
     explicitly overridden via APEX_TPU_PALLAS_INTERPRET."""
-    env = os.environ.get("APEX_TPU_PALLAS_INTERPRET")
+    env = env_flag("APEX_TPU_PALLAS_INTERPRET")
     if env is not None:
-        return env == "1"
+        return env
     return not on_tpu()
 
 
@@ -66,7 +56,7 @@ def default_use_pallas(kernel: str | None = None) -> bool:
     preflight compile-probe is pinned to the jnp path regardless."""
     if kernel is not None and kernel in _DISABLED_KERNELS:
         return False
-    env = os.environ.get("APEX_TPU_USE_PALLAS")
+    env = env_flag("APEX_TPU_USE_PALLAS")
     if env is not None:
-        return env == "1"
+        return env
     return on_tpu()

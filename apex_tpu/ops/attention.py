@@ -34,7 +34,6 @@ Semantics notes:
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +41,8 @@ import numpy as np
 from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 
-from apex_tpu.ops._utils import default_use_pallas, pallas_interpret
+from apex_tpu.ops._utils import default_use_pallas, env_flag, env_int, \
+    pallas_interpret
 from apex_tpu.ops.block_rng import keep_block, keep_full, keep_threshold, \
     seed_words
 
@@ -56,18 +56,9 @@ def _env_block(bwd: bool = False):
     for backward kernels (round-4 verdict Weak #1: the fused bwd holds
     more live tiles per grid step, so its VMEM-optimal block need not
     match the forward's)."""
-    env = var = None
-    if bwd:
-        var = "APEX_TPU_FLASH_BLOCK_BWD"
-        env = os.environ.get(var)
-    if not env:
-        var = "APEX_TPU_FLASH_BLOCK"
-        env = os.environ.get(var)
-    if not env:
-        return None
-    b = int(env)
-    if b <= 0 or b % 128:
-        raise ValueError(f"{var}={b} must be a positive multiple of 128")
+    b = env_int("APEX_TPU_FLASH_BLOCK_BWD", quantum=128) if bwd else None
+    if b is None:
+        b = env_int("APEX_TPU_FLASH_BLOCK", quantum=128)
     return b
 
 
@@ -115,8 +106,7 @@ def _streaming_available() -> bool:
 
     if _pltpu is None or kernel_disabled("flash_attention_stream"):
         return False
-    env = os.environ.get("APEX_TPU_FLASH_STREAM")
-    return env is None or env == "1"
+    return env_flag("APEX_TPU_FLASH_STREAM", default=True)
 
 
 def _auto_use_kernel(family: str, q, k, causal: bool, group: int) -> bool:
@@ -131,7 +121,7 @@ def _auto_use_kernel(family: str, q, k, causal: bool, group: int) -> bool:
     explicit use_pallas=True never reaches this function."""
     if not default_use_pallas(family):
         return False
-    if os.environ.get("APEX_TPU_USE_PALLAS") == "1":
+    if env_flag("APEX_TPU_USE_PALLAS"):
         return True
     from apex_tpu import tuning
 
@@ -685,9 +675,9 @@ def _use_streaming(sq: int, sk: int) -> bool:
         # preflight found the streaming kernels unlowerable: stay on the
         # resident-KV kernels (fine to ~8-16k; beyond that VMEM will say so)
         return False
-    env = os.environ.get("APEX_TPU_FLASH_STREAM")
+    env = env_flag("APEX_TPU_FLASH_STREAM")
     if env is not None:
-        return env == "1"
+        return env
     return max(sq, sk) > _STREAM_SEQ
 
 
@@ -1060,7 +1050,7 @@ def _bwd_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse=None,
         # split/debug pair never sees a mask)
         return _bwd_fused_pallas(q, k, v, bias, causal, scale, o, lse, do,
                                  dlse, drop=drop, group=group)
-    if os.environ.get("APEX_TPU_FLASH_SPLIT_BWD") != "1":
+    if not env_flag("APEX_TPU_FLASH_SPLIT_BWD", default=False):
         return _bwd_fused_pallas(q, k, v, bias, causal, scale, o, lse, do,
                                  dlse, group=group)
     return _bwd_split_pallas(q, k, v, bias, causal, scale, o, lse, do, dlse,
@@ -1211,9 +1201,8 @@ def _check_dbias_seq(q, k):
         # full score matrix, so the dbias pass adds no NEW memory class —
         # blocking it would protect nothing (round-3 advisor item)
         return
-    env = os.environ.get("APEX_TPU_FLASH_STREAM")
-    if env is not None and env != "1":
-        # same parse as _use_streaming: any non-"1" value forces the
+    if env_flag("APEX_TPU_FLASH_STREAM") is False:
+        # same parse as _use_streaming: an explicit "0" forces the
         # resident kernels, so the user already opted into resident memory
         return
     raise NotImplementedError(

@@ -49,14 +49,14 @@ it the dense segment oracle beats the grid overhead) that backs the
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from apex_tpu.ops._utils import default_use_pallas, env_int, pallas_interpret
+from apex_tpu.ops._utils import default_use_pallas, env_flag, env_int, \
+    pallas_interpret
 
 try:
     from jax.experimental.pallas import tpu as _pltpu
@@ -98,7 +98,7 @@ def _auto_use_kernel(t: int, e: int, h: int, f: int, dtype) -> bool:
     segment oracle; env=1 beats the cache (env > cache > model)."""
     if not default_use_pallas("grouped_matmul"):
         return False
-    if os.environ.get("APEX_TPU_USE_PALLAS") == "1":
+    if env_flag("APEX_TPU_USE_PALLAS"):
         return True
     return _gmm_params(t, e, h, f, dtype)["backend"] != "jnp"
 

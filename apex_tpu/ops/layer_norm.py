@@ -16,13 +16,12 @@ native: params are upcast in-kernel and the output takes x.dtype.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from apex_tpu.ops._utils import default_use_pallas, pallas_interpret
+from apex_tpu.ops._utils import default_use_pallas, env_int, pallas_interpret
 
 _BLOCK_ROWS = 256  # historical default; kept for external references
 
@@ -40,13 +39,8 @@ def _block_rows(kernel: str, hidden: int, dtype) -> int:
     Must be a positive multiple of 8: the bwd kernels' per-block partial
     reductions are (8, h) blocks (_group_sum8 / Mosaic sublane quantum).
     """
-    env = os.environ.get("APEX_TPU_LN_BLOCK_ROWS")
-    if env:
-        r = int(env)
-        if r <= 0 or r % 8:
-            raise ValueError(
-                f"APEX_TPU_LN_BLOCK_ROWS={r} must be a positive multiple "
-                f"of 8")
+    r = env_int("APEX_TPU_LN_BLOCK_ROWS", quantum=8)
+    if r is not None:
         return r
     from apex_tpu import tuning
 

@@ -36,13 +36,13 @@ resolution order.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from apex_tpu.ops._utils import default_use_pallas, env_int, pallas_interpret
+from apex_tpu.ops._utils import default_use_pallas, env_flag, env_int, \
+    pallas_interpret
 
 try:
     from jax.experimental.pallas import tpu as _pltpu
@@ -80,7 +80,7 @@ def _auto_use_kernel(n_slots, max_blocks, block_size, group, d, dtype) -> bool:
     class to the oracle; env=1 beats the cache (env > cache > model)."""
     if not default_use_pallas("paged_attention"):
         return False
-    if os.environ.get("APEX_TPU_USE_PALLAS") == "1":
+    if env_flag("APEX_TPU_USE_PALLAS"):
         return True
     return _paged_params(n_slots, max_blocks, block_size, group, d,
                          dtype)["backend"] != "jnp"

@@ -25,7 +25,6 @@ numerics against the jnp oracles.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -39,7 +38,7 @@ except Exception:  # pragma: no cover
     pltpu = None
     _SMEM = None
 
-from apex_tpu.ops._utils import pallas_interpret
+from apex_tpu.ops._utils import env_int, pallas_interpret
 
 LANES = 128
 _BLOCK_ROWS = 2048  # 2048 x 128 fp32 = 1 MiB per operand tile in VMEM
@@ -61,13 +60,8 @@ def _tuned_block_rows(n_tiles: int) -> int:
                                      the measured split above exactly
                                      (2 tiles -> 2048, 7 tiles -> 1024)
     """
-    env = os.environ.get("APEX_TPU_OPTIM_BLOCK_ROWS")
-    if env:
-        r = int(env)
-        if r <= 0 or r % 8:
-            raise ValueError(
-                f"APEX_TPU_OPTIM_BLOCK_ROWS={r} must be a positive "
-                f"multiple of 8")
+    r = env_int("APEX_TPU_OPTIM_BLOCK_ROWS", quantum=8)
+    if r is not None:
         return r
     from apex_tpu import tuning
 

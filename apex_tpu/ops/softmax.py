@@ -24,22 +24,18 @@ row's softmax is independent), only the schedule changes. 0 = untiled
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
+
+from apex_tpu.ops._utils import env_int
 
 MASK_VALUE = -10000.0  # the reference's fill value for masked logits
 
 
 def _row_chunk(rows: int, cols: int, dtype) -> int:
     """Resolved row-chunk size: env > tune cache > 0 (untiled)."""
-    env = os.environ.get("APEX_TPU_SOFTMAX_CHUNK")
-    if env:
-        c = int(env)
-        if c < 0:
-            raise ValueError(
-                f"APEX_TPU_SOFTMAX_CHUNK={c} must be >= 0 (0 = untiled)")
+    c = env_int("APEX_TPU_SOFTMAX_CHUNK", allow_zero=True)
+    if c is not None:
         return c
     from apex_tpu import tuning
 

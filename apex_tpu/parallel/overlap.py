@@ -59,12 +59,13 @@ Env gates (all off by default; each lever independent):
 
 from __future__ import annotations
 
-import os
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from apex_tpu.utils.envvars import env_flag, env_int
 
 __all__ = [
     "all_gather_matmul",
@@ -82,17 +83,17 @@ __all__ = [
 
 def overlap_tp_enabled() -> bool:
     """Decomposed-collective-matmul gate; read at trace time."""
-    return os.environ.get("APEX_TPU_OVERLAP_TP") == "1"
+    return env_flag("APEX_TPU_OVERLAP_TP", default=False)
 
 
 def quantized_comms_enabled() -> bool:
     """Quantized DDP/ZeRO collectives gate; read at trace time."""
-    return os.environ.get("APEX_TPU_QUANTIZED_COMMS") == "1"
+    return env_flag("APEX_TPU_QUANTIZED_COMMS", default=False)
 
 
 def zero_prefetch_enabled() -> bool:
     """ZeRO allgather-prefetch gate; read at trace time."""
-    return os.environ.get("APEX_TPU_ZERO_PREFETCH") == "1"
+    return env_flag("APEX_TPU_ZERO_PREFETCH", default=False)
 
 
 # -- chunk-count resolution (env > tune cache > cost model) ---------------
@@ -106,12 +107,7 @@ def resolve_chunks(rows_local: int, n_ranks: int, dtype,
     result is always clamped to [1, rows_local] so a stale cache entry
     degrades instead of crashing."""
     if chunks is None:
-        env = os.environ.get("APEX_TPU_OVERLAP_TP_CHUNKS")
-        if env:
-            try:
-                chunks = int(env)
-            except ValueError:
-                chunks = None
+        chunks = env_int("APEX_TPU_OVERLAP_TP_CHUNKS")
     if chunks is None:
         from apex_tpu.tuning import cache, shape_class
 

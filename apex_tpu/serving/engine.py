@@ -37,7 +37,6 @@ from __future__ import annotations
 
 import dataclasses
 import math
-import os
 import time
 from typing import Dict, List, Optional
 
@@ -75,12 +74,8 @@ from apex_tpu.observability import (
     observe,
     set_gauge,
 )
+from apex_tpu.utils.envvars import env_int
 from apex_tpu.utils.profiling import host_trace_range, trace_range
-
-
-def _env_default(var: str, fallback: int) -> int:
-    v = os.environ.get(var)
-    return int(v) if v else fallback
 
 
 @dataclasses.dataclass(frozen=True)
@@ -103,10 +98,10 @@ class ServingConfig:
         s = object.__setattr__
         if self.block_size is None:
             s(self, "block_size",
-              _env_default("APEX_TPU_PAGED_BLOCK_SIZE", 16))
+              env_int("APEX_TPU_PAGED_BLOCK_SIZE", default=16))
         if self.max_slots is None:
             s(self, "max_slots",
-              _env_default("APEX_TPU_SERVING_MAX_SLOTS", 8))
+              env_int("APEX_TPU_SERVING_MAX_SLOTS", default=8))
         if self.max_seq_len is None:
             s(self, "max_seq_len", self.model.seq_len)
         if self.max_prefill_len is None:

@@ -59,13 +59,13 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.observability import inc_counter
+from apex_tpu.utils.envvars import env_flag
 from apex_tpu.utils.profiling import trace_range
 
 
@@ -209,7 +209,7 @@ def _dispatch_masks(logits, cfg: MoEConfig, capacity: int):
 
 def _grouped_enabled() -> bool:
     """The trace-time gate (same discipline as parallel/overlap.py)."""
-    return os.environ.get("APEX_TPU_MOE_GROUPED") == "1"
+    return env_flag("APEX_TPU_MOE_GROUPED", default=False)
 
 
 def moe_apply(params, x, cfg: MoEConfig, *,

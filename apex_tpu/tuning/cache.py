@@ -40,6 +40,8 @@ import threading
 from pathlib import Path
 from typing import Dict, Optional
 
+from apex_tpu.utils.envvars import env_flag, env_str
+
 SCHEMA_VERSION = 1
 
 _lock = threading.RLock()
@@ -104,7 +106,7 @@ class TuneDB:
 
 
 def cache_path() -> Path:
-    env = os.environ.get("APEX_TPU_TUNEDB")
+    env = env_str("APEX_TPU_TUNEDB")
     if env:
         return Path(env)
     return Path.home() / ".cache" / "apex_tpu" / "tunedb.json"
@@ -141,7 +143,7 @@ def _build_active() -> TuneDB:
 
 
 def tuning_enabled() -> bool:
-    return os.environ.get("APEX_TPU_TUNE") != "0"
+    return env_flag("APEX_TPU_TUNE", default=True)
 
 
 def active_db() -> TuneDB:

@@ -1,0 +1,80 @@
+"""Validated ``APEX_TPU_*`` environment-knob parsing — the ONE place raw
+``os.environ`` values become ints and flags.
+
+Every runtime knob in the library is an env var read **at call/trace
+time** (never cached at import — the PR-3 ``profiling.py`` bug class,
+now machine-checked by ``apex_tpu.analysis`` rule APX101). Before this
+module each consumer parsed its own string: a bad ``APEX_TPU_MOE_TILE_T``
+surfaced as a bare ``invalid literal for int()`` five frames deep in
+kernel code, and a typo'd flag value silently meant "off". The contract
+here:
+
+* unset / empty  -> the caller's ``default`` (``None`` means "no
+  override" in the resolution chains: env > tune cache > cost model)
+* well-formed    -> the parsed value, validated (positive multiple of
+  ``quantum`` for ints, ``"1"``/``"0"`` for flags)
+* malformed      -> ``ValueError`` naming the VARIABLE and the offending
+  value, raised at the read site (= the first trace that consults the
+  knob), never deeper
+
+``apex_tpu.analysis`` rule APX102 forbids raw ``int(os.environ...)`` /
+``== "1"`` parsing anywhere else in the package, so new knobs cannot
+regress to ad-hoc parsing.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["env_int", "env_flag", "env_str"]
+
+
+def env_int(var: str, *, quantum: int = 1, default=None,
+            allow_zero: bool = False):
+    """Integer env knob: ``default`` when unset/empty, else a validated
+    positive multiple of ``quantum`` (``allow_zero=True`` additionally
+    admits 0 — the "disabled / untiled" convention, e.g.
+    APEX_TPU_SOFTMAX_CHUNK). Malformed values raise naming ``var``."""
+    raw = os.environ.get(var)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{var}={raw!r} must be an integer"
+            + (f" multiple of {quantum}" if quantum > 1 else "")
+        ) from None
+    if v == 0 and allow_zero:
+        return 0
+    if v <= 0 or v % quantum:
+        zero = " (or 0)" if allow_zero else ""
+        raise ValueError(
+            f"{var}={v} must be a positive multiple of {quantum}{zero}")
+    return v
+
+
+def env_flag(var: str, *, default=None):
+    """Boolean env gate: ``"1"`` -> True, ``"0"`` -> False, unset/empty ->
+    ``default``. Anything else raises naming ``var`` — a typo'd gate
+    value must fail loudly, not silently mean "off" (the pre-analysis
+    behavior of every ``== "1"`` comparison)."""
+    raw = os.environ.get(var)
+    if raw is None or raw == "":
+        return default
+    if raw == "1":
+        return True
+    if raw == "0":
+        return False
+    raise ValueError(
+        f"{var}={raw!r} must be '1' or '0' (unset = default)")
+
+
+def env_str(var: str, *, default=None):
+    """String env knob (paths, sink kinds): ``default`` when unset/empty.
+    Exists so string knobs share the one read surface the linter
+    allowlists — validation of the *values* stays with the consumer."""
+    raw = os.environ.get(var)
+    if raw is None or raw == "":
+        return default
+    return raw

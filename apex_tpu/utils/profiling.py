@@ -12,10 +12,11 @@ from __future__ import annotations
 
 import contextlib
 import functools
-import os
 from typing import Iterator, Optional
 
 import jax
+
+from apex_tpu.utils.envvars import env_flag
 
 # master switch mirroring the reference's DistributedDataParallel(prof=...).
 # None = "no programmatic override": trace_range then follows the env var
@@ -36,9 +37,9 @@ def set_profiling_enabled(enabled: bool) -> None:
 def profiling_enabled() -> bool:
     """The switch trace_range consults, resolved at CALL time:
     APEX_TPU_PROF env (when set) > set_profiling_enabled > default on."""
-    env = os.environ.get("APEX_TPU_PROF")
+    env = env_flag("APEX_TPU_PROF")
     if env is not None:
-        return env == "1"
+        return env
     if _PROF_OVERRIDE is not None:
         return _PROF_OVERRIDE
     return True

@@ -646,6 +646,52 @@ def _obs_compile_rung(on_cpu: bool, timeout_s: float) -> dict:
     return rung
 
 
+def _analysis_compile_rung() -> dict:
+    """The static-analysis self-check as a gate rung: the full self-run
+    (AST lint + jaxpr auditors) plus the seeded kernel-sanitizer sweep
+    over every registered tunable family. Zero unsuppressed findings is
+    the verdict — the same pin tests/L0/test_analysis.py holds, surfaced
+    in the compile gate so a lint regression names itself next to the
+    kernel dry-compiles."""
+    import time as _time
+
+    rung = {"rung": "analysis", "batch": None, "remat": "analysis"}
+    try:
+        from apex_tpu.analysis import run as analysis_run
+
+        t0 = _time.perf_counter()
+        report = analysis_run()
+        dt = _time.perf_counter() - t0
+        families = [s["family"] for s in
+                    report["stats"].get("sanitize", [])]
+        ok = report["exit_code"] == 0
+        if ok:
+            print(f"bench: compile-only rung analysis: OK ({dt:.1f}s — "
+                  f"{report['stats'].get('lint_files', 0)} files linted, "
+                  f"{report['stats'].get('audited_entry_points', 0)} "
+                  f"entry points audited, {len(families)} families "
+                  f"sanitized)", file=sys.stderr, flush=True)
+            rung.update(ok=True, compile_s=round(dt, 1),
+                        errors=0, families=families)
+        else:
+            worst = [f.format() for f in report["findings"]
+                     if not f.suppressed and f.severity == "error"][:3]
+            print(f"bench: compile-only rung analysis: FAILED — "
+                  f"{report['errors']} finding(s), exit "
+                  f"{report['exit_code']}; first: {'; '.join(worst)}",
+                  file=sys.stderr, flush=True)
+            rung.update(ok=False, errors=report["errors"],
+                        exit_code=report["exit_code"])
+    except Exception as e:  # noqa: BLE001 — a failing rung is data
+        print(f"bench: compile-only rung analysis: FAILED — marked "
+              f"skipped ({type(e).__name__}: "
+              f"{str(e).splitlines()[0][:200]})", file=sys.stderr,
+              flush=True)
+        rung.update(ok=False, skipped=True,
+                    error=str(e).splitlines()[0][:200])
+    return rung
+
+
 def _moe_compile_rungs(on_cpu: bool, timeout_s: float) -> list:
     """Dry-compile the MoE dispatch steps as one gate rung PER PATH
     (einsum / grouped / dropless — a per-rung verdict line for each, so
@@ -1061,6 +1107,7 @@ def main():
         compile_rungs.append(_serving_compile_rung(on_cpu, gate_timeout))
         compile_rungs.extend(_moe_compile_rungs(on_cpu, gate_timeout))
         compile_rungs.append(_obs_compile_rung(on_cpu, gate_timeout))
+        compile_rungs.append(_analysis_compile_rung())
         emit(_compile_only_payload(compile_rungs, kernel_report))
         return
 

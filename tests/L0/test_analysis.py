@@ -1,0 +1,747 @@
+"""apex_tpu.analysis: lint-rule corpus, jaxpr auditors, kernel
+sanitizer, and the self-hosting pin.
+
+Layout mirrors the subsystem:
+
+* a seeded true/false-positive corpus per lint rule (every rule both
+  fires and stays silent, incl. pragma suppression),
+* regression fixtures re-introducing the PR-3 ``profiling.py``
+  env-caching bug and the PR-5 missing-``functools.wraps`` bug,
+* auditor checks driven through real ``make_jaxpr`` programs (donation
+  hazard, signature drift, collective consistency),
+* sanitizer checks: the registered families validate over a seeded
+  subsample (full sweep is ``slow``-marked), and a deliberately broken
+  BlockSpec fixture is rejected,
+* the self-run pin: ``apex_tpu.analysis.run`` over the installed
+  package reports ZERO unsuppressed findings — the suite lints every
+  future PR.
+"""
+
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.analysis import run
+from apex_tpu.analysis.findings import (Finding, Pragmas, RULES, layer_bit,
+                                        summarize)
+from apex_tpu.analysis.lint import lint_source
+from apex_tpu.analysis.sanitizer import (BlockGeom, FAMILIES, KernelGeom,
+                                         check_geometry, replay_gmm_schedule,
+                                         replay_tgmm_schedule,
+                                         sanitize_families)
+from apex_tpu.utils.envvars import env_flag, env_int
+
+
+def _rules(findings, *, include_suppressed=False):
+    return sorted({f.rule for f in findings
+                   if include_suppressed or not f.suppressed})
+
+
+def _lint(snippet: str, rel: str = "pkg/mod.py"):
+    return lint_source(textwrap.dedent(snippet), rel, rel)
+
+
+# ---------------------------------------------------------------------------
+# APX101 — env read at module scope
+# ---------------------------------------------------------------------------
+
+def test_apx101_fires_on_module_scope_read():
+    findings = _lint("""
+        import os
+        _CACHED = os.environ.get("APEX_TPU_PROF")
+    """)
+    assert "APX101" in _rules(findings)
+
+
+def test_apx101_silent_on_call_time_read():
+    findings = _lint("""
+        import os
+        def enabled():
+            return os.environ.get("APEX_TPU_PROF")
+    """)
+    assert "APX101" not in _rules(findings)
+
+
+def test_apx101_silent_on_function_defined_under_try():
+    """A call-time read inside a function whose def sits under a
+    top-level try/if is NOT an import-time read."""
+    findings = _lint("""
+        import os
+        try:
+            import fancy
+        except ImportError:
+            def fallback():
+                return os.environ.get("APEX_TPU_X")
+    """)
+    assert "APX101" not in _rules(findings)
+
+
+def test_apx101_fires_inside_class_body():
+    """Class bodies DO execute at import."""
+    findings = _lint("""
+        import os
+        class Config:
+            home = os.environ.get("HOME")
+    """)
+    assert "APX101" in _rules(findings)
+
+
+def test_apx101_pragma_suppresses_but_keeps_evidence():
+    findings = _lint("""
+        import os
+        _HOME = os.environ.get("HOME")  # apexlint: disable=APX101
+    """)
+    assert "APX101" not in _rules(findings)
+    assert "APX101" in _rules(findings, include_suppressed=True)
+
+
+def test_regression_pr3_profiling_env_caching_bug():
+    """The exact PR-3 bug shape: the gate parsed ONCE at import and
+    consumed by the jitted path — flipping APEX_TPU_PROF after import
+    silently did nothing."""
+    findings = _lint("""
+        import os
+        import jax
+
+        _PROF = os.environ.get("APEX_TPU_PROF") == "1"
+
+        @jax.jit
+        def step(x):
+            if _PROF:
+                x = x + 1
+            return x
+    """)
+    fired = _rules(findings)
+    assert "APX101" in fired          # frozen at import
+    assert "APX102" in fired          # ad-hoc == "1" parse
+
+
+# ---------------------------------------------------------------------------
+# APX102 — raw env int/flag parsing
+# ---------------------------------------------------------------------------
+
+def test_apx102_fires_on_raw_int():
+    findings = _lint("""
+        import os
+        def block():
+            return int(os.environ.get("APEX_TPU_MOE_TILE_T", "512"))
+    """)
+    assert "APX102" in _rules(findings)
+
+
+def test_apx102_follows_alias():
+    findings = _lint("""
+        import os
+        def block():
+            raw = os.environ.get("APEX_TPU_MOE_TILE_T")
+            return int(raw)
+    """)
+    assert "APX102" in _rules(findings)
+
+
+def test_apx102_follows_annassign_and_walrus_aliases():
+    findings = _lint("""
+        import os
+        def ann():
+            v: str = os.environ.get("APEX_TPU_X")
+            return int(v)
+    """)
+    assert "APX102" in _rules(findings)
+    findings = _lint("""
+        import os
+        def walrus():
+            if (w := os.environ.get("APEX_TPU_Y")):
+                return int(w)
+    """)
+    assert "APX102" in _rules(findings)
+
+
+def test_apx102_fires_on_flag_compare():
+    findings = _lint("""
+        import os
+        def gate():
+            return os.environ.get("APEX_TPU_MOE_GROUPED") == "1"
+    """)
+    assert "APX102" in _rules(findings)
+
+
+def test_apx102_silent_on_envvars_helpers():
+    findings = _lint("""
+        from apex_tpu.utils.envvars import env_flag, env_int
+        def block():
+            return env_int("APEX_TPU_MOE_TILE_T", quantum=8)
+        def gate():
+            return env_flag("APEX_TPU_MOE_GROUPED", default=False)
+    """)
+    assert "APX102" not in _rules(findings)
+
+
+def test_apx102_exempts_the_helper_module_itself():
+    findings = _lint("""
+        import os
+        def env_int(var):
+            return int(os.environ.get(var, "0"))
+    """, rel="utils/envvars.py")
+    assert "APX102" not in _rules(findings)
+
+
+def test_apx102_exemption_survives_narrowed_root(tmp_path):
+    """Pointing the CLI at the utils directory itself narrows rel to
+    just 'envvars.py' — the exemption must hold via the absolute
+    path."""
+    from apex_tpu.analysis.lint import lint_file
+
+    d = tmp_path / "utils"
+    d.mkdir()
+    f = d / "envvars.py"
+    f.write_text("import os\n\ndef env_int(var):\n"
+                 "    return int(os.environ.get(var, '0'))\n")
+    assert lint_file(str(f), root=str(d)) == []
+
+
+# ---------------------------------------------------------------------------
+# APX103 — host syncs inside jitted code
+# ---------------------------------------------------------------------------
+
+def test_apx103_fires_on_item_in_jitted_fn():
+    findings = _lint("""
+        import jax
+        @jax.jit
+        def step(x):
+            return x.sum().item()
+    """)
+    assert "APX103" in _rules(findings)
+
+
+def test_apx103_fires_on_device_get_in_assigned_jit():
+    findings = _lint("""
+        import jax
+        def body(x):
+            return jax.device_get(x)
+        step = jax.jit(body)
+    """)
+    assert "APX103" in _rules(findings)
+
+
+def test_apx103_fires_on_np_asarray_in_pallas_kernel():
+    findings = _lint("""
+        import functools
+        import numpy as np
+        from jax.experimental import pallas as pl
+        def _kernel(x_ref, o_ref, scale):
+            o_ref[...] = np.asarray(x_ref[...]) * scale
+        def op(x):
+            return pl.pallas_call(functools.partial(_kernel, scale=2),
+                                  out_shape=x)(x)
+    """)
+    assert "APX103" in _rules(findings)
+
+
+def test_apx103_silent_in_host_code():
+    """The triage the rule promises: syncs OUTSIDE hot functions are the
+    allowlist (drainer harvest, scheduler loops)."""
+    findings = _lint("""
+        import jax
+        def harvest(buf):
+            return jax.device_get(buf)
+        def report(x):
+            return x.sum().item()
+    """)
+    assert "APX103" not in _rules(findings)
+
+
+def test_apx103_fires_on_float_of_traced_param():
+    findings = _lint("""
+        import jax
+        @jax.jit
+        def step(x):
+            return float(x)
+    """)
+    assert "APX103" in _rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# APX104 — decorator wrapper without functools.wraps
+# ---------------------------------------------------------------------------
+
+_DECORATOR_BUG = """
+    def annotate(fn):
+        def wrapper(*args, **kwargs):
+            return fn(*args, **kwargs)
+        return wrapper
+"""
+
+
+def test_regression_pr5_missing_wraps_bug():
+    """The exact PR-5 profiling.annotate bug shape."""
+    findings = _lint(_DECORATOR_BUG)
+    assert "APX104" in _rules(findings)
+
+
+def test_apx104_silent_with_wraps():
+    findings = _lint("""
+        import functools
+        def annotate(fn):
+            @functools.wraps(fn)
+            def wrapper(*args, **kwargs):
+                return fn(*args, **kwargs)
+            return wrapper
+    """)
+    assert "APX104" not in _rules(findings)
+
+
+def test_apx104_silent_on_explicit_signature_hofs():
+    """Step builders / index-map factories deliberately don't match."""
+    findings = _lint("""
+        def make_step(loss):
+            def step(params, batch):
+                return loss(params, batch)
+            return step
+    """)
+    assert "APX104" not in _rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# APX105 — truthiness on traced values
+# ---------------------------------------------------------------------------
+
+def test_apx105_fires_on_if_jnp_in_jitted_fn():
+    findings = _lint("""
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def step(x):
+            if jnp.any(x > 0):
+                return x
+            return -x
+    """)
+    assert "APX105" in _rules(findings)
+
+
+def test_apx105_silent_on_lax_cond_and_host_code():
+    findings = _lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        @jax.jit
+        def step(x):
+            return jnp.where(x > 0, x, -x)
+        def host(x):
+            if jnp.any(x > 0):
+                return x
+            return -x
+    """)
+    assert "APX105" not in _rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# findings / pragma plumbing
+# ---------------------------------------------------------------------------
+
+def test_pragma_disable_all_and_multi():
+    src = "x = 1  # apexlint: disable=APX101,APX103\ny = 2  # apexlint: disable=all\n"
+    p = Pragmas(src)
+    assert p.suppressed("APX101", 1) and p.suppressed("APX103", 1)
+    assert not p.suppressed("APX104", 1)
+    assert p.suppressed("APX999", 2)
+
+
+def test_layer_bits_and_exit_code():
+    assert layer_bit("APX101") == 1
+    assert layer_bit("APX203") == 2
+    assert layer_bit("APX304") == 4
+    findings = [Finding("APX101", "a.py", 1, "m"),
+                Finding("APX301", "b.py", 1, "m"),
+                Finding("APX305", "c.py", 1, "m")]  # info: never fails
+    rep = summarize(findings)
+    assert rep["exit_code"] == 5
+    assert rep["errors"] == 2
+
+
+def test_rule_catalog_is_stable():
+    assert set(RULES) == {
+        "APX101", "APX102", "APX103", "APX104", "APX105",
+        "APX201", "APX202", "APX203",
+        "APX301", "APX302", "APX303", "APX304", "APX305",
+    }
+    assert RULES["APX305"].severity == "info"
+
+
+# ---------------------------------------------------------------------------
+# envvars helpers (the satellite: errors name the variable)
+# ---------------------------------------------------------------------------
+
+def test_env_int_names_the_variable(monkeypatch):
+    monkeypatch.setenv("APEX_TPU_MOE_TILE_T", "banana")
+    with pytest.raises(ValueError, match="APEX_TPU_MOE_TILE_T"):
+        env_int("APEX_TPU_MOE_TILE_T", quantum=8)
+    monkeypatch.setenv("APEX_TPU_MOE_TILE_T", "12")  # not a multiple of 8
+    with pytest.raises(ValueError, match="APEX_TPU_MOE_TILE_T"):
+        env_int("APEX_TPU_MOE_TILE_T", quantum=8)
+    monkeypatch.setenv("APEX_TPU_MOE_TILE_T", "16")
+    assert env_int("APEX_TPU_MOE_TILE_T", quantum=8) == 16
+    monkeypatch.delenv("APEX_TPU_MOE_TILE_T")
+    assert env_int("APEX_TPU_MOE_TILE_T", default=512) == 512
+
+
+def test_env_int_allow_zero():
+    os.environ.pop("APEX_TPU_SOFTMAX_CHUNK", None)
+    assert env_int("APEX_TPU_SOFTMAX_CHUNK", allow_zero=True) is None
+    try:
+        os.environ["APEX_TPU_SOFTMAX_CHUNK"] = "0"
+        assert env_int("APEX_TPU_SOFTMAX_CHUNK", allow_zero=True) == 0
+        with pytest.raises(ValueError, match="APEX_TPU_SOFTMAX_CHUNK"):
+            env_int("APEX_TPU_SOFTMAX_CHUNK")   # zero not allowed here
+    finally:
+        os.environ.pop("APEX_TPU_SOFTMAX_CHUNK", None)
+
+
+def test_env_flag_rejects_typos(monkeypatch):
+    monkeypatch.setenv("APEX_TPU_MOE_GROUPED", "yes")
+    with pytest.raises(ValueError, match="APEX_TPU_MOE_GROUPED"):
+        env_flag("APEX_TPU_MOE_GROUPED")
+    monkeypatch.setenv("APEX_TPU_MOE_GROUPED", "1")
+    assert env_flag("APEX_TPU_MOE_GROUPED") is True
+    monkeypatch.setenv("APEX_TPU_MOE_GROUPED", "0")
+    assert env_flag("APEX_TPU_MOE_GROUPED") is False
+    monkeypatch.delenv("APEX_TPU_MOE_GROUPED")
+    assert env_flag("APEX_TPU_MOE_GROUPED", default=False) is False
+
+
+def test_converted_knob_sites_raise_named_errors(monkeypatch):
+    """The unified parsing reaches the real knob sites: a malformed
+    value surfaces at the read site naming the variable, not as a bare
+    ValueError deep in kernel code."""
+    from apex_tpu.ops.layer_norm import _block_rows
+    from apex_tpu.parallel import overlap
+
+    monkeypatch.setenv("APEX_TPU_LN_BLOCK_ROWS", "13")
+    with pytest.raises(ValueError, match="APEX_TPU_LN_BLOCK_ROWS"):
+        _block_rows("layer_norm", 1024, np.dtype(np.float32))
+    monkeypatch.delenv("APEX_TPU_LN_BLOCK_ROWS")
+
+    monkeypatch.setenv("APEX_TPU_OVERLAP_TP", "on")
+    with pytest.raises(ValueError, match="APEX_TPU_OVERLAP_TP"):
+        overlap.overlap_tp_enabled()
+
+
+# ---------------------------------------------------------------------------
+# jaxpr auditors
+# ---------------------------------------------------------------------------
+
+def test_apx201_fires_on_use_after_donation():
+    from apex_tpu.analysis.auditors import audit_donation
+
+    step = jax.jit(lambda x: x * 2.0, donate_argnums=0)
+
+    def bad(x):
+        y = step(x)
+        return y + x          # touches the donated buffer again
+
+    closed = jax.make_jaxpr(bad)(np.ones((4,), np.float32))
+    findings = audit_donation(closed, "<t>")
+    assert _rules(findings) == ["APX201"]
+
+
+def test_apx201_silent_on_correct_protocol():
+    from apex_tpu.analysis.auditors import audit_donation
+
+    step = jax.jit(lambda x: x * 2.0, donate_argnums=0)
+
+    def good(x):
+        y = step(x)
+        return y + 1.0        # only the replacement value is carried
+
+    closed = jax.make_jaxpr(good)(np.ones((4,), np.float32))
+    assert audit_donation(closed, "<t>") == []
+
+
+def test_apx201_catches_donated_operand_escaping_as_output():
+    from apex_tpu.analysis.auditors import audit_donation
+
+    step = jax.jit(lambda x: x * 2.0, donate_argnums=0)
+
+    def leak(x):
+        y = step(x)
+        return y, x           # donated operand escapes
+
+    closed = jax.make_jaxpr(leak)(np.ones((4,), np.float32))
+    assert _rules(audit_donation(closed, "<t>")) == ["APX201"]
+
+
+def test_apx202_fires_on_dtype_drift():
+    from apex_tpu.analysis.auditors import audit_signature_drift
+
+    fn = lambda x: x + 1  # noqa: E731
+    findings = audit_signature_drift(
+        fn, (np.ones((2,), np.float32),), (np.ones((2,), np.int32),),
+        "<t>")
+    assert _rules(findings) == ["APX202"]
+
+
+def test_apx202_fires_on_weak_type_drift():
+    from apex_tpu.analysis.auditors import audit_signature_drift
+
+    fn = lambda x: x + 1  # noqa: E731
+    strong = jnp.float32(1.0)          # committed f32 aval
+    weak = 1.0                         # python scalar: weak f32
+    findings = audit_signature_drift(fn, (strong,), (weak,), "<t>")
+    assert _rules(findings) == ["APX202"]
+
+
+def test_apx202_silent_on_identical_signatures():
+    from apex_tpu.analysis.auditors import audit_signature_drift
+
+    fn = lambda x: x + 1  # noqa: E731
+    findings = audit_signature_drift(
+        fn, (np.ones((2,), np.float32),), (np.zeros((2,), np.float32),),
+        "<t>")
+    assert findings == []
+
+
+def _collective_jaxpr(fn, n, axis):
+    """Trace ``fn`` inside an axis environment so the collective
+    primitive survives into the jaxpr (vmap would batch it away)."""
+    return jax.make_jaxpr(fn, axis_env=[(axis, n)])(
+        np.ones((2,), np.float32))
+
+
+def test_apx203_fires_on_unbound_axis():
+    from apex_tpu.analysis.auditors import audit_collectives
+
+    closed = _collective_jaxpr(
+        lambda x: jax.lax.psum(x, "batch"), 4, "batch")
+    findings = audit_collectives(closed, {}, "<t>")
+    assert "APX203" in _rules(findings)
+    assert audit_collectives(closed, {"batch": 4}, "<t>") == []
+
+
+def test_apx203_fires_on_duplicate_ppermute_destination():
+    from apex_tpu.analysis.auditors import audit_collectives
+
+    n = 4
+    perm = [(0, 1), (1, 1), (2, 3), (3, 0)]   # rank 1 receives twice
+    closed = _collective_jaxpr(
+        lambda x: jax.lax.ppermute(x, "ring", perm), n, "ring")
+    findings = audit_collectives(closed, {"ring": n}, "<t>")
+    assert any("duplicate" in f.message for f in findings)
+
+
+def test_apx203_fires_on_out_of_range_rank():
+    from apex_tpu.analysis.auditors import audit_collectives
+
+    n = 2
+    perm = [(0, 1), (1, 5)]                    # rank 5 does not exist
+    closed = _collective_jaxpr(
+        lambda x: jax.lax.ppermute(x, "ring", perm), n, "ring")
+    findings = audit_collectives(closed, {"ring": n}, "<t>")
+    assert any("outside" in f.message for f in findings)
+
+
+def test_apx203_silent_on_valid_ring():
+    from apex_tpu.analysis.auditors import audit_collectives
+
+    n = 4
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    closed = _collective_jaxpr(
+        lambda x: jax.lax.ppermute(x, "ring", perm), n, "ring")
+    assert audit_collectives(closed, {"ring": n}, "<t>") == []
+
+
+def test_default_entry_points_audit_clean():
+    """The repo's own representative programs (train step, DDP/ZeRO
+    flushes, decomposed TP matmul, paged decode) pass all three
+    audits."""
+    from apex_tpu.analysis.auditors import (audit_entry_points,
+                                            default_entry_points)
+
+    eps = default_entry_points()
+    assert len(eps) == 5
+    findings = audit_entry_points(eps)
+    assert [f.format() for f in findings] == []
+
+
+# ---------------------------------------------------------------------------
+# kernel sanitizer
+# ---------------------------------------------------------------------------
+
+def test_sanitizer_subsample_all_families_clean():
+    """The tier-1 sweep: seeded subsample per family, zero errors (info
+    inventory allowed)."""
+    findings, stats = sanitize_families(seed=0, sample=24)
+    errors = [f for f in findings if f.severity == "error"]
+    assert [f.format() for f in errors] == []
+    assert {s["family"] for s in stats} == set(FAMILIES)
+    assert all(s["checked"] > 0 for s in stats)
+
+
+@pytest.mark.slow
+def test_sanitizer_full_sweep_clean():
+    """The exhaustive lane: every (shape, candidate) pair of every
+    registered family."""
+    findings, stats = sanitize_families(full=True)
+    errors = [f for f in findings if f.severity == "error"]
+    assert [f.format() for f in errors] == []
+    # the full space is strictly larger than the tier-1 subsample
+    assert sum(s["checked"] for s in stats) > 300
+
+
+def test_broken_blockspec_divisibility_rejected():
+    geom = KernelGeom(
+        "fixture", (4,),
+        [BlockGeom("x", (48,), (256,), lambda i: (i,))])  # 256 % 48 != 0
+    assert "APX301" in _rules(check_geometry(geom))
+
+
+def test_unclamped_index_map_rejected():
+    # grid walks 4 blocks but the array only holds 3 — the shipped
+    # kernels clamp; this fixture does not
+    geom = KernelGeom(
+        "fixture", (4,),
+        [BlockGeom("x", (64,), (192,), lambda i: (i,))])
+    findings = check_geometry(geom)
+    assert "APX303" in _rules(findings)
+    # and the clamped version of the same geometry passes
+    ok = KernelGeom(
+        "fixture", (4,),
+        [BlockGeom("x", (64,), (192,), lambda i: (min(i, 2),))])
+    assert "APX303" not in _rules(check_geometry(ok))
+
+
+def test_vmem_budget_violation_rejected():
+    geom = KernelGeom(
+        "fixture", (2,),
+        [BlockGeom("x", (64,), (128,), lambda i: (i,))],
+        vmem_bytes=1 << 40, vmem_budget=1 << 27)
+    assert "APX302" in _rules(check_geometry(geom))
+
+
+def test_index_map_arity_mismatch_rejected():
+    """An index map returning too few indices for its block rank must
+    be rejected, not silently bounds-checked on a prefix of the dims."""
+    geom = KernelGeom(
+        "fixture", (2,),
+        [BlockGeom("x", (64, 128), (128, 256), lambda i: (i,))])
+    findings = check_geometry(geom)
+    assert "APX303" in _rules(findings)
+    assert any("arity" in f.message for f in findings)
+
+
+def test_negative_index_map_rejected():
+    geom = KernelGeom(
+        "fixture", (2,),
+        [BlockGeom("x", (64,), (128,), lambda i: (i - 1,))])
+    assert "APX303" in _rules(check_geometry(geom))
+
+
+def test_group_distributions_respect_the_gmm_contract():
+    """Every adversarial distribution must satisfy sum(groups) <= t for
+    ANY (t, e) — e.g. t=8, e=8 once fabricated sum 24 > t."""
+    import random as _random
+
+    from apex_tpu.analysis.sanitizer import _group_distributions
+
+    for t, e in ((8, 8), (64, 4), (17, 5), (1024, 8)):
+        for dist in _group_distributions(e, t, _random.Random(0)):
+            assert len(dist) == e
+            assert all(g >= 0 for g in dist)
+            assert sum(dist) <= t, (t, e, dist)
+
+
+def test_gmm_replay_clean_on_real_schedules():
+    for groups in ([0, 0, 0, 0], [64, 0, 0, 0], [0, 0, 0, 64],
+                   [16, 16, 16, 16], [13, 7, 31, 5]):
+        assert replay_gmm_schedule(groups, 64, 16) == []
+        assert replay_tgmm_schedule(groups, 64, 16) == []
+
+
+def test_gmm_replay_catches_corrupted_schedule(monkeypatch):
+    """Corrupt the work list the way a buggy metadata builder would
+    (a tile revisited after its flush) and require APX304."""
+    import apex_tpu.ops.grouped_matmul as gm
+
+    real = gm._group_metadata
+
+    def corrupted(group_sizes, t_pad, tile_t):
+        wt, wg, offs = real(group_sizes, t_pad, tile_t)
+        wt = np.asarray(wt).copy()
+        # tile 0's chain re-opens after its flush (and the tile that
+        # work item used to cover is never flushed at all)
+        wt[2] = wt[0]
+        return jnp.asarray(wt), wg, offs
+
+    monkeypatch.setattr(gm, "_group_metadata", corrupted)
+    findings = replay_gmm_schedule([16, 16, 16, 16], 64, 16)
+    assert findings, "corrupted schedule must be rejected"
+    assert _rules(findings) == ["APX304"]
+    assert any("re-opens" in f.message for f in findings)
+    assert any("never flushed" in f.message for f in findings)
+
+
+def test_swept_vmem_busts_become_info_not_errors():
+    """A candidate that merely exists in the sweep space and busts VMEM
+    is APX305 inventory; only resolution-chain picks are errors."""
+    findings, _ = sanitize_families(["flash"], full=True)
+    assert all(f.severity == "info" for f in findings
+               if f.rule == "APX305")
+    assert not any(f.rule == "APX302" for f in findings
+                   if f.severity == "error")
+
+
+# ---------------------------------------------------------------------------
+# CLI + self-hosting pin
+# ---------------------------------------------------------------------------
+
+def test_cli_exit_code_bits_on_bad_file(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\n_X = os.environ.get('A')\n")
+    from apex_tpu.analysis.cli import main
+
+    assert main([str(bad), "--no-audit", "--no-sanitize"]) == 1
+
+
+def test_cli_json_report(tmp_path, capsys):
+    import json
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\n_X = os.environ.get('A')\n")
+    from apex_tpu.analysis.cli import main
+
+    code = main([str(bad), "--no-audit", "--no-sanitize", "--json"])
+    rep = json.loads(capsys.readouterr().out)
+    assert code == rep["exit_code"] == 1
+    assert rep["per_rule"].get("APX101") == 1
+    assert rep["findings"][0]["rule"] == "APX101"
+
+
+def test_cli_list_rules(capsys):
+    from apex_tpu.analysis.cli import main
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "APX101" in out and "APX304" in out
+
+
+def test_strict_promotes_warnings(monkeypatch):
+    warn = Finding("APX101", "a.py", 1, "m", severity="warn")
+    assert summarize([warn])["exit_code"] == 0
+    assert summarize([warn], strict=True)["exit_code"] == 1
+
+
+def test_self_run_is_clean():
+    """THE self-hosting pin: the analyzer over its own package reports
+    zero unsuppressed findings (lint + auditors + seeded sanitizer
+    subsample). Every future PR is linted by this test."""
+    report = run()
+    findings = report["findings"]
+    unsuppressed = [f.format() for f in findings
+                    if not f.suppressed and f.severity != "info"]
+    assert unsuppressed == []
+    assert report["exit_code"] == 0
+    assert report["errors"] == 0
+    assert report["stats"]["lint_files"] > 40
+    assert report["stats"]["audited_entry_points"] == 5

@@ -327,17 +327,23 @@ def test_dbias_threshold_decoupled_from_stream_switch(monkeypatch):
     _check_dbias_seq(mid, mid)
 
 
-def test_dbias_guard_honors_any_forced_resident_value(monkeypatch):
-    """_use_streaming treats any env value other than "1" as forced
-    resident; the guard must use the same parse (a user who set
-    APEX_TPU_FLASH_STREAM=off already owns the memory cost)."""
+def test_dbias_guard_honors_forced_resident_value(monkeypatch):
+    """_use_streaming treats an explicit "0" as forced resident; the
+    guard must use the same parse (a user who set APEX_TPU_FLASH_STREAM=0
+    already owns the memory cost). Any other non-"1" value now raises
+    naming the variable — the unified env_flag contract (a typo'd gate
+    must fail loudly, not silently flip the kernel family)."""
     from apex_tpu.ops.attention import _DBIAS_SEQ, _check_dbias_seq
 
-    long = jnp.zeros((1, _DBIAS_SEQ * 2, 64))
-    monkeypatch.setenv("APEX_TPU_FLASH_STREAM", "off")
-    _check_dbias_seq(long, long)
-    monkeypatch.setenv("APEX_TPU_FLASH_STREAM", "1")
     import pytest as _pytest
+
+    long = jnp.zeros((1, _DBIAS_SEQ * 2, 64))
+    monkeypatch.setenv("APEX_TPU_FLASH_STREAM", "0")
+    _check_dbias_seq(long, long)
+    monkeypatch.setenv("APEX_TPU_FLASH_STREAM", "off")
+    with _pytest.raises(ValueError, match="APEX_TPU_FLASH_STREAM"):
+        _check_dbias_seq(long, long)
+    monkeypatch.setenv("APEX_TPU_FLASH_STREAM", "1")
     with _pytest.raises(NotImplementedError):
         _check_dbias_seq(long, long)
 
