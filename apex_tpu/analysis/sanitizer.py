@@ -551,47 +551,77 @@ def _overlap_extra(params: dict, features: dict, tag: str
     return findings
 
 
-# -- paged decode ----------------------------------------------------------
+# -- paged decode (ragged multi-query) --------------------------------------
 
 def _paged_shapes() -> List[dict]:
-    return [{"slots": s, "max_blocks": mb, "bs": 16, "group": g, "d": 64,
-             "nb": 32}
-            for s in (4,) for mb in (1, 7) for g in (1, 4)]
+    return [{"slots": 4, "max_blocks": mb, "bs": 16, "group": g, "d": 64,
+             "nb": 32, "tq": tq}
+            for mb in (1, 7) for g in (1, 4) for tq in (4, 24)]
+
+
+def _paged_layout(s_n: int, tq: int, q_tile: int) -> List[int]:
+    """Adversarial per-slot query lengths for the work-list model: an
+    idle slot, single-token decodes, and one chunk taking every
+    remaining row (crossing q_tile boundaries whenever tq allows)."""
+    ql = [1] * s_n
+    ql[1 % s_n] = 0
+    ql[0] = max(1, tq - sum(ql[1:]))
+    del q_tile  # the chunk crosses tiles for any q_tile < ql[0]
+    return ql
 
 
 def _paged_build(params: dict, features: dict) -> Optional[KernelGeom]:
+    """Mirror of ops.paged_attention._ragged_pallas: grid
+    (work item, kv head, fetch step) over the static (slot, q-tile) work
+    list, whole-array q/out blocks, per-fetch KV page blocks selected by
+    the table through clamped flat indices."""
     if params.get("backend") == "jnp":
         return None
     s_n, mb = features["slots"], features["max_blocks"]
     bs, group, d = features["bs"], features["group"], features["d"]
-    nb = features["nb"]
+    nb, tq = features["nb"], features["tq"]
     hkv = 2
+    hq = hkv * group
     fetch = min(params["kv_fetch"], max(1, mb))
-    rows = max(params["block_rows"], _pad_to(group, 8))
+    q_tile = params["q_tile"]
+    rows = max(params["block_rows"], q_tile * group)
     nj = _ceil(mb, fetch)
+    n_work = _ceil(tq, q_tile) + s_n
+    tq_pad = tq + q_tile
+
+    # the work list exactly as _work_metadata builds it (plain ints)
+    ql = _paged_layout(s_n, tq, q_tile)
+    work_slot: List[int] = []
+    for s, n in enumerate(ql):
+        work_slot.extend([s] * _ceil(n, q_tile))
+    work_slot = (work_slot + [s_n] * n_work)[:n_work]  # sentinel pad
+
     # adversarial block table: first/last pool pages + the clamp target
     table = [(si * 7 + j * 3) % nb for si in range(s_n) for j in range(mb)]
     flat_len = len(table)
 
     def page_map(i):
-        def index(s, h, j):
+        def index(w, h, j):
+            s = min(work_slot[w], s_n - 1)
             flat = min(max(s * mb + j * fetch + i, 0), flat_len - 1)
             return (table[flat], 0, h, 0)
         return index
 
-    blocks = [BlockGeom("q", (1, 1, rows, d), (s_n, hkv, rows, d),
-                        lambda s, h, j: (s, h, 0, 0)),
-              BlockGeom("out", (1, 1, rows, d), (s_n, hkv, rows, d),
-                        lambda s, h, j: (s, h, 0, 0))]
+    blocks = [BlockGeom("q", (tq_pad, hq, d), (tq_pad, hq, d),
+                        lambda w, h, j: (0, 0, 0)),
+              BlockGeom("out", (tq_pad, hq, d), (tq_pad, hq, d),
+                        lambda w, h, j: (0, 0, 0))]
     for i in range(fetch):
         blocks.append(BlockGeom(f"k{i}", (1, bs, 1, d), (nb, bs, hkv, d),
                                 page_map(i)))
         blocks.append(BlockGeom(f"v{i}", (1, bs, 1, d), (nb, bs, hkv, d),
                                 page_map(i)))
     bytes_el = 2
-    vmem = fetch * 2 * bs * d * bytes_el * 2 + rows * d * 4 + 2 * rows * 4
+    vmem = (2 * tq_pad * hq * d * bytes_el          # resident q + out
+            + fetch * 2 * bs * d * bytes_el * 2     # double-buffered pages
+            + rows * d * 4 + 2 * rows * 4)          # (acc, m, l) scratch
     return KernelGeom(
-        "paged_decode", (s_n, hkv, nj), blocks,
+        "paged_decode", (n_work, hkv, nj), blocks,
         vmem_bytes=vmem, vmem_budget=_vmem_budget(),
         tag=_tag("paged_decode", features, params))
 
@@ -604,6 +634,7 @@ def _paged_defaults(features: dict) -> dict:
             features["group"]),
         "kv_fetch": cost_model.paged_kv_fetch_default(
             features["bs"], features["d"]),
+        "q_tile": cost_model.paged_q_tile_default(features["group"]),
     }
 
 

@@ -1,36 +1,56 @@
-"""Ragged paged-attention decode kernel — Pallas fwd with jnp oracle.
+"""Ragged multi-query paged attention — one Pallas program for prefill
+chunks AND decode steps, with a jnp oracle.
 
 Ref: "Ragged Paged Attention" (arxiv 2604.15464, PAPERS.md) — the
-TPU-native inference kernel shape: one decode query token per sequence, a
-ragged batch of sequence lengths, and K/V living in a fixed pool of
+TPU-native inference kernel shape: a ragged batch where every slot
+contributes a RUN of query tokens (a prefill chunk, a speculative
+window, or a single decode token) against K/V living in a fixed pool of
 fixed-size blocks ("pages") indexed through per-sequence block tables
-(serving/kv_cache.py owns the pool).
+(serving/kv_cache.py owns the pool). One fixed-shape program serves any
+prefill/decode mix, which is what lets serving/engine.py compile ONCE.
 
-TPU design: the block table and the ragged lengths ride as SCALAR
-PREFETCH operands (pltpu.PrefetchScalarGridSpec), so the K/V page for
-each grid step is selected by the BlockSpec *index map* reading the
-table — the gather happens in the pipeline's own DMAs, never as an XLA
-gather that would materialize the padded [slots, max_seq] KV. Grid is
-(slot, kv_head, fetch-step) with the fetch axis minor; each step pulls
-``kv_fetch`` pages (the pool is passed kv_fetch times with staggered
-index maps, so the pipeline overlaps the page fetches) and folds them
-into the online-softmax accumulator held in VMEM scratch — the same
-(m, l, acc) fp32 recurrence as ops/attention.py. GQA: the q rows of one
-kernel instance are the kv head's whole query group, padded up to
-``block_rows`` sublanes; pages past a sequence's length are skipped via
-pl.when on the *logical* page position, and partial last pages are
-masked per column, so ragged lengths cost masked lanes, not branches.
+Query layout: queries are PACKED token-major into ``q [total_q, Hq, D]``
+and described per slot by three scalar-prefetch vectors —
 
-Decode semantics: ``lengths[s]`` INCLUDES the current token — the
-caller appends the new token's K/V to the cache first (the position the
-query attends to last is its own), which makes causality within the
-step trivial. A slot with length 0 (inactive) outputs exactly 0.
+    query_start[s]  row offset of slot s's run in the packed buffer
+    query_len[s]    tokens in the run (0 = slot idle this call)
+    kv_len[s]       KV tokens visible INCLUDING the run (the caller
+                    appends the run's K/V to the cache first, exactly
+                    the old decode contract generalized)
+
+so the query at local index i sits at absolute sequence position
+``kv_len - query_len + i`` and causally attends to KV positions
+``<= kv_len - query_len + i``. Decode is the degenerate run
+``query_len == 1`` (the old one-query-per-slot entry below builds
+exactly that). Packed runs must be laid out in SLOT ORDER
+(query_start non-decreasing with slot index): tile tails are masked by
+overwrite order, which the slot-major grid guarantees only then.
+
+TPU design: the grid is (work item, kv_head, fetch-step) where the
+WORK LIST — built by a tiny jnp prologue from ``query_len``, the same
+MegaBlocks-style static schedule as ops/grouped_matmul.py — flattens
+(slot, query-tile) pairs so dead (slot, tile) combinations cost nothing:
+``n_work = ceil(total_q / q_tile) + slots`` items, sentinel-padded. The
+block table + run metadata ride as SCALAR PREFETCH
+(pltpu.PrefetchScalarGridSpec); each fetch-step pulls ``kv_fetch`` pages
+through BlockSpec index maps reading the table (the gather happens in
+the pipeline's own DMAs), and folds them into the fp32 online-softmax
+accumulator ((m, l, acc), the ops/attention.py recurrence) held in VMEM
+scratch across the fetch axis. The q tile of one work item is
+``q_tile`` consecutive tokens x the kv head's whole GQA group, padded
+up to ``block_rows`` sublanes; causal masking is per (row, column)
+against the ragged ``kv_len``, so mixed ragged runs cost masked lanes,
+not recompiles.
 
 Tunables (``paged_decode`` family, tuning/registry.py): ``block_rows``
-(sublane padding of the query-group tile) and ``kv_fetch`` (pages per
-grid step), resolved env (APEX_TPU_PAGED_BLOCK_ROWS /
-APEX_TPU_PAGED_KV_FETCH) > tune cache > cost model, following the PR-1
-resolution order.
+(sublane floor of the q tile), ``kv_fetch`` (pages per grid step) and
+``q_tile`` (query tokens per work item), resolved env
+(APEX_TPU_PAGED_BLOCK_ROWS / APEX_TPU_PAGED_KV_FETCH /
+APEX_TPU_PAGED_Q_TILE) > tune cache > cost model, the PR-1 resolution
+order. Auto backend routing folds the GQA group into the oracle-cost
+threshold (cost_model.paged_backend_default): the unfused oracle
+materializes the gathered pages AND a score tensor that scales with
+``group``, so bigger groups amortize the kernel's grid overhead sooner.
 """
 
 from __future__ import annotations
@@ -54,87 +74,169 @@ _NEG_INF = -1e30
 
 
 def _paged_params(n_slots: int, max_blocks: int, block_size: int, group: int,
-                  d: int, dtype) -> dict:
-    """Resolved {"block_rows", "kv_fetch"} for one call: env wins outright,
-    then the tune cache for this shape class, then the cost model — the
-    same three-layer order as every PR-1 family."""
+                  d: int, dtype, total_q: int | None = None) -> dict:
+    """Resolved {"block_rows", "kv_fetch", "q_tile"} for one call: env wins
+    outright, then the tune cache for this shape class, then the cost
+    model — the same three-layer order as every PR-1 family."""
     from apex_tpu import tuning
-    from apex_tpu.tuning import cost_model
 
     cfg = tuning.paged_decode_config(n_slots, max_blocks, block_size, group,
-                                     d, dtype)
+                                     d, dtype, total_q=total_q)
     rows = env_int("APEX_TPU_PAGED_BLOCK_ROWS", quantum=8)
     fetch = env_int("APEX_TPU_PAGED_KV_FETCH")
+    q_tile = env_int("APEX_TPU_PAGED_Q_TILE", quantum=8)
     return {
         "block_rows": rows if rows is not None else cfg["block_rows"],
         "kv_fetch": min(fetch if fetch is not None else cfg["kv_fetch"],
                         max(1, max_blocks)),
+        "q_tile": q_tile if q_tile is not None else cfg["q_tile"],
         "backend": cfg["backend"],
     }
 
 
-def _auto_use_kernel(n_slots, max_blocks, block_size, group, d, dtype) -> bool:
+def _auto_use_kernel(n_slots, max_blocks, block_size, group, d, dtype,
+                     total_q=None) -> bool:
     """Backend decision for auto mode (use_pallas=None): preflight registry
     and APEX_TPU_USE_PALLAS first (ops/_utils.default_use_pallas), then a
-    pinned cache entry ({"backend": "jnp"}) may still route this shape
-    class to the oracle; env=1 beats the cache (env > cache > model)."""
+    pinned cache entry ({"backend": "jnp"}) or the group-aware cost-model
+    threshold may still route this shape class to the oracle; env=1 beats
+    both (env > cache > model)."""
     if not default_use_pallas("paged_attention"):
         return False
     if env_flag("APEX_TPU_USE_PALLAS"):
         return True
     return _paged_params(n_slots, max_blocks, block_size, group, d,
-                         dtype)["backend"] != "jnp"
+                         dtype, total_q)["backend"] != "jnp"
+
+
+def packed_row_slots(query_start, query_len, total_q: int):
+    """Per packed row: (owning slot id, validity mask) — the ONE
+    definition of the packing geometry (row r belongs to the first slot
+    whose run [query_start, query_start + query_len) covers it), shared
+    by the jnp oracle, the kernel wrapper's output mask, and the serving
+    engine's row -> position mapping."""
+    r = jnp.arange(total_q)
+    qs = query_start.astype(jnp.int32)
+    ql = query_len.astype(jnp.int32)
+    inside = (r[:, None] >= qs[None, :]) & (r[:, None] < (qs + ql)[None, :])
+    return jnp.argmax(inside, axis=1), jnp.any(inside, axis=1)
 
 
 # ---------------------------------------------------------------------------
 # jnp reference (oracle + fallback)
 # ---------------------------------------------------------------------------
 
-def paged_attention_ref(q, k_pool, v_pool, block_tables, lengths, *,
-                        scale=None):
-    """Unfused oracle: gather the pages, mask the ragged tail, fp32 softmax.
+def ragged_paged_attention_ref(q, k_pool, v_pool, block_tables, query_start,
+                               query_len, kv_len, *, scale=None):
+    """Unfused oracle for the ragged multi-query layout: gather each row's
+    slot pages, causal-mask against the ragged lengths, fp32 softmax.
 
-    q: [S, Hq, D]; k_pool/v_pool: [N, bs, Hkv, D];
-    block_tables: [S, max_blocks] int32; lengths: [S] int32.
-    Returns [S, Hq, D]. Materializes [S, max_blocks*bs, Hkv, D] — the
-    memory-bound path the Pallas kernel exists to avoid; used as the
+    q: [total_q, Hq, D] packed; k_pool/v_pool: [N, bs, Hkv, D];
+    block_tables: [S, max_blocks] int32; query_start/query_len/kv_len:
+    [S] int32. Returns [total_q, Hq, D]; rows not covered by any slot's
+    run are exactly 0. Materializes [total_q, max_blocks*bs, Hkv, D] —
+    the memory-bound path the Pallas kernel exists to avoid; used as the
     fallback and the test oracle."""
-    s_n, hq, d = q.shape
+    tq, hq, d = q.shape
     nb, bs, hkv, _ = k_pool.shape
+    s_n, maxb = block_tables.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     group = hq // hkv
-    t = block_tables.shape[1] * bs
+    t = maxb * bs
+    qs = query_start.astype(jnp.int32)
+    ql = query_len.astype(jnp.int32)
+    kl = kv_len.astype(jnp.int32)
     idx = jnp.clip(block_tables, 0, nb - 1)
     k = k_pool[idx].reshape(s_n, t, hkv, d).astype(jnp.float32)
     v = v_pool[idx].reshape(s_n, t, hkv, d).astype(jnp.float32)
-    qf = q.reshape(s_n, hkv, group, d).astype(jnp.float32) * scale
-    scores = jnp.einsum("shgd,sthd->shgt", qf, k, precision=_HIGHEST)
-    valid = jnp.arange(t)[None, :] < lengths[:, None]        # [S, T]
-    scores = jnp.where(valid[:, None, None, :], scores, _NEG_INF)
+    r = jnp.arange(tq)
+    sid, valid = packed_row_slots(qs, ql, tq)
+    pos = kl[sid] - ql[sid] + (r - qs[sid])                  # abs position
+    qf = q.reshape(tq, hkv, group, d).astype(jnp.float32) * scale
+    scores = jnp.einsum("rhgd,rthd->rhgt", qf, k[sid], precision=_HIGHEST)
+    cols = jnp.arange(t)
+    ok = ((cols[None, :] <= pos[:, None])
+          & (cols[None, :] < kl[sid][:, None])
+          & valid[:, None])                                  # [Tq, T]
+    scores = jnp.where(ok[:, None, None, :], scores, _NEG_INF)
     m = jnp.max(scores, axis=-1, keepdims=True)
     p = jnp.where(scores > _NEG_INF / 2, jnp.exp(scores - m), 0.0)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    p = p / jnp.where(l == 0.0, 1.0, l)                      # len 0 -> out 0
-    o = jnp.einsum("shgt,sthd->shgd", p, v, precision=_HIGHEST)
-    return o.reshape(s_n, hq, d).astype(q.dtype)
+    p = p / jnp.where(l == 0.0, 1.0, l)                      # dead row -> 0
+    o = jnp.einsum("rhgt,rthd->rhgd", p, v[sid], precision=_HIGHEST)
+    o = o.reshape(tq, hq, d)
+    return jnp.where(valid[:, None, None], o, 0.0).astype(q.dtype)
+
+
+def paged_attention_ref(q, k_pool, v_pool, block_tables, lengths, *,
+                        scale=None):
+    """Decode-shaped oracle (one query per slot, the PR-3 entry): slot s's
+    query is packed row s with ``kv_len = lengths[s]``; a slot with
+    length 0 is an idle run (query_len 0) and returns exactly 0."""
+    s_n = q.shape[0]
+    lengths = lengths.astype(jnp.int32)
+    return ragged_paged_attention_ref(
+        q, k_pool, v_pool, block_tables,
+        jnp.arange(s_n, dtype=jnp.int32),
+        (lengths > 0).astype(jnp.int32), lengths, scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# work-list metadata (jnp prologue — the grouped_matmul idiom)
+# ---------------------------------------------------------------------------
+
+def _work_metadata(query_len, q_tile: int, n_work: int, n_slots: int):
+    """Static-shape (slot, query-tile) work list from the ragged
+    ``query_len``: ``work_slot[w]`` / ``work_qt[w]`` enumerate, in slot
+    order, every q_tile-sized tile each slot's run needs; items past the
+    ragged total carry the sentinel slot ``n_slots`` (their kernel
+    instances skip compute and never store). ``n_work =
+    ceil(total_q / q_tile) + n_slots`` bounds the list for ANY split of
+    total_q rows over n_slots runs (each run wastes < 1 tile)."""
+    ql = query_len.astype(jnp.int32)
+    ntiles = (ql + q_tile - 1) // q_tile                    # [S]
+    ends = jnp.cumsum(ntiles)
+    total = ends[-1]
+    w = jnp.arange(n_work)
+    slot = jnp.searchsorted(ends, w, side="right").astype(jnp.int32)
+    slot_c = jnp.minimum(slot, n_slots - 1)
+    starts = ends - ntiles
+    qt = (w - starts[slot_c]).astype(jnp.int32)
+    work_slot = jnp.where(w < total, slot, n_slots).astype(jnp.int32)
+    work_qt = jnp.where(w < total, qt, 0).astype(jnp.int32)
+    return work_slot, work_qt
 
 
 # ---------------------------------------------------------------------------
 # Pallas kernel
 # ---------------------------------------------------------------------------
 
-def _decode_kernel(tbl_ref, len_ref, q_ref, *rest, kv_fetch, block_size,
-                   scale, nj, rows):
-    """Grid (slot, kv_head, fetch-step j). rest is kv_fetch k-page refs,
-    kv_fetch v-page refs, the out ref, then (acc, m, l) scratch."""
+def _ragged_kernel(wslot_ref, wqt_ref, tbl_ref, qs_ref, ql_ref, kl_ref,
+                   q_ref, *rest, kv_fetch, block_size, scale, nj, q_tile,
+                   group, rows, n_slots, d):
+    """Grid (work item w, kv_head h, fetch-step j). rest is kv_fetch
+    k-page refs, kv_fetch v-page refs, the out ref, then (acc, m, l)
+    scratch. The (m, l, acc) recurrence accumulates across j per work
+    item; init at j == 0, emit at the last j."""
     k_refs = rest[:kv_fetch]
     v_refs = rest[kv_fetch:2 * kv_fetch]
     o_ref = rest[2 * kv_fetch]
     acc_ref, m_ref, l_ref = rest[2 * kv_fetch + 1:]
     del tbl_ref  # consumed by the index maps, not the body
-    si = pl.program_id(0)
+    w = pl.program_id(0)
+    h = pl.program_id(1)
     j = pl.program_id(2)
+
+    s_raw = wslot_ref[w]
+    s = jnp.minimum(s_raw, n_slots - 1)
+    qt = wqt_ref[w]
+    qs = qs_ref[s]
+    ql = ql_ref[s]
+    kl = kl_ref[s]
+    live = (s_raw < n_slots) & (qt * q_tile < ql)
+    # last KV position any row of this tile may see (its own position)
+    lim = jnp.minimum(kl - 1, kl - ql + qt * q_tile + q_tile - 1)
 
     @pl.when(j == 0)
     def _init():
@@ -142,26 +244,37 @@ def _decode_kernel(tbl_ref, len_ref, q_ref, *rest, kv_fetch, block_size,
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    length = len_ref[si]
-    q = q_ref[0, 0].astype(jnp.float32) * scale               # [rows, D]
+    start = qs + qt * q_tile
+    qblk = q_ref[pl.ds(start, q_tile), pl.ds(h * group, group), :]
+    qv = qblk.reshape(q_tile * group, d).astype(jnp.float32) * scale
+    if rows > q_tile * group:                 # block_rows sublane floor
+        qv = jnp.concatenate(
+            [qv, jnp.zeros((rows - q_tile * group, d), jnp.float32)])
+    # local query-token index per tile row (rows are token-major x group)
+    t_loc = jax.lax.broadcasted_iota(jnp.int32, (rows, block_size),
+                                     0) // group
+    # absolute sequence position of each row's query token
+    pos = kl - ql + qt * q_tile + t_loc
+    row_ok = (qt * q_tile + t_loc) < ql
 
     for i in range(kv_fetch):                                 # unrolled
         page = j * kv_fetch + i                               # logical page
 
-        @pl.when(page * block_size < length)
+        @pl.when(live & (page * block_size <= lim))
         def _(i=i, page=page):
             kb = k_refs[i][0, :, 0, :].astype(jnp.float32)    # [bs, D]
             vb = v_refs[i][0, :, 0, :].astype(jnp.float32)
-            s = jax.lax.dot_general(
-                q, kb, (((1,), (1,)), ((), ())),
+            sc = jax.lax.dot_general(
+                qv, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )                                                 # [rows, bs]
             cols = page * block_size + jax.lax.broadcasted_iota(
                 jnp.int32, (rows, block_size), 1)
-            s = jnp.where(cols < length, s, _NEG_INF)
+            ok = (cols <= pos) & (cols < kl) & row_ok
+            sc = jnp.where(ok, sc, _NEG_INF)
             m_i, l_i = m_ref[...], l_ref[...]
-            m_new = jnp.maximum(m_i, jnp.max(s, axis=1, keepdims=True))
-            p = jnp.where(s > _NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+            m_new = jnp.maximum(m_i, jnp.max(sc, axis=1, keepdims=True))
+            p = jnp.where(sc > _NEG_INF / 2, jnp.exp(sc - m_new), 0.0)
             alpha = jnp.exp(m_i - m_new)
             l_ref[...] = l_i * alpha + jnp.sum(p, axis=1, keepdims=True)
             m_ref[...] = m_new
@@ -170,40 +283,53 @@ def _decode_kernel(tbl_ref, len_ref, q_ref, *rest, kv_fetch, block_size,
                 preferred_element_type=jnp.float32,
             )
 
-    @pl.when(j == nj - 1)
+    @pl.when((j == nj - 1) & live)
     def _emit():
+        # dead rows (t >= ql, including the block_rows pad) have l == 0
+        # and emit exact zeros; tile tails that spill into a LATER slot's
+        # region are overwritten by that slot's own (higher-w) emit —
+        # the slot-order packing contract in the module doc
         l_safe = jnp.where(l_ref[...] == 0.0, 1.0, l_ref[...])
-        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        out = (acc_ref[...] / l_safe)[: q_tile * group]
+        o_ref[pl.ds(start, q_tile), pl.ds(h * group, group), :] = (
+            out.reshape(q_tile, group, d).astype(o_ref.dtype))
 
 
-def _decode_pallas(q, k_pool, v_pool, block_tables, lengths, scale,
-                   block_rows, kv_fetch):
-    s_n, hq, d = q.shape
+def _ragged_pallas(q, k_pool, v_pool, block_tables, query_start, query_len,
+                   kv_len, scale, block_rows, kv_fetch, q_tile):
+    tq, hq, d = q.shape
     nb, bs, hkv, _ = k_pool.shape
+    s_n, max_blocks = block_tables.shape
     group = hq // hkv
-    max_blocks = block_tables.shape[1]
-    rows = max(block_rows, -(-group // 8) * 8)                # sublane pad
+    rows = max(block_rows, q_tile * group)                # q_tile % 8 == 0
     nj = -(-max_blocks // kv_fetch)
+    n_work = -(-tq // q_tile) + s_n
 
-    # [S, Hkv, rows, D] q tile per (slot, kv head); pad group -> rows
-    q4 = q.reshape(s_n, hkv, group, d)
-    if rows != group:
-        q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, rows - group), (0, 0)))
+    # pad the packed rows so the last tile's dynamic slice stays in
+    # bounds (start <= tq - 1, so start + q_tile <= tq + q_tile - 1)
+    qp = jnp.pad(q, ((0, q_tile), (0, 0), (0, 0)))
+    tq_pad = qp.shape[0]
 
+    wslot, wqt = _work_metadata(query_len, q_tile, n_work, s_n)
     tbl = jnp.clip(block_tables, 0, nb - 1).reshape(-1).astype(jnp.int32)
 
     def page_map(i):
-        # logical page j*F+i of slot s; past-the-table steps clamp to the
-        # last entry — their logical position is >= max_blocks*bs, so the
-        # kernel's length mask kills them
-        def index(s, h, j, tbl_ref, len_ref):
+        # logical page j*F+i of work item w's slot; steps past the table
+        # clamp to the last entry — their logical position is beyond the
+        # slot's kv_len, so the kernel's length mask kills them
+        def index(w, h, j, wslot_ref, wqt_ref, tbl_ref, qs_ref, ql_ref,
+                  kl_ref):
+            s = jnp.minimum(wslot_ref[w], s_n - 1)
             flat = jnp.clip(s * max_blocks + j * kv_fetch + i, 0,
                             tbl_ref.shape[0] - 1)
             return (tbl_ref[flat], 0, h, 0)
         return index
 
-    in_specs = [pl.BlockSpec((1, 1, rows, d), lambda s, h, j, t, l: (s, h, 0, 0))]
-    args = [q4]
+    def whole(w, h, j, *refs):
+        return (0, 0, 0)
+
+    in_specs = [pl.BlockSpec((tq_pad, hq, d), whole)]
+    args = [qp]
     for i in range(kv_fetch):
         in_specs.append(pl.BlockSpec((1, bs, 1, d), page_map(i)))
         args.append(k_pool)
@@ -212,11 +338,10 @@ def _decode_pallas(q, k_pool, v_pool, block_tables, lengths, scale,
         args.append(v_pool)
 
     grid_spec = _pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(s_n, hkv, nj),
+        num_scalar_prefetch=6,
+        grid=(n_work, hkv, nj),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, rows, d),
-                               lambda s, h, j, t, l: (s, h, 0, 0)),
+        out_specs=pl.BlockSpec((tq_pad, hq, d), whole),
         scratch_shapes=[
             _pltpu.VMEM((rows, d), jnp.float32),
             _pltpu.VMEM((rows, 1), jnp.float32),
@@ -225,50 +350,60 @@ def _decode_pallas(q, k_pool, v_pool, block_tables, lengths, scale,
     )
     out = pl.pallas_call(
         functools.partial(
-            _decode_kernel, kv_fetch=kv_fetch, block_size=bs, scale=scale,
-            nj=nj, rows=rows,
+            _ragged_kernel, kv_fetch=kv_fetch, block_size=bs, scale=scale,
+            nj=nj, q_tile=q_tile, group=group, rows=rows, n_slots=s_n, d=d,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((s_n, hkv, rows, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((tq_pad, hq, d), q.dtype),
         interpret=pallas_interpret(),
-    )(tbl, lengths.astype(jnp.int32), *args)
-    return out[:, :, :group, :].reshape(s_n, hq, d)
+    )(wslot, wqt, tbl, query_start.astype(jnp.int32),
+      query_len.astype(jnp.int32), kv_len.astype(jnp.int32), *args)
+    out = out[:tq]
+    # rows outside every run (inter-run gaps, idle slots, the pad the
+    # kernel never visits) are undefined VMEM — pin them to the oracle's
+    # exact-zero contract
+    _, valid = packed_row_slots(query_start, query_len, tq)
+    return jnp.where(valid[:, None, None], out, 0.0)
 
 
 # ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
 
-def paged_attention(q, k_pool, v_pool, block_tables, lengths, *, scale=None,
-                    use_pallas=None):
-    """Ragged paged-attention decode: one query token per slot against the
-    block-paged KV pool.
+def ragged_paged_attention(q, k_pool, v_pool, block_tables, query_start,
+                           query_len, kv_len, *, scale=None,
+                           use_pallas=None):
+    """Ragged multi-query paged attention: per-slot query RUNS packed
+    token-major against the block-paged KV pool.
 
-    q: [S, Hq, D] (S = decode slots, one token each); k_pool/v_pool:
-    [num_blocks, block_size, Hkv, D] with Hq % Hkv == 0 (GQA shares each
-    KV page across the query group in-kernel); block_tables:
-    [S, max_blocks] int32 page ids (entries past a sequence's pages are
-    ignored); lengths: [S] int32 — tokens visible to the query INCLUDING
-    its own position (append to the cache first). Slots with length 0
-    return exactly 0. No backward: decode is inference-only.
+    q: [total_q, Hq, D] packed queries (runs laid out in slot order);
+    k_pool/v_pool: [num_blocks, block_size, Hkv, D] with Hq % Hkv == 0
+    (GQA shares each KV page across the query group in-kernel);
+    block_tables: [S, max_blocks] int32 page ids; query_start/query_len/
+    kv_len: [S] int32 run metadata (module doc). The run's K/V must
+    already be in the cache (kv_len INCLUDES the run). Rows covered by
+    no run return exactly 0. No backward: inference-only.
     """
     if q.ndim != 3:
-        raise ValueError(f"paged_attention expects q [slots, heads, dim], "
-                         f"got {q.shape}")
+        raise ValueError(f"ragged_paged_attention expects q "
+                         f"[total_q, heads, dim], got {q.shape}")
     if k_pool.ndim != 4 or v_pool.shape != k_pool.shape:
         raise ValueError(
             f"k/v pools must be [blocks, block_size, kv_heads, dim]: "
             f"k {k_pool.shape} v {v_pool.shape}")
-    s_n, hq, d = q.shape
+    tq, hq, d = q.shape
     nb, bs, hkv, dk = k_pool.shape
     if dk != d or hkv < 1 or hq % hkv:
         raise ValueError(
             f"q heads {hq} not a multiple of kv heads {hkv} (or head dim "
             f"mismatch {d} vs {dk})")
-    if block_tables.shape[0] != s_n or lengths.shape != (s_n,):
-        raise ValueError(
-            f"block_tables {block_tables.shape} / lengths {lengths.shape} "
-            f"do not match {s_n} slots")
+    s_n = block_tables.shape[0]
+    for name, arr in (("query_start", query_start),
+                      ("query_len", query_len), ("kv_len", kv_len)):
+        if arr.shape != (s_n,):
+            raise ValueError(
+                f"{name} {arr.shape} does not match block_tables "
+                f"{block_tables.shape} ({s_n} slots)")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     group = hq // hkv
@@ -276,10 +411,39 @@ def paged_attention(q, k_pool, v_pool, block_tables, lengths, *, scale=None,
 
     use = use_pallas
     if use is None:
-        use = _auto_use_kernel(s_n, max_blocks, bs, group, d, q.dtype)
+        use = _auto_use_kernel(s_n, max_blocks, bs, group, d, q.dtype, tq)
     if not use or _pltpu is None:
-        return paged_attention_ref(q, k_pool, v_pool, block_tables, lengths,
-                                   scale=scale)
-    p = _paged_params(s_n, max_blocks, bs, group, d, q.dtype)
-    return _decode_pallas(q, k_pool, v_pool, block_tables, lengths, scale,
-                          p["block_rows"], p["kv_fetch"])
+        return ragged_paged_attention_ref(
+            q, k_pool, v_pool, block_tables, query_start, query_len, kv_len,
+            scale=scale)
+    p = _paged_params(s_n, max_blocks, bs, group, d, q.dtype, tq)
+    return _ragged_pallas(q, k_pool, v_pool, block_tables, query_start,
+                          query_len, kv_len, scale, p["block_rows"],
+                          p["kv_fetch"], p["q_tile"])
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, lengths, *, scale=None,
+                    use_pallas=None):
+    """Decode-shaped entry (the PR-3 signature, kept for probes and
+    sweeps): one query token per slot against the block-paged KV pool —
+    slot s is the packed run ``(query_start=s, query_len=(lengths[s]>0),
+    kv_len=lengths[s])`` of the ragged kernel above.
+
+    q: [S, Hq, D]; lengths: [S] int32 tokens visible INCLUDING the
+    query's own position (append to the cache first). Slots with
+    length 0 return exactly 0.
+    """
+    if q.ndim != 3:
+        raise ValueError(f"paged_attention expects q [slots, heads, dim], "
+                         f"got {q.shape}")
+    s_n = q.shape[0]
+    if block_tables.shape[0] != s_n or lengths.shape != (s_n,):
+        raise ValueError(
+            f"block_tables {block_tables.shape} / lengths {lengths.shape} "
+            f"do not match {s_n} slots")
+    lengths = lengths.astype(jnp.int32)
+    return ragged_paged_attention(
+        q, k_pool, v_pool, block_tables,
+        jnp.arange(s_n, dtype=jnp.int32),
+        (lengths > 0).astype(jnp.int32), lengths,
+        scale=scale, use_pallas=use_pallas)

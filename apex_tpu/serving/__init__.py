@@ -3,15 +3,20 @@
 Three layers (docs/serving.md):
 
 - ``kv_cache``   — block-paged KV cache: one fixed pool of fixed-size
-                   pages + per-sequence block tables, pure-functional
-                   allocate/append/free (jits, donates, shards).
-- ``scheduler``  — host-side continuous batching: free-block-watermark
-                   admission, slot accounting, eviction.
-- ``engine``     — two fixed-shape jitted programs (prefill + decode;
-                   the decode path is the ragged paged-attention kernel,
-                   ops/paged_attention.py) driven by the scheduler, with
-                   optional tensor-parallel sharded weights reusing the
-                   training layout.
+                   pages + per-sequence block tables + per-block
+                   refcounts, pure-functional allocate/share/append/free
+                   (jits, donates, shards), plus the host-side
+                   PrefixIndex (block-content hash -> resident page).
+- ``scheduler``  — host-side continuous batching: refcount-aware
+                   free-block-watermark admission with prefix sharing,
+                   chunked-prefill step planning under a fixed token
+                   budget, slot accounting, eviction.
+- ``engine``     — ONE fixed-shape jitted step (prefill chunks + decode
+                   steps packed through the ragged multi-query
+                   paged-attention kernel, ops/paged_attention.py)
+                   driven by the scheduler, with optional
+                   tensor-parallel sharded weights reusing the training
+                   layout.
 """
 
 from apex_tpu.serving.engine import (  # noqa: F401
@@ -21,22 +26,30 @@ from apex_tpu.serving.engine import (  # noqa: F401
 )
 from apex_tpu.serving.kv_cache import (  # noqa: F401
     PagedKVCache,
+    PrefixIndex,
     alloc_decode_blocks,
     allocate_slot,
     append_layer,
     blocks_needed,
     cache_pspecs,
     check_invariants,
+    cow_append,
+    extend_slots,
     free_block_count,
     free_slot,
     paged_kv_cache,
+    release_blocks,
+    retain_blocks,
+    share_prefix,
     write_prefill,
 )
 from apex_tpu.serving.scheduler import Request, Scheduler  # noqa: F401
 
 __all__ = [
-    "PagedKVCache", "Request", "Scheduler", "ServingConfig",
+    "PagedKVCache", "PrefixIndex", "Request", "Scheduler", "ServingConfig",
     "ServingEngine", "alloc_decode_blocks", "allocate_slot", "append_layer",
-    "blocks_needed", "cache_pspecs", "check_invariants", "free_block_count",
-    "free_slot", "greedy_reference", "paged_kv_cache", "write_prefill",
+    "blocks_needed", "cache_pspecs", "check_invariants", "cow_append",
+    "extend_slots", "free_block_count", "free_slot", "greedy_reference",
+    "paged_kv_cache", "release_blocks", "retain_blocks", "share_prefix",
+    "write_prefill",
 ]

@@ -1,26 +1,40 @@
-"""Serving engine — fixed-shape jitted prefill/decode over standalone_gpt.
+"""Serving engine — ONE fixed-shape jitted step over standalone_gpt.
 
-Two device programs, compiled ONCE each, drive all traffic:
+A single device program, compiled ONCE, drives all traffic: every step
+carries a PACKED batch of at most ``chunk_tokens`` query tokens — any
+mix of prompt chunks (chunked prefill) and decode steps, one run per
+slot — through the training layers (the SAME tensor-parallel layers as
+testing/standalone_transformer.py — arxiv 2605.25645's argument for one
+stack, not a separate serving port) with attention running through the
+ragged multi-query paged-attention kernel (ops/paged_attention.py)
+against the block-paged KV cache (serving/kv_cache.py). Each layer
+writes the packed rows' K/V into the paged pool FIRST, then attends, so
+causality within a chunk and across the resident prefix is uniform; the
+greedy token of every packed row comes back and the host keeps the rows
+it needs (a decode row's next token; a prompt-completing chunk's last
+row = the request's FIRST token). Shapes never depend on the request
+mix, so the jit cache sees exactly ONE step signature over any workload
+— asserted by trace counters (``engine.trace_counts["step"]``; the tiny
+admission/indexing helpers — share/retain/release/free — are separate
+one-compile programs that never touch the transformer).
 
-- **prefill**: one request, prompt padded to ``max_prefill_len``. Runs
-  the standard training forward (the SAME tensor-parallel layers and
-  flash kernels as testing/standalone_transformer.py — arxiv 2605.25645's
-  argument for one stack, not a separate serving port), captures each
-  layer's K/V, scatters them into the paged cache
-  (serving/kv_cache.py), and emits the first greedy token from the last
-  prompt position.
-- **decode**: ALL slots at once, one token per active slot (padded
-  active-slot batch — inactive lanes compute masked garbage), each layer
-  appending its K/V at the positions ``alloc_decode_blocks`` reserved
-  and attending through the block table with the ragged paged-attention
-  kernel (ops/paged_attention.py). Shapes never depend on the request
-  mix, so the jit cache sees exactly two signatures over any workload —
-  asserted by trace counters (``engine.trace_counts``).
+Prefix caching: the engine owns a persistent host-side
+kv_cache.PrefixIndex. At admission the scheduler shares a prompt's
+already-resident full blocks (device ``share_prefix``: refcount += 1,
+only the suffix is prefilled or charged); when a request finishes, its
+prompt's full blocks are inserted into the index and RETAINED (+1)
+before the slot frees, so the pages survive for the next hit. Warm
+requests are bitwise-identical to cold ones: the same single program
+runs either way, only the run metadata differs, and every row's
+attention reads the same K/V values whether this request or an earlier
+identical prefix wrote them.
 
 Continuous batching: the host loop (``ServingEngine.run``) interleaves
-admission->prefill with decode steps under the scheduler's free-block
-watermark (serving/scheduler.py) and evicts finished sequences by
-returning their blocks to the pool, so later arrivals join mid-flight.
+admission with planned steps under the scheduler's refcount-aware
+free-block watermark (serving/scheduler.py) and evicts finished
+sequences by returning non-shared blocks to the pool, so later arrivals
+join mid-flight and long prompts prefill in chunks without stalling
+running decodes.
 
 Tensor parallelism is the training layout re-used verbatim: weights
 shard via ``param_specs``, the cache's KV heads ride the model axis
@@ -29,8 +43,10 @@ argmaxes across shards with a pmax/pmin pair — token-identical to the
 single-device argmax (first-max-wins tie-break in both).
 
 Env knobs (docs/serving.md): ``APEX_TPU_PAGED_BLOCK_SIZE`` (cache page
-size, default 16), ``APEX_TPU_SERVING_MAX_SLOTS`` (decode batch width,
-default 8) — defaults for ServingConfig, explicit arguments win.
+size, default 16), ``APEX_TPU_SERVING_MAX_SLOTS`` (slot count, default
+8), ``APEX_TPU_SERVING_CHUNK_TOKENS`` (per-step token budget),
+``APEX_TPU_PREFIX_CACHE`` (0 disables prefix sharing) — defaults for
+ServingConfig, explicit arguments win.
 """
 
 from __future__ import annotations
@@ -42,10 +58,13 @@ from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from apex_tpu.ops.attention import flash_attention
-from apex_tpu.ops.paged_attention import paged_attention
+from apex_tpu.ops.paged_attention import (
+    packed_row_slots,
+    ragged_paged_attention,
+)
 from apex_tpu.serving import kv_cache as kc
 from apex_tpu.serving.scheduler import Request, Scheduler
 from apex_tpu.testing.commons import smap
@@ -74,8 +93,12 @@ from apex_tpu.observability import (
     observe,
     set_gauge,
 )
-from apex_tpu.utils.envvars import env_int
+from apex_tpu.utils.envvars import env_flag, env_int
 from apex_tpu.utils.profiling import host_trace_range, trace_range
+
+# serving/chunk_utilization histogram: fraction of the step budget
+# actually carrying query tokens
+UTIL_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,11 +111,13 @@ class ServingConfig:
     num_blocks: int = 128
     block_size: Optional[int] = None        # APEX_TPU_PAGED_BLOCK_SIZE | 16
     max_slots: Optional[int] = None         # APEX_TPU_SERVING_MAX_SLOTS | 8
-    max_prefill_len: Optional[int] = None   # prompt pad (compile shape)
+    max_prefill_len: Optional[int] = None   # seeds the chunk budget default
     max_seq_len: Optional[int] = None       # context cap per sequence
     watermark: Optional[int] = None         # admission reserve (None=slots)
     eos_id: Optional[int] = None            # greedy stop token (None = off)
     dtype: object = None                    # cache dtype (None = model's)
+    chunk_tokens: Optional[int] = None      # APEX_TPU_SERVING_CHUNK_TOKENS
+    prefix_cache: Optional[bool] = None     # APEX_TPU_PREFIX_CACHE | on
 
     def __post_init__(self):
         s = object.__setattr__
@@ -106,6 +131,13 @@ class ServingConfig:
             s(self, "max_seq_len", self.model.seq_len)
         if self.max_prefill_len is None:
             s(self, "max_prefill_len", min(self.max_seq_len, 64))
+        if self.chunk_tokens is None:
+            s(self, "chunk_tokens",
+              env_int("APEX_TPU_SERVING_CHUNK_TOKENS",
+                      default=max(self.max_slots, self.max_prefill_len)))
+        if self.prefix_cache is None:
+            env = env_flag("APEX_TPU_PREFIX_CACHE")
+            s(self, "prefix_cache", True if env is None else env)
         if self.dtype is None:
             s(self, "dtype", self.model.dtype)
 
@@ -136,7 +168,7 @@ def _vp_greedy(logits, axis: str, tp: int):
 
 
 def _rope_rows(cfg: TransformerConfig, pos):
-    """Per-slot RoPE table rows at positions ``pos`` [S] (fp32)."""
+    """Per-row RoPE table rows at positions ``pos`` [n] (fp32)."""
     from apex_tpu.ops.rope import rope_frequencies
 
     cos, sin = rope_frequencies(cfg.head_dim, cfg.seq_len)
@@ -144,9 +176,9 @@ def _rope_rows(cfg: TransformerConfig, pos):
 
 
 def _rope_at(x, cos_rows, sin_rows):
-    """ops/rope._rotate at gathered per-slot positions: x [S, nh, d],
-    cos/sin_rows [S, d//2]. Same split-halves rotation, so decode matches
-    the prefill/training apply_rope bit for bit."""
+    """ops/rope._rotate at gathered per-row positions: x [n, nh, d],
+    cos/sin_rows [n, d//2]. Same split-halves rotation, so the packed
+    step matches the training apply_rope bit for bit."""
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     c = cos_rows[:, None, :]
     s = sin_rows[:, None, :]
@@ -169,87 +201,63 @@ def _check_supported(cfg: TransformerConfig):
 
 
 # ---------------------------------------------------------------------------
-# device programs (shard_map-local bodies)
+# the unified device step (shard_map-local body)
 # ---------------------------------------------------------------------------
 
-def _prefill_body(params, cache, tokens, slot, length, n_blocks, *, cfg,
-                  scfg):
-    """tokens [1, max_prefill_len] -> (cache', first greedy token).
-    The training forward with per-layer K/V capture; pad rows are dropped
-    by write_prefill and causality keeps them out of every valid row."""
+def _step_body(params, cache, tokens, query_start, query_len, *, cfg, scfg):
+    """tokens [chunk_tokens] packed input ids (prompt chunks + decode
+    tokens, runs in slot order), query_start/query_len [max_slots]
+    (query_len 0 = slot idle this step) -> (cache', greedy next token
+    per packed row [chunk_tokens]). One fixed shape forever.
+
+    Per step: COW-guard the append positions, advance seq_lens (decode
+    rows grow a page where they cross a boundary), then per layer write
+    the packed rows' K/V at their absolute positions and attend through
+    the block table with the ragged multi-query kernel. Rows covered by
+    no run compute masked garbage the host never reads."""
     ax = cfg.model_axis
-    cache = kc.allocate_slot(cache, slot, n_blocks)
-    t_pad = tokens.shape[1]
-    emb = vocab_parallel_embedding(tokens, params["embedding"], axis=ax)
-    if cfg.rope:
-        x = emb.astype(cfg.dtype)
-    else:
-        x = (emb + params["pos_embedding"][None, :t_pad]).astype(cfg.dtype)
-    x = x.transpose(1, 0, 2)                           # [s, 1, h]
-    if cfg.rope:
-        from apex_tpu.ops.rope import apply_rope, rope_frequencies
+    tq = tokens.shape[0]
+    bs = cache.block_size
+    qs = jnp.asarray(query_start, jnp.int32)
+    ql = jnp.asarray(query_len, jnp.int32)
+    active = ql > 0
+    cache = kc.cow_append(cache, active)
+    cache = kc.extend_slots(cache, active, ql)
+    kl = jnp.where(active, cache.seq_lens, 0)                  # [S]
 
-        rope_tbl = rope_frequencies(cfg.head_dim, cfg.seq_len)
-    ks, vs = [], []
-    for lp in params["layers"]:
-        qkv = column_parallel_linear(
-            _norm(x, lp["ln1"], cfg),
-            lp["qkv"]["kernel"], lp["qkv"]["bias"], axis=ax,
-            gather_output=False)
-        q, k, v = split_qkv(qkv, cfg)                  # [s, 1, nh, d]
-        if cfg.rope:
-            q = apply_rope(q.transpose(1, 0, 2, 3), *rope_tbl).transpose(
-                1, 0, 2, 3)
-            k = apply_rope(k.transpose(1, 0, 2, 3), *rope_tbl).transpose(
-                1, 0, 2, 3)
-        ks.append(k[:, 0])                             # [s, n_kv, d]
-        vs.append(v[:, 0])
-        qh, kh, vh = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
-        o = flash_attention(qh, kh, vh, causal=True)
-        o = o.transpose(2, 0, 1, 3).reshape(t_pad, 1, -1)
-        o = row_parallel_linear(
-            o, lp["proj"]["kernel"], lp["proj"]["bias"], axis=ax,
-            input_is_parallel=True)
-        x = x + o
-        x = x + _mlp(lp, _norm(x, lp["ln2"], cfg), cfg, None)
-    cache = kc.write_prefill(cache, slot, jnp.stack(ks), jnp.stack(vs),
-                             length)
-    x = _norm(x, params["final_ln"], cfg)
-    xl = jax.lax.dynamic_slice_in_dim(x, length - 1, 1, 0)   # [1, 1, h]
-    xl = copy_to_tensor_model_parallel_region(xl, ax)
-    logits = _lm_logits(xl, params, cfg)[0, 0]               # [v/tp]
-    return cache, _vp_greedy(logits, ax, scfg["tp"])
+    # packed-row geometry: row r of slot sid[r] sits at absolute
+    # sequence position pos[r] (its own token included in kl)
+    r = jnp.arange(tq)
+    sid, rvalid = packed_row_slots(qs, ql, tq)
+    pos = kl[sid] - ql[sid] + (r - qs[sid])
+    pos_c = jnp.clip(pos, 0, cfg.seq_len - 1)
+    tbl_idx = jnp.clip(pos // bs, 0, cache.max_blocks_per_seq - 1)
+    row_blk = jnp.where(rvalid, cache.block_tables[sid, tbl_idx],
+                        cache.num_blocks).astype(jnp.int32)
+    row_off = jnp.where(rvalid, pos % bs, 0).astype(jnp.int32)
 
-
-def _decode_body(params, cache, tokens, active, *, cfg, scfg):
-    """tokens [max_slots] (each slot's last token), active [max_slots]
-    bool -> (cache', next tokens [max_slots]). One fixed shape forever."""
-    ax = cfg.model_axis
-    cache, block_ids, offsets = kc.alloc_decode_blocks(cache, active)
-    lengths = jnp.where(active, cache.seq_lens, 0)
-    pos = jnp.clip(cache.seq_lens - 1, 0, cfg.seq_len - 1)   # [S]
     emb = vocab_parallel_embedding(tokens[:, None], params["embedding"],
-                                   axis=ax)[:, 0]            # [S, h]
+                                   axis=ax)[:, 0]              # [Tq, h]
     if cfg.rope:
         x = emb.astype(cfg.dtype)
-        rope_rows = _rope_rows(cfg, pos)
+        rope_rows = _rope_rows(cfg, pos_c)
     else:
-        x = (emb + params["pos_embedding"][pos]).astype(cfg.dtype)
-    x = x[None]                                        # [s=1, b=S, h]
+        x = (emb + params["pos_embedding"][pos_c]).astype(cfg.dtype)
+    x = x[None]                                        # [s=1, b=Tq, h]
     for li, lp in enumerate(params["layers"]):
         qkv = column_parallel_linear(
             _norm(x, lp["ln1"], cfg),
             lp["qkv"]["kernel"], lp["qkv"]["bias"], axis=ax,
             gather_output=False)
-        q, k, v = split_qkv(qkv, cfg)                  # [1, S, nh, d]
-        q, k, v = q[0], k[0], v[0]                     # [S, nh(_kv), d]
+        q, k, v = split_qkv(qkv, cfg)                  # [1, Tq, nh, d]
+        q, k, v = q[0], k[0], v[0]                     # [Tq, nh(_kv), d]
         if cfg.rope:
             q = _rope_at(q, *rope_rows)
             k = _rope_at(k, *rope_rows)
-        cache = kc.append_layer(cache, li, block_ids, offsets, k, v)
-        o = paged_attention(q, cache.k_pool[li], cache.v_pool[li],
-                            cache.block_tables, lengths)
-        o = o.reshape(1, o.shape[0], -1)               # [1, S, nh*d]
+        cache = kc.append_layer(cache, li, row_blk, row_off, k, v)
+        o = ragged_paged_attention(q, cache.k_pool[li], cache.v_pool[li],
+                                   cache.block_tables, qs, ql, kl)
+        o = o.reshape(1, tq, -1)                       # [1, Tq, nh*d]
         o = row_parallel_linear(
             o, lp["proj"]["kernel"], lp["proj"]["bias"], axis=ax,
             input_is_parallel=True)
@@ -257,7 +265,7 @@ def _decode_body(params, cache, tokens, active, *, cfg, scfg):
         x = x + _mlp(lp, _norm(x, lp["ln2"], cfg), cfg, None)
     x = _norm(x, params["final_ln"], cfg)
     x = copy_to_tensor_model_parallel_region(x, ax)
-    logits = _lm_logits(x, params, cfg)[0]             # [S, v/tp]
+    logits = _lm_logits(x, params, cfg)[0]             # [Tq, v/tp]
     return cache, _vp_greedy(logits, ax, scfg["tp"])
 
 
@@ -268,8 +276,9 @@ def _decode_body(params, cache, tokens, active, *, cfg, scfg):
 class ServingEngine:
     """Continuous-batching driver. ``mesh`` is a Mesh with a "model" axis
     (size 1 = single chip); weights shard per param_specs, the KV cache
-    per kv_cache.cache_pspecs. All loop state other than the cache is
-    host-side python."""
+    per kv_cache.cache_pspecs. The prefix index and the KV cache persist
+    across ``run`` calls (that persistence IS the warm-TTFT win); all
+    other loop state is per-run host python."""
 
     def __init__(self, scfg: ServingConfig, params,
                  mesh: Optional[Mesh] = None):
@@ -288,41 +297,62 @@ class ServingEngine:
             raise ValueError(
                 f"max_seq_len {scfg.max_seq_len} exceeds the model's "
                 f"position range ({cfg.seq_len})")
-        if scfg.max_prefill_len > scfg.max_seq_len:
-            raise ValueError("max_prefill_len exceeds max_seq_len")
+        if scfg.chunk_tokens < scfg.max_slots:
+            raise ValueError(
+                f"chunk_tokens {scfg.chunk_tokens} < max_slots "
+                f"{scfg.max_slots}: a full decode round must fit one step")
         self.scfg = scfg
         self.cfg = cfg
         self.mesh = mesh
         self.params = params
-        self.trace_counts = {"prefill": 0, "decode": 0}
+        self.index: Optional[kc.PrefixIndex] = (
+            kc.PrefixIndex(scfg.block_size) if scfg.prefix_cache else None)
+        self._cache: Optional[kc.PagedKVCache] = None
+        self.trace_counts = {"step": 0, "share": 0, "retain": 0,
+                             "release": 0, "free": 0}
 
         pspec = param_specs(cfg)
         cspec = kc.cache_pspecs(tp_axis="model")
         opts = {"cfg": cfg, "scfg": {"tp": tp}}
         counts = self.trace_counts
 
-        def prefill(params, cache, tokens, slot, length, n_blocks):
-            counts["prefill"] += 1            # trace-time side effect
-            with trace_range("serving.prefill"):
-                return _prefill_body(params, cache, tokens, slot, length,
-                                     n_blocks, **opts)
+        def step(params, cache, tokens, qs, ql):
+            counts["step"] += 1               # trace-time side effect
+            with trace_range("serving.step"):
+                return _step_body(params, cache, tokens, qs, ql, **opts)
 
-        def decode(params, cache, tokens, active):
-            counts["decode"] += 1
-            with trace_range("serving.decode"):
-                return _decode_body(params, cache, tokens, active, **opts)
+        def counted(name, fn):
+            def wrapped(*args):
+                counts[name] += 1
+                return fn(*args)
+            return wrapped
 
-        self._prefill = jax.jit(
-            smap(prefill, mesh,
-                 (pspec, cspec, P(), P(), P(), P()), (cspec, P())),
+        self._step = jax.jit(
+            smap(step, mesh, (pspec, cspec, P(), P(), P()), (cspec, P())),
             donate_argnums=(1,))
-        self._decode = jax.jit(
-            smap(decode, mesh, (pspec, cspec, P(), P()), (cspec, P())),
-            donate_argnums=(1,))
-        self._free = jax.jit(
-            smap(lambda cache, slot: kc.free_slot(cache, slot), mesh,
-                 (cspec, P()), cspec),
+        self._share = jax.jit(
+            smap(counted("share", kc.share_prefix), mesh,
+                 (cspec, P(), P(), P(), P()), cspec),
             donate_argnums=(0,))
+        self._retain = jax.jit(
+            smap(counted("retain", kc.retain_blocks), mesh,
+                 (cspec, P(), P()), cspec),
+            donate_argnums=(0,))
+        self._release = jax.jit(
+            smap(counted("release", kc.release_blocks), mesh,
+                 (cspec, P(), P()), cspec),
+            donate_argnums=(0,))
+        self._free = jax.jit(
+            smap(counted("free", kc.free_slot), mesh, (cspec, P()), cspec),
+            donate_argnums=(0,))
+
+    def reset_state(self) -> None:
+        """Forget the persistent KV cache and prefix index (the next run
+        cold-starts) without touching the compiled step — the A/B lever
+        benches use to re-measure cold TTFT on a warmed engine."""
+        self._cache = None
+        if self.index is not None:
+            self.index = kc.PrefixIndex(self.scfg.block_size)
 
     def fresh_cache(self) -> kc.PagedKVCache:
         s = self.scfg
@@ -332,42 +362,54 @@ class ServingEngine:
             head_dim=self.cfg.head_dim, max_slots=s.max_slots,
             max_blocks_per_seq=s.max_blocks_per_seq, dtype=s.dtype)
 
+    def _ids_row(self, ids: List[int]) -> jax.Array:
+        row = jnp.zeros((self.scfg.max_blocks_per_seq,), jnp.int32)
+        if ids:
+            row = row.at[: len(ids)].set(jnp.asarray(ids, jnp.int32))
+        return row
+
     # -- the serving loop -------------------------------------------
     def run(self, requests: List[Request], *, max_steps: int = 10_000,
             cache: Optional[kc.PagedKVCache] = None) -> Dict[object, dict]:
         """Serve ``requests`` (arrival-staggered) to completion. Returns
         {rid: {"tokens": [...], "ttft_step": int, "steps": int}} plus
-        engine stats under the reserved key ``None``."""
+        engine stats under the reserved key ``None``. With no explicit
+        ``cache`` the engine's persistent cache (and prefix index) carry
+        over from the previous run — the warm path; passing a cache
+        resets the index (its block ids would dangle)."""
         s = self.scfg
+        if cache is None:
+            cache = self._cache if self._cache is not None \
+                else self.fresh_cache()
+        elif self.index is not None:
+            self.index = kc.PrefixIndex(s.block_size)
+        held = len(self.index) if self.index is not None else 0
         sched = Scheduler(
-            max_slots=s.max_slots, num_blocks=s.num_blocks,
+            max_slots=s.max_slots, num_blocks=s.num_blocks - held,
             block_size=s.block_size,
             max_blocks_per_seq=s.max_blocks_per_seq,
-            watermark=s.watermark)
+            watermark=s.watermark, chunk_tokens=s.chunk_tokens,
+            prefix_index=self.index)
         for r in requests:
-            # fail fast at intake: a bad request must not surface as an
-            # opaque shape error mid-batch, after other requests already
-            # prefilled into the donated cache
-            if len(r.prompt) > s.max_prefill_len:
-                raise ValueError(
-                    f"request {r.rid!r}: prompt length {len(r.prompt)} "
-                    f"exceeds max_prefill_len {s.max_prefill_len}")
+            # fail fast at intake: a bad request must not surface as
+            # silent KV corruption mid-batch, after other requests
+            # already prefilled into the donated cache
             if len(r.prompt) + r.max_new_tokens > s.max_seq_len:
                 raise ValueError(
                     f"request {r.rid!r}: prompt + max_new_tokens = "
                     f"{len(r.prompt) + r.max_new_tokens} exceeds "
                     f"max_seq_len {s.max_seq_len}")
             sched.add(r)
-        if cache is None:
-            cache = self.fresh_cache()
         gen: Dict[int, List[int]] = {}                 # slot -> tokens
         out: Dict[object, dict] = {}
         stats = {"steps": 0, "prefills": 0, "decode_steps": 0,
-                 "decode_tokens": 0, "prefill_s": 0.0, "decode_s": 0.0}
+                 "decode_tokens": 0, "chunk_steps": 0, "chunk_tokens": 0,
+                 "prefix_hit_tokens": 0, "prefix_miss_tokens": 0,
+                 "prefill_s": 0.0, "decode_s": 0.0}
         waiting_since: Dict[object, float] = {}        # rid -> wall ts
         # host-side telemetry (docs/observability.md): everything below
-        # records OUTSIDE the jitted programs, so the prefill/decode HLO
-        # and the two-compile contract are untouched with metrics on
+        # records OUTSIDE the jitted step, so the step HLO and the
+        # one-compile contract are untouched with metrics on
         kv_free_min = sched.free_blocks
         if metrics_enabled():
             # materialize the event counters at 0 so a quiet run still
@@ -377,7 +419,9 @@ class ServingEngine:
             reg = default_registry()
             for name in ("serving/admissions", "serving/evictions",
                          "serving/preemptions",
-                         "serving/admission_blocked"):
+                         "serving/admission_blocked",
+                         "serving/prefix_hit_tokens",
+                         "serving/prefix_miss_tokens"):
                 reg.counter(name).inc(0)
             set_gauge("serving/kv_blocks_total", s.num_blocks)
             set_gauge("serving/kv_watermark", sched.watermark)
@@ -386,82 +430,131 @@ class ServingEngine:
             nonlocal cache
             st = sched.running[slot]
             out[st.req.rid]["tokens"] = gen.pop(slot)
+            newly: List[int] = []
+            if self.index is not None:
+                n_full = len(st.req.prompt) // s.block_size
+                if n_full:
+                    # one small host fetch per FINISHED request — the
+                    # index needs the slot's concrete page ids
+                    row = np.asarray(cache.block_tables)[slot][:n_full]
+                    newly = self.index.insert(st.req.prompt,
+                                              [int(b) for b in row])
+                    if newly:
+                        cache = self._retain(cache, self._ids_row(newly),
+                                             jnp.int32(len(newly)))
             cache = self._free(cache, jnp.int32(slot))
-            sched.release(slot)
+            sched.release(slot, newly)
 
         step = 0
-        while sched.has_work() and step < max_steps:
-            sched.tick(step)
-            for r in list(sched._waiting):
-                waiting_since.setdefault(r.rid, time.perf_counter())
-            set_gauge("serving/queue_depth", len(sched._waiting))
-            for slot, req, need in sched.admit():
-                tokens = jnp.zeros((1, s.max_prefill_len), jnp.int32
-                                   ).at[0, : len(req.prompt)].set(
-                    jnp.asarray(req.prompt, jnp.int32))
-                t0 = time.perf_counter()
-                # host-side profiler seam: marks the dispatch+wait span
-                # in host traces without touching the compiled program
-                # (host_trace_range — a named_scope here would rename ops
-                # if this call is the one that traces)
-                with host_trace_range("serving.prefill_dispatch"):
-                    cache, tok = self._prefill(
-                        self.params, cache, tokens, jnp.int32(slot),
-                        jnp.int32(len(req.prompt)), jnp.int32(need))
-                stats["prefills"] += 1
-                tok = int(tok)                # host sync: timing honest
-                now = time.perf_counter()
-                stats["prefill_s"] += now - t0
-                gen[slot] = [tok]
-                ttft = now - waiting_since.get(req.rid, t0)
-                observe("serving/ttft_s", ttft, buckets=TIME_BUCKETS)
-                observe("serving/prefill_s", now - t0,
-                        buckets=TIME_BUCKETS)
-                out[req.rid] = {
-                    "ttft_step": step, "steps": step,
-                    "ttft_s": ttft,
-                }
-                if req.max_new_tokens == 1 or tok == s.eos_id:
-                    finish(slot)
-            if sched.running:
-                active = jnp.zeros((s.max_slots,), bool)
-                tokens = jnp.zeros((s.max_slots,), jnp.int32)
-                for slot in sched.running:
-                    active = active.at[slot].set(True)
-                    tokens = tokens.at[slot].set(gen[slot][-1])
-                sched.grow_for_decode()       # host mirror of the device
-                t0 = time.perf_counter()
-                with host_trace_range("serving.paged_decode_step"):
-                    cache, nxt = self._decode(self.params, cache, tokens,
-                                              active)
-                stats["decode_steps"] += 1
-                stats["decode_tokens"] += len(sched.running)
-                nxt = jax.device_get(nxt)     # host sync: timing honest
-                dt = time.perf_counter() - t0
-                stats["decode_s"] += dt
-                # one decode step = one token per active slot, so the
-                # step latency IS the per-token latency (TPOT)
-                observe("serving/tpot_s", dt, buckets=TIME_BUCKETS)
-                for slot in list(sched.running):
-                    st = sched.running[slot]
-                    tok = int(nxt[slot])
-                    gen[slot].append(tok)
-                    out[st.req.rid]["steps"] = step
-                    if (len(gen[slot]) >= st.req.max_new_tokens
-                            or tok == s.eos_id):
-                        finish(slot)
-            kv_free_min = min(kv_free_min, sched.free_blocks)
-            set_gauge("serving/kv_blocks_free", sched.free_blocks)
-            set_gauge("serving/kv_occupancy",
-                      1.0 - sched.free_blocks / s.num_blocks)
-            set_gauge("serving/active_slots", len(sched.running))
-            step += 1
-        if sched.has_work():
-            raise RuntimeError(
-                f"serving loop exceeded {max_steps} steps with work left")
+        ok = False
+        try:
+            while sched.has_work() and step < max_steps:
+                sched.tick(step)
+                for r in list(sched._waiting):
+                    waiting_since.setdefault(r.rid, time.perf_counter())
+                set_gauge("serving/queue_depth", len(sched._waiting))
+                admissions = sched.admit()
+                for b in self._batched(sched.drain_releases()):
+                    cache = self._release(cache, self._ids_row(b),
+                                          jnp.int32(len(b)))
+                for adm in admissions:
+                    hit = len(adm.shared_ids) * s.block_size
+                    stats["prefix_hit_tokens"] += hit
+                    stats["prefix_miss_tokens"] += len(adm.req.prompt) - hit
+                    cache = self._share(
+                        cache, jnp.int32(adm.slot),
+                        self._ids_row(adm.shared_ids),
+                        jnp.int32(len(adm.shared_ids)),
+                        jnp.int32(adm.n_blocks))
+                work = sorted(sched.plan_step(), key=lambda w: w.slot)
+                if work:
+                    tokens = np.zeros((s.chunk_tokens,), np.int32)
+                    qs = np.zeros((s.max_slots,), np.int32)
+                    ql = np.zeros((s.max_slots,), np.int32)
+                    off = 0
+                    for w in work:                 # packed runs in slot order
+                        st = sched.running[w.slot]
+                        qs[w.slot] = off
+                        ql[w.slot] = w.n
+                        if w.kind == "chunk":
+                            tokens[off:off + w.n] = st.req.prompt[
+                                w.start:w.start + w.n]
+                        else:
+                            tokens[off] = gen[w.slot][-1]
+                        off += w.n
+                    t0 = time.perf_counter()
+                    # host-side profiler seam: marks the dispatch+wait span
+                    # in host traces without touching the compiled program
+                    with host_trace_range("serving.unified_step"):
+                        cache, nxt = self._step(
+                            self.params, cache, jnp.asarray(tokens),
+                            jnp.asarray(qs), jnp.asarray(ql))
+                    nxt = jax.device_get(nxt)     # host sync: timing honest
+                    now = time.perf_counter()
+                    dt = now - t0
+                    observe("serving/chunk_utilization", off / s.chunk_tokens,
+                            buckets=UTIL_BUCKETS)
+                    n_dec = sum(1 for w in work if w.kind == "decode")
+                    if n_dec:
+                        stats["decode_steps"] += 1
+                        stats["decode_tokens"] += n_dec
+                        stats["decode_s"] += dt
+                        # one decode item = one token for that slot, so the
+                        # step latency IS its per-token latency (TPOT)
+                        observe("serving/tpot_s", dt, buckets=TIME_BUCKETS)
+                    else:
+                        stats["prefill_s"] += dt
+                    if any(w.kind == "chunk" for w in work):
+                        stats["chunk_steps"] += 1
+                        stats["chunk_tokens"] += sum(
+                            w.n for w in work if w.kind == "chunk")
+                    for w in work:
+                        st = sched.running[w.slot]
+                        rid = st.req.rid
+                        if w.kind == "decode":
+                            tok = int(nxt[qs[w.slot]])
+                            gen[w.slot].append(tok)
+                            out[rid]["steps"] = step
+                            if (len(gen[w.slot]) >= st.req.max_new_tokens
+                                    or tok == s.eos_id):
+                                finish(w.slot)
+                        elif w.completes_prompt:
+                            tok = int(nxt[qs[w.slot] + w.n - 1])
+                            gen[w.slot] = [tok]
+                            stats["prefills"] += 1
+                            ttft = now - waiting_since.get(rid, t0)
+                            observe("serving/ttft_s", ttft,
+                                    buckets=TIME_BUCKETS)
+                            out[rid] = {"ttft_step": step, "steps": step,
+                                        "ttft_s": ttft}
+                            if st.req.max_new_tokens == 1 or tok == s.eos_id:
+                                finish(w.slot)
+                kv_free_min = min(kv_free_min, sched.free_blocks)
+                set_gauge("serving/kv_blocks_free", sched.free_blocks)
+                set_gauge("serving/kv_occupancy",
+                          1.0 - (sched.free_blocks
+                                 + (len(self.index) if self.index else 0))
+                          / s.num_blocks)
+                set_gauge("serving/active_slots", len(sched.running))
+                step += 1
+            if sched.has_work():
+                raise RuntimeError(
+                    f"serving loop exceeded {max_steps} steps with work "
+                    f"left")
+            ok = True
+        finally:
+            if not ok:
+                # the cache buffers were donated into the jitted step as
+                # the loop ran and the index's holds refer to them — a
+                # failed run must cold-start the next one instead of
+                # serving from deleted arrays / desynced refcounts
+                self.reset_state()
         stats["steps"] = step
         stats["trace_counts"] = dict(self.trace_counts)
+        stats["free_blocks"] = sched.free_blocks
+        stats["index_blocks"] = len(self.index) if self.index else 0
         stats["cache"] = cache
+        self._cache = cache
         # low-watermark + throughput summary gauges for the whole run
         set_gauge("serving/kv_blocks_free_min", kv_free_min)
         if stats["decode_s"] > 0:
@@ -471,6 +564,12 @@ class ServingEngine:
                       stats["decode_tokens"] / stats["decode_s"])
         out[None] = stats
         return out
+
+    def _batched(self, ids: List[int]):
+        """Chunk a host id list into fixed-width release calls."""
+        mb = self.scfg.max_blocks_per_seq
+        for i in range(0, len(ids), mb):
+            yield ids[i:i + mb]
 
 
 # ---------------------------------------------------------------------------

@@ -1,4 +1,5 @@
-"""Block-paged KV cache — a fixed-pool pytree with pure-functional ops.
+"""Block-paged KV cache — a fixed-pool pytree with pure-functional ops,
+per-block refcounts, and host-side hash-based prefix sharing.
 
 The serving memory model of "Ragged Paged Attention" (arxiv 2604.15464)
 and vLLM: K/V for all sequences live in ONE fixed pool of fixed-size
@@ -6,6 +7,26 @@ blocks ("pages"), and each sequence maps its logical positions to pool
 blocks through a block table. Admission/eviction then move block IDS, not
 KV bytes, and memory fragmentation is bounded by one partial block per
 sequence.
+
+Prefix caching (the millions-of-users lever: shared system prompts,
+multi-turn chat) adds two pieces on top:
+
+- **Per-block refcounts** (device side, part of the pytree): a block is
+  free iff its refcount is 0. A block may be referenced by several block
+  tables at once (a shared prompt prefix) and/or by the host-side prefix
+  index; ``free_slot`` DECREMENTS instead of freeing, so a shared page
+  outlives any one sequence. ``share_prefix`` admits a sequence by
+  pointing its table at already-resident pages (+1 each) and allocating
+  fresh pages only for the suffix; ``cow_append`` is the copy-on-write
+  guard that gives a slot a private copy of a shared partial page before
+  an append would write into it.
+- **PrefixIndex** (host side, plain python): a chain hash of block-sized
+  token runs -> the pool block id holding that run's K/V. The scheduler
+  matches an incoming prompt against it block by block; every indexed
+  block carries one refcount of its own (the engine retains newly
+  indexed blocks before freeing their slot), so cached prefixes survive
+  sequence eviction until the index itself evicts them under pool
+  pressure (LRU).
 
 Layout (the whole cache is a NamedTuple pytree — it jits, donates, and
 shards like any train state):
@@ -15,13 +36,14 @@ shards like any train state):
                      entries past n_blocks[slot] are meaningless and kept 0)
     n_blocks         [max_slots] int32  — blocks assigned per slot
     seq_lens         [max_slots] int32  — tokens written per slot
-    free             [num_blocks] bool  — pool free map (True = free)
+    refcount         [num_blocks] int32 — table references + prefix-index
+                     holds (0 = free)
 
 The per-layer pool slice ``k_pool[l]`` is exactly the
 ``[num_blocks, block_size, n_kv_heads, head_dim]`` operand
-ops/paged_attention.py consumes. Sharding (pspecs()): KV heads ride the
-TP axis — the same head split as the training tensor-parallel layers, so
-TP-sharded decode reuses the training weight layout — and the pool's
+ops/paged_attention.py consumes. Sharding (cache_pspecs()): KV heads ride
+the TP axis — the same head split as the training tensor-parallel layers,
+so TP-sharded decode reuses the training weight layout — and the pool's
 block axis can ride the data axis (each data rank serves its own
 requests from its own pool shard; inside shard_map all ops here are
 rank-local).
@@ -31,19 +53,21 @@ ops only, so the whole serving step — allocate, append, attend, free —
 jits as one program. Out-of-range scatters use mode="drop" as the
 masking mechanism for inactive slots (index ``num_blocks`` is the
 designated drop target). Callers keep the pool from overflowing via the
-scheduler's free-block watermark; ``alloc_decode_blocks`` on an empty
-pool is a documented invariant violation (it would corrupt block 0), so
-the engine checks ``free_block_count`` before every decode step.
+scheduler's free-block watermark; allocation on an empty pool is a
+documented invariant violation (it would corrupt block 0), so the
+engine checks ``free_block_count`` before every step.
 
 Env defaults (docs/serving.md): APEX_TPU_PAGED_BLOCK_SIZE (block_size,
-default 16), APEX_TPU_SERVING_MAX_SLOTS (max_slots, default 8) — read by
+default 16), APEX_TPU_SERVING_MAX_SLOTS (max_slots, default 8),
+APEX_TPU_SERVING_CHUNK_TOKENS (engine step budget) — read by
 serving/engine.py, not here; this module is explicit-arguments-only.
 """
 
 from __future__ import annotations
 
 import math
-from typing import NamedTuple, Optional
+from collections import OrderedDict
+from typing import List, Mapping, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -56,7 +80,7 @@ class PagedKVCache(NamedTuple):
     block_tables: jax.Array  # [max_slots, max_blocks_per_seq] int32
     n_blocks: jax.Array     # [max_slots] int32
     seq_lens: jax.Array     # [max_slots] int32
-    free: jax.Array         # [N] bool
+    refcount: jax.Array     # [N] int32 (0 = free)
 
     # -- static views ------------------------------------------------
     @property
@@ -80,7 +104,7 @@ def paged_kv_cache(layers: int, num_blocks: int, block_size: int,
                    n_kv_heads: int, head_dim: int, max_slots: int,
                    max_blocks_per_seq: Optional[int] = None,
                    dtype=jnp.bfloat16) -> PagedKVCache:
-    """A fresh cache: empty pool, zeroed tables, everything free."""
+    """A fresh cache: empty pool, zeroed tables, every refcount 0."""
     if max_blocks_per_seq is None:
         max_blocks_per_seq = num_blocks
     shape = (layers, num_blocks, block_size, n_kv_heads, head_dim)
@@ -90,7 +114,7 @@ def paged_kv_cache(layers: int, num_blocks: int, block_size: int,
         block_tables=jnp.zeros((max_slots, max_blocks_per_seq), jnp.int32),
         n_blocks=jnp.zeros((max_slots,), jnp.int32),
         seq_lens=jnp.zeros((max_slots,), jnp.int32),
-        free=jnp.ones((num_blocks,), bool),
+        refcount=jnp.zeros((num_blocks,), jnp.int32),
     )
 
 
@@ -107,7 +131,7 @@ def cache_pspecs(tp_axis: Optional[str] = "model",
         block_tables=P(data_axis),
         n_blocks=P(data_axis),
         seq_lens=P(data_axis),
-        free=P(data_axis),
+        refcount=P(data_axis),
     )
 
 
@@ -117,42 +141,68 @@ def blocks_needed(n_tokens: int, block_size: int) -> int:
 
 
 def free_block_count(cache: PagedKVCache):
-    return jnp.sum(cache.free.astype(jnp.int32))
+    return jnp.sum((cache.refcount == 0).astype(jnp.int32))
 
 
 # ---------------------------------------------------------------------------
-# allocate / free
+# allocate / share / free
 # ---------------------------------------------------------------------------
 
-def allocate_slot(cache: PagedKVCache, slot, n_blocks) -> PagedKVCache:
-    """Assign the first ``n_blocks`` free pool blocks to ``slot`` (its
-    whole table row is replaced; seq_len resets to 0). ``n_blocks`` may be
-    traced; the caller guarantees ``n_blocks <= free_block_count`` and
-    ``n_blocks <= max_blocks_per_seq`` (scheduler admission)."""
+def share_prefix(cache: PagedKVCache, slot, shared_ids, n_shared,
+                 n_total) -> PagedKVCache:
+    """Admit ``slot`` with a resident prefix: its table's first
+    ``n_shared`` entries point at ``shared_ids`` (already-resident pages,
+    refcount += 1 each — the prefix-cache hit), entries
+    ``[n_shared, n_total)`` take the first free pool blocks (refcount
+    set to 1), and ``seq_lens`` starts at ``n_shared * block_size`` (the
+    prefix tokens are already written; the engine prefills only the
+    suffix). ``shared_ids`` is a fixed-shape [max_blocks_per_seq] int32
+    row; entries past ``n_shared`` are ignored. ``n_shared``/``n_total``
+    may be traced; the caller guarantees ``n_total - n_shared <=
+    free_block_count`` and ``n_total <= max_blocks_per_seq`` (scheduler
+    admission), and that the shared ids are distinct resident blocks."""
     mb = cache.max_blocks_per_seq
     nb_pool = cache.num_blocks
+    lane = jnp.arange(mb)
     # free blocks first, in index order (stable sort of the "taken" flag)
-    order = jnp.argsort(jnp.logical_not(cache.free), stable=True)
-    take = order[:mb]
+    order = jnp.argsort(cache.refcount > 0, stable=True)
+    take = order[:mb].astype(jnp.int32)
     if mb > nb_pool:  # tiny pools: pad with the drop target
         take = jnp.concatenate(
             [take, jnp.full((mb - nb_pool,), nb_pool, take.dtype)])
-    lane = jnp.arange(mb) < n_blocks
-    row = jnp.where(lane, take, 0).astype(jnp.int32)
-    free = cache.free.at[jnp.where(lane, take, nb_pool)].set(
-        False, mode="drop")
+    shared_ids = jnp.asarray(shared_ids, jnp.int32)
+    is_shared = lane < n_shared
+    is_fresh = (lane >= n_shared) & (lane < n_total)
+    fresh = take[jnp.clip(lane - n_shared, 0, mb - 1)]
+    row = jnp.where(is_shared, shared_ids,
+                    jnp.where(is_fresh, fresh, 0)).astype(jnp.int32)
+    rc = cache.refcount.at[
+        jnp.where(is_shared, shared_ids, nb_pool)].add(1, mode="drop")
+    rc = rc.at[jnp.where(is_fresh, fresh, nb_pool)].set(1, mode="drop")
     return cache._replace(
         block_tables=cache.block_tables.at[slot].set(row),
         n_blocks=cache.n_blocks.at[slot].set(
-            jnp.asarray(n_blocks, jnp.int32)),
-        seq_lens=cache.seq_lens.at[slot].set(0),
-        free=free,
+            jnp.asarray(n_total, jnp.int32)),
+        seq_lens=cache.seq_lens.at[slot].set(
+            jnp.asarray(n_shared * cache.block_size, jnp.int32)),
+        refcount=rc,
     )
 
 
+def allocate_slot(cache: PagedKVCache, slot, n_blocks) -> PagedKVCache:
+    """Assign the first ``n_blocks`` free pool blocks to ``slot`` (its
+    whole table row is replaced; seq_len resets to 0) — the cold-path
+    special case of ``share_prefix`` with an empty shared prefix."""
+    return share_prefix(cache, slot,
+                        jnp.zeros((cache.max_blocks_per_seq,), jnp.int32),
+                        0, n_blocks)
+
+
 def free_slot(cache: PagedKVCache, slot) -> PagedKVCache:
-    """Return ``slot``'s blocks to the pool and clear its row. Idempotent
-    (a slot with n_blocks == 0 frees nothing)."""
+    """Release ``slot``: clear its row and DECREMENT its blocks'
+    refcounts — blocks shared with another slot or held by the prefix
+    index stay resident; only refcount 0 returns a block to the pool.
+    Idempotent (a slot with n_blocks == 0 frees nothing)."""
     mb = cache.max_blocks_per_seq
     lane = jnp.arange(mb) < cache.n_blocks[slot]
     ids = jnp.where(lane, cache.block_tables[slot], cache.num_blocks)
@@ -161,8 +211,31 @@ def free_slot(cache: PagedKVCache, slot) -> PagedKVCache:
             jnp.zeros((mb,), jnp.int32)),
         n_blocks=cache.n_blocks.at[slot].set(0),
         seq_lens=cache.seq_lens.at[slot].set(0),
-        free=cache.free.at[ids].set(True, mode="drop"),
+        refcount=cache.refcount.at[ids].add(-1, mode="drop"),
     )
+
+
+def retain_blocks(cache: PagedKVCache, ids, n) -> PagedKVCache:
+    """refcount += 1 for ``ids[:n]`` (fixed-shape [max_blocks_per_seq]
+    row) — the engine's handoff of newly prefix-indexed blocks from a
+    finishing slot to the index, called BEFORE free_slot so the pages
+    never transit refcount 0."""
+    lane = jnp.arange(ids.shape[0])
+    tgt = jnp.where(lane < n, jnp.asarray(ids, jnp.int32),
+                    cache.num_blocks)
+    return cache._replace(
+        refcount=cache.refcount.at[tgt].add(1, mode="drop"))
+
+
+def release_blocks(cache: PagedKVCache, ids, n) -> PagedKVCache:
+    """refcount -= 1 for ``ids[:n]`` — prefix-index eviction returning
+    its hold on cached pages (a page still shared by a running slot
+    stays resident)."""
+    lane = jnp.arange(ids.shape[0])
+    tgt = jnp.where(lane < n, jnp.asarray(ids, jnp.int32),
+                    cache.num_blocks)
+    return cache._replace(
+        refcount=cache.refcount.at[tgt].add(-1, mode="drop"))
 
 
 # ---------------------------------------------------------------------------
@@ -171,7 +244,7 @@ def free_slot(cache: PagedKVCache, slot) -> PagedKVCache:
 
 def write_prefill(cache: PagedKVCache, slot, k, v, length) -> PagedKVCache:
     """Scatter a prefill's K/V into ``slot``'s assigned pages and set its
-    length. k/v: [layers, t_pad, n_kv_heads, head_dim] (the fixed padded
+    length. k/v: [layers, t_pad, n_kv_heads, head_dim] (a fixed padded
     prefill shape); rows at positions >= ``length`` are dropped. The slot
     must hold >= ceil(length / block_size) blocks (allocate_slot)."""
     t_pad = k.shape[1]
@@ -193,59 +266,139 @@ def write_prefill(cache: PagedKVCache, slot, k, v, length) -> PagedKVCache:
 
 
 # ---------------------------------------------------------------------------
-# decode append
+# append (decode steps and prefill chunks)
 # ---------------------------------------------------------------------------
+
+def cow_append(cache: PagedKVCache, active) -> PagedKVCache:
+    """Copy-on-write guard before appending at each active slot's current
+    position: if the page the next token would land in is partially
+    filled AND shared (refcount > 1 — another slot or the prefix index
+    also reads it), the slot gets a private copy first (fresh block,
+    page contents copied, table repointed, shared refcount -= 1).
+
+    With the engine's full-block-only prefix sharing a suffix always
+    starts on a page boundary, so this never fires there — it is the
+    safety net that makes partial-page sharing (forking, speculative
+    branches) correct by construction. Callers keep one free block per
+    potentially-COWed slot under the admission watermark."""
+    bs = cache.block_size
+    mb = cache.max_blocks_per_seq
+    nb_pool = cache.num_blocks
+    pos = cache.seq_lens                                       # [S]
+    tbl_idx = jnp.clip(pos // bs, 0, mb - 1)
+    blk = jnp.take_along_axis(cache.block_tables, tbl_idx[:, None],
+                              1)[:, 0]
+    inside = (jnp.asarray(active, bool) & (pos % bs != 0)
+              & (pos // bs < cache.n_blocks))
+    src_c = jnp.clip(blk, 0, nb_pool - 1)
+    shared = inside & (cache.refcount[src_c] > 1)
+
+    def body(carry, s):
+        rc, tables = carry
+        f = jnp.argmax(rc == 0).astype(jnp.int32)              # first free
+        need = shared[s]
+        rc = rc.at[f].set(jnp.where(need, 1, rc[f]))
+        rc = rc.at[src_c[s]].add(jnp.where(need, -1, 0))
+        tables = tables.at[s, tbl_idx[s]].set(
+            jnp.where(need, f, tables[s, tbl_idx[s]]))
+        return (rc, tables), jnp.where(need, f, nb_pool)
+
+    (rc, tables), dst = jax.lax.scan(
+        body, (cache.refcount, cache.block_tables),
+        jnp.arange(cache.max_slots))
+
+    def _copy(pools):
+        kp, vp = pools
+        return (kp.at[:, dst].set(kp[:, src_c], mode="drop"),
+                vp.at[:, dst].set(vp[:, src_c], mode="drop"))
+
+    # the page gather+scatter is the expensive part and the common case
+    # is "no COW anywhere" — gate it at RUNTIME so the steady-state step
+    # pays one predicate, not [L, S, bs, Hkv, D] of HBM traffic
+    k_pool, v_pool = jax.lax.cond(
+        jnp.any(shared), _copy, lambda pools: pools,
+        (cache.k_pool, cache.v_pool))
+    return cache._replace(
+        k_pool=k_pool,
+        v_pool=v_pool,
+        block_tables=tables,
+        refcount=rc,
+    )
+
+
+def extend_slots(cache: PagedKVCache, active, ql) -> PagedKVCache:
+    """Advance each active slot's ``seq_lens`` by ``ql[s]`` tokens,
+    allocating AT MOST ONE fresh pool block where the new span crosses
+    into an unassigned page. Decode steps (ql == 1) grow across page
+    boundaries here; prefill chunks land in pages assigned up front at
+    admission (share_prefix), so they never need growth — a chunk that
+    WOULD need more than one fresh page is a scheduler bug this op does
+    not mask (the span past the one granted page scatters to the drop
+    target and check_invariants flags the length).
+
+    Growth walks slots with a scan (max_slots is small and static),
+    handing each needy slot the first free block — callers keep
+    ``free_block_count >= popcount(need)`` via the admission watermark.
+    """
+    ql = jnp.where(jnp.asarray(active, bool), jnp.asarray(ql, jnp.int32), 0)
+    pos_end = cache.seq_lens + ql
+    bs = cache.block_size
+    need_blocks = (pos_end + bs - 1) // bs
+    need = ((need_blocks > cache.n_blocks)
+            & (cache.n_blocks < cache.max_blocks_per_seq))
+
+    def body(carry, s):
+        rc, tables, nblk = carry
+        blk = jnp.argmax(rc == 0).astype(jnp.int32)            # first free
+        grow = need[s]
+        ti = jnp.clip(nblk[s], 0, cache.max_blocks_per_seq - 1)
+        rc = rc.at[blk].set(jnp.where(grow, 1, rc[blk]))
+        tables = tables.at[s, ti].set(jnp.where(grow, blk, tables[s, ti]))
+        nblk = nblk.at[s].add(jnp.where(grow, 1, 0))
+        return (rc, tables, nblk), None
+
+    (rc, tables, nblk), _ = jax.lax.scan(
+        body, (cache.refcount, cache.block_tables, cache.n_blocks),
+        jnp.arange(cache.max_slots))
+    return cache._replace(
+        block_tables=tables, n_blocks=nblk, refcount=rc,
+        seq_lens=pos_end,
+    )
+
 
 def alloc_decode_blocks(cache: PagedKVCache, active):
     """Reserve this decode step's token position for every active slot,
-    growing block tables where the position opens a new page.
+    growing block tables where the position opens a new page (the PR-3
+    decode entry — ``extend_slots`` with ql == 1 plus the per-slot write
+    coordinates).
 
     active: [max_slots] bool. Returns (cache, block_ids, offsets) where
     block_ids/offsets [max_slots] locate each active slot's NEW token
     (inactive slots get the drop target ``num_blocks``); seq_lens of
     active slots are already incremented, so the lengths the paged
     kernel wants (current token included) are ``cache.seq_lens``.
-
-    Growth walks slots with a scan (max_slots is small and static),
-    handing each needy slot the first free block — callers keep
-    ``free_block_count >= popcount(need)`` via the admission watermark.
     """
     pos = cache.seq_lens                                       # [S]
-    need = active & (pos // cache.block_size >= cache.n_blocks) \
-        & (cache.n_blocks < cache.max_blocks_per_seq)
-
-    def body(carry, s):
-        free, tables, nblk = carry
-        blk = jnp.argmax(free).astype(jnp.int32)               # first free
-        grow = need[s]
-        free = free.at[blk].set(jnp.where(grow, False, free[blk]))
-        tables = tables.at[s, jnp.clip(nblk[s], 0,
-                                       cache.max_blocks_per_seq - 1)].set(
-            jnp.where(grow, blk, tables[s, jnp.clip(
-                nblk[s], 0, cache.max_blocks_per_seq - 1)]))
-        nblk = nblk.at[s].add(jnp.where(grow, 1, 0))
-        return (free, tables, nblk), None
-
-    (free, tables, nblk), _ = jax.lax.scan(
-        body, (cache.free, cache.block_tables, cache.n_blocks),
-        jnp.arange(cache.max_slots))
+    active = jnp.asarray(active, bool)
+    out = extend_slots(cache, active, jnp.ones((cache.max_slots,),
+                                               jnp.int32))
     tbl_idx = jnp.clip(pos // cache.block_size, 0,
                        cache.max_blocks_per_seq - 1)
     block_ids = jnp.where(
-        active, jnp.take_along_axis(tables, tbl_idx[:, None], 1)[:, 0],
+        active,
+        jnp.take_along_axis(out.block_tables, tbl_idx[:, None], 1)[:, 0],
         cache.num_blocks).astype(jnp.int32)
     offsets = (pos % cache.block_size).astype(jnp.int32)
-    return cache._replace(
-        block_tables=tables, n_blocks=nblk, free=free,
-        seq_lens=pos + active.astype(jnp.int32),
-    ), block_ids, offsets
+    return out, block_ids, offsets
 
 
 def append_layer(cache: PagedKVCache, layer: int, block_ids, offsets,
                  k_tok, v_tok) -> PagedKVCache:
-    """Write one decode token's K/V for ``layer`` at the positions
-    alloc_decode_blocks reserved. k_tok/v_tok: [max_slots, n_kv_heads,
-    head_dim]; slots whose block_id is the drop target write nothing."""
+    """Write K/V rows for ``layer`` at reserved positions. k_tok/v_tok:
+    [n, n_kv_heads, head_dim] with block_ids/offsets [n] — one row per
+    decode slot (alloc_decode_blocks) OR per packed ragged query row
+    (the unified serving step); rows whose block_id is the drop target
+    write nothing."""
     return cache._replace(
         k_pool=cache.k_pool.at[layer, block_ids, offsets].set(
             k_tok.astype(cache.k_pool.dtype), mode="drop"),
@@ -255,30 +408,146 @@ def append_layer(cache: PagedKVCache, layer: int, block_ids, offsets,
 
 
 # ---------------------------------------------------------------------------
+# host-side prefix index (hash -> resident block id)
+# ---------------------------------------------------------------------------
+
+class PrefixIndex:
+    """Content-addressed index of FULL resident pages: chain hash of
+    block-sized token runs -> pool block id. Host-side plain python (the
+    scheduler consults it at admission; no device work).
+
+    The hash of block i covers the WHOLE prompt prefix through block i
+    (h_i = hash(h_{i-1}, tokens of block i)), so a match is always a
+    contiguous prefix and two different prefixes never alias onto the
+    same chain entry. Only full blocks are indexed — a partial page's
+    tail bytes belong to one sequence only (cow_append covers the day
+    partial sharing is added).
+
+    Refcount contract: every indexed block id carries ONE device
+    refcount held by the index (the engine retains newly inserted ids
+    before freeing their slot, and releases evicted ids). ``evict``
+    drops least-recently-matched entries first; evicting a chain's
+    parent strands its children (match() walks from the root), which is
+    accepted — children age out by the same LRU.
+    """
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+        self._chain: "OrderedDict[int, int]" = OrderedDict()  # hash -> id
+        self._holds: dict = {}                                # id -> hash
+
+    def __len__(self) -> int:
+        return len(self._chain)
+
+    def holds(self, block_id: int) -> bool:
+        """True while the index carries a refcount on ``block_id``."""
+        return int(block_id) in self._holds
+
+    def held_ids(self) -> dict:
+        """{block_id: 1} for every page the index holds — the
+        ``index_refs`` argument check_invariants wants."""
+        return {bid: 1 for bid in self._holds}
+
+    def _hashes(self, tokens: Sequence[int]) -> List[int]:
+        bs = self.block_size
+        h = 0
+        out = []
+        for i in range(len(tokens) // bs):
+            h = hash((h, tuple(tokens[i * bs:(i + 1) * bs])))
+            out.append(h)
+        return out
+
+    def match(self, tokens: Sequence[int]) -> List[int]:
+        """Longest indexed full-block prefix of ``tokens`` -> resident
+        block ids (possibly empty). Touches matched entries (LRU)."""
+        ids = []
+        for h in self._hashes(tokens):
+            bid = self._chain.get(h)
+            if bid is None:
+                break
+            self._chain.move_to_end(h)
+            ids.append(bid)
+        return ids
+
+    def insert(self, tokens: Sequence[int],
+               block_ids: Sequence[int]) -> List[int]:
+        """Index the full-block chain of ``tokens`` resident at
+        ``block_ids`` (the sequence's table prefix, in order). Returns
+        the ids NEWLY indexed — the caller must retain exactly these on
+        device. Chains already present (a concurrent duplicate wrote the
+        same content elsewhere) keep their existing block; the
+        duplicate's pages simply free with its slot."""
+        new = []
+        for h, bid in zip(self._hashes(tokens), block_ids):
+            if h in self._chain:
+                self._chain.move_to_end(h)
+                continue
+            self._chain[h] = int(bid)
+            self._holds[int(bid)] = h
+            new.append(int(bid))
+        return new
+
+    def evict(self, n: int, protect=frozenset()) -> List[int]:
+        """Drop up to ``n`` least-recently-matched entries whose block id
+        is not in ``protect`` (blocks an in-flight admission is about to
+        share must keep their hold until the device share lands);
+        returns the evicted block ids — the caller must release exactly
+        these on device."""
+        out = []
+        for h in list(self._chain):
+            if len(out) >= n:
+                break
+            bid = self._chain[h]
+            if bid in protect:
+                continue
+            del self._chain[h]
+            self._holds.pop(bid, None)
+            out.append(bid)
+        return out
+
+
+# ---------------------------------------------------------------------------
 # invariant check (tests / debugging — host side)
 # ---------------------------------------------------------------------------
 
-def check_invariants(cache: PagedKVCache) -> None:
-    """Assert the pool accounting is consistent: assigned blocks are
-    distinct, none of them is marked free, and every unassigned block is
-    free. Host-side (concrete arrays) — test helper, not a jit citizen."""
+def check_invariants(cache: PagedKVCache,
+                     index_refs: Optional[Mapping[int, int]] = None) -> None:
+    """Assert the pool accounting is consistent under sharing: every
+    block reachable from a block table has refcount >= 1, freed
+    (unreferenced) blocks have refcount exactly 0, and — with the
+    prefix index's holds supplied as ``index_refs`` ({block_id: count},
+    or any iterable of held ids) — every block's refcount EQUALS its
+    table references plus index holds, so a refcount leak fails fast in
+    tests instead of silently shrinking pool capacity. Host-side
+    (concrete arrays) — test helper, not a jit citizen."""
     import numpy as np
 
     tables = np.asarray(cache.block_tables)
     nblk = np.asarray(cache.n_blocks)
-    free = np.asarray(cache.free)
+    rc = np.asarray(cache.refcount)
     lens = np.asarray(cache.seq_lens)
-    assigned: list = []
+    nb = cache.num_blocks
+    table_refs = np.zeros(nb, np.int64)
     for s in range(cache.max_slots):
         row = tables[s, : nblk[s]]
-        assigned.extend(row.tolist())
+        assert row.size == 0 or (0 <= row.min() and row.max() < nb), (
+            f"slot {s}: table ids {row.tolist()} out of pool range {nb}")
+        np.add.at(table_refs, row, 1)
         assert lens[s] <= nblk[s] * cache.block_size, (
             f"slot {s}: {lens[s]} tokens exceed {nblk[s]} blocks")
-    assert len(assigned) == len(set(assigned)), (
-        f"double-assigned pool blocks: {sorted(assigned)}")
-    for b in assigned:
-        assert not free[b], f"assigned block {b} marked free"
-    assert len(assigned) + int(free.sum()) == cache.num_blocks, (
-        "pool accounting leak: "
-        f"{len(assigned)} assigned + {int(free.sum())} free "
-        f"!= {cache.num_blocks}")
+    expected = table_refs.copy()
+    if index_refs is not None:
+        items = (index_refs.items() if hasattr(index_refs, "items")
+                 else ((b, 1) for b in index_refs))
+        for b, n in items:
+            expected[int(b)] += int(n)
+    assert (rc >= 0).all(), f"negative refcounts: {np.flatnonzero(rc < 0)}"
+    bad = np.flatnonzero((table_refs > 0) & (rc < 1))
+    assert bad.size == 0, (
+        f"blocks {bad.tolist()} reachable from a block table with "
+        f"refcount 0")
+    bad = np.flatnonzero(rc != expected)
+    assert bad.size == 0, (
+        "refcount leak: blocks "
+        f"{[(int(b), int(rc[b]), int(expected[b])) for b in bad[:8]]} "
+        "(id, refcount, table+index refs) disagree")

@@ -1,40 +1,62 @@
-"""Continuous-batching scheduler — host-side block/slot accounting.
+"""Continuous-batching scheduler — host-side block/slot/chunk accounting.
 
 The split of responsibilities mirrors production TPU serving stacks: the
-DEVICE side (engine.py) is two fixed-shape jitted programs — prefill and
-decode — that never recompile; the HOST side (this module) decides *what*
-those programs run on each step: which waiting request is admitted into
-which slot, and when a finished sequence's blocks return to the pool.
+DEVICE side (engine.py) is ONE fixed-shape jitted step that never
+recompiles; the HOST side (this module) decides *what* that step runs on
+each tick: which waiting request is admitted into which slot (and how
+much of its prompt is already resident — the prefix cache), how this
+step's fixed token budget (``chunk_tokens``) splits between decode steps
+and prefill chunks, and when a finished sequence's blocks return to the
+pool or are handed to the prefix index.
 
 State machine per request::
 
-    WAITING --admit--> RUNNING --(eos | max_new_tokens)--> FINISHED
+    WAITING --admit--> RUNNING (chunk prefill -> decode)
+                         --(eos | max_new_tokens)--> FINISHED
       ^ arrival gate (requests carry an arrival step; continuous
         batching means later arrivals join mid-flight decodes)
 
+**Chunked prefill** (``plan_step``): every step carries at most
+``chunk_tokens`` query tokens through the unified program. Decode steps
+come first (one token per decode-ready slot — latency critical), then
+prompt chunks FIFO in slot order fill the remaining budget, so a long
+prompt is split across steps and never stalls running decodes behind a
+monolithic prefill.
+
+**Prefix-aware admission**: a request's prompt is matched against the
+PrefixIndex (kv_cache.py) full block by full block; matched blocks are
+SHARED (device refcount += 1 via share_prefix), and only the suffix
+blocks are charged against the free-block watermark — a shared block is
+already resident and is never double-counted against
+``free_blocks``. At least one prompt token is always left to recompute:
+its logits emit the first generated token. Under pool pressure the
+scheduler evicts least-recently-matched index entries (their device
+refcount release is drained by the engine via ``drain_releases``)
+before blocking admission.
+
 Admission policy (free-block watermark): a request is admitted only when
 a slot is free AND the pool would retain >= ``watermark`` free blocks
-after its prompt allocation. The watermark reserves decode headroom for
+after its suffix allocation. The watermark reserves decode headroom for
 the sequences already running — every active sequence needs at most one
 new block per ``block_size`` decode steps, so ``watermark = max_slots``
 (the default) guarantees a full round of block growth before the next
-admission can be reconsidered; sizing the pool for the worst case
-(``sum(ceil(max_ctx/bs))``) makes growth unconditionally safe.
+admission can be reconsidered.
 
 The scheduler's counters are an exact host mirror of the device cache's
-accounting (it sees every admit/grow/release), so steady-state decode
-needs no device round-trip to make admission decisions. The engine
-cross-checks the mirror against ``kv_cache.free_block_count`` in tests.
+refcount accounting (it sees every admit/share/grow/release/evict), so
+steady-state serving needs no device round-trip to make admission
+decisions. The engine cross-checks the mirror against
+``kv_cache.free_block_count`` in tests.
 """
 
 from __future__ import annotations
 
 import dataclasses
 from collections import deque
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Deque, Dict, Iterable, List, Optional
 
 from apex_tpu.observability import inc_counter
-from apex_tpu.serving.kv_cache import blocks_needed
+from apex_tpu.serving.kv_cache import PrefixIndex, blocks_needed
 
 WAITING = "WAITING"
 RUNNING = "RUNNING"
@@ -64,29 +86,76 @@ class _Running:
     req: Request
     slot: int
     n_blocks: int          # blocks currently assigned to the slot
-    tokens_in_cache: int   # prompt + generated tokens written so far
+    tokens_in_cache: int   # prefix + chunk + decode tokens written so far
+    prefilled: int         # prompt tokens resident (prefix hit + chunks)
+    shared_ids: List[int]  # prefix blocks borrowed from the index
+
+
+@dataclasses.dataclass
+class Admission:
+    """One admitted request, ready for the engine's share_prefix call:
+    point ``slot``'s table at ``shared_ids`` (the prefix-cache hit, may
+    be empty) and allocate ``n_blocks - len(shared_ids)`` fresh suffix
+    blocks."""
+
+    slot: int
+    req: Request
+    shared_ids: List[int]
+    n_blocks: int
+
+    @property
+    def prefix_tokens(self) -> int:
+        return len(self.shared_ids)  # caller scales by block_size
+
+
+@dataclasses.dataclass
+class Work:
+    """One slot's share of a step's token budget: a prompt chunk
+    (``kind == "chunk"``, prompt[start : start+n]) or a decode step
+    (``kind == "decode"``, n == 1, the slot's last generated token).
+    ``completes_prompt`` marks the chunk whose last-row logits emit the
+    request's FIRST generated token."""
+
+    slot: int
+    kind: str
+    start: int
+    n: int
+    completes_prompt: bool = False
 
 
 class Scheduler:
-    """Slot/block bookkeeping + admission. Pure host state."""
+    """Slot/block/chunk bookkeeping + admission. Pure host state."""
 
     def __init__(self, *, max_slots: int, num_blocks: int, block_size: int,
                  max_blocks_per_seq: int,
-                 watermark: Optional[int] = None):
+                 watermark: Optional[int] = None,
+                 chunk_tokens: Optional[int] = None,
+                 prefix_index: Optional[PrefixIndex] = None):
         self.max_slots = max_slots
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
         self.free_blocks = num_blocks
         self.watermark = max_slots if watermark is None else watermark
+        self.chunk_tokens = (max(1, max_slots) if chunk_tokens is None
+                             else chunk_tokens)
+        if self.chunk_tokens < max_slots:
+            raise ValueError(
+                f"chunk_tokens {self.chunk_tokens} < max_slots "
+                f"{max_slots}: a full decode round must fit one step")
+        self.index = prefix_index
         self._future: List[Request] = []
         self._waiting: Deque[Request] = deque()
         self.running: Dict[int, _Running] = {}     # slot -> state
         self._free_slots = sorted(range(max_slots))
+        # host mirror of index-held blocks currently shared by slots
+        self._shared_in_use: Dict[int, int] = {}
+        # index evictions awaiting their device refcount release
+        self._pending_releases: List[int] = []
 
     # -- intake ------------------------------------------------------
     def add(self, req: Request) -> None:
         # capacity check covers the WHOLE lifetime (prompt + decode
-        # budget), so grow_for_decode can never push a sequence past
+        # budget), so decode growth can never push a sequence past
         # max_blocks_per_seq — without this, decode past the last page
         # would silently overwrite live K/V on device while the host
         # mirror debits blocks the device never allocated
@@ -110,37 +179,127 @@ class Scheduler:
         return bool(self._future or self._waiting or self.running)
 
     # -- admission ---------------------------------------------------
-    def admit(self) -> List[Tuple[int, Request, int]]:
+    def _make_room(self, fresh: int, protect: set) -> None:
+        """Evict least-recently-matched prefix-index entries until the
+        watermark would pass (or the index runs dry). Evicting an entry
+        drops the index's device refcount (drained by the engine); the
+        block only becomes FREE if no running slot still shares it."""
+        while (self.index is not None and len(self.index)
+               and self.free_blocks - fresh < self.watermark):
+            ids = self.index.evict(1, protect=protect)
+            if not ids:
+                break
+            for b in ids:
+                self._pending_releases.append(b)
+                if self._shared_in_use.get(b, 0) == 0:
+                    self.free_blocks += 1
+
+    def drain_releases(self) -> List[int]:
+        """Block ids whose index refcount release is due on device."""
+        out, self._pending_releases = self._pending_releases, []
+        return out
+
+    def admit(self) -> List[Admission]:
         """Admit FIFO from the wait queue while a slot is free and the
-        pool keeps ``watermark`` blocks after each prompt allocation.
-        Returns [(slot, request, prompt_blocks)]; the caller runs the
-        prefills and reports the first decode tokens via started()."""
-        admitted = []
+        pool keeps ``watermark`` blocks after each request's FRESH
+        (non-shared) allocation. Prefix-matched blocks are borrowed from
+        the index (refcount-aware: already resident, charged zero), so
+        admission is not spuriously blocked when most resident blocks
+        are shared prefixes."""
+        admitted: List[Admission] = []
         while self._waiting and self._free_slots:
             req = self._waiting[0]
-            need = blocks_needed(len(req.prompt), self.block_size)
-            if self.free_blocks - need < self.watermark:
+            prompt = req.prompt
+            matched = self.index.match(prompt) if self.index else []
+            # always leave >= 1 prompt token to recompute: its logits
+            # emit the first generated token
+            n_shared = min(len(matched),
+                           (len(prompt) - 1) // self.block_size)
+            shared_ids = matched[:n_shared]
+            need = blocks_needed(len(prompt), self.block_size)
+            fresh = need - n_shared
+            protect = set(shared_ids) | set(self._shared_in_use)
+            if self.free_blocks - fresh < self.watermark:
+                self._make_room(fresh, protect)
+            if self.free_blocks - fresh < self.watermark:
                 # the head-of-line request deferred by the watermark: the
                 # KV-pressure signal an operator sizes the pool by
                 inc_counter("serving/admission_blocked", 1)
                 break                         # FIFO: no skip-ahead
             self._waiting.popleft()
             slot = self._free_slots.pop(0)
-            self.free_blocks -= need
+            self.free_blocks -= fresh
+            for b in shared_ids:
+                self._shared_in_use[b] = self._shared_in_use.get(b, 0) + 1
+            prefix_tokens = n_shared * self.block_size
             self.running[slot] = _Running(
                 req=req, slot=slot, n_blocks=need,
-                tokens_in_cache=len(req.prompt))
+                tokens_in_cache=prefix_tokens, prefilled=prefix_tokens,
+                shared_ids=list(shared_ids))
             inc_counter("serving/admissions", 1)
-            admitted.append((slot, req, need))
+            inc_counter("serving/prefix_hit_tokens", prefix_tokens)
+            inc_counter("serving/prefix_miss_tokens",
+                        len(prompt) - prefix_tokens)
+            admitted.append(Admission(slot=slot, req=req,
+                                      shared_ids=list(shared_ids),
+                                      n_blocks=need))
         return admitted
 
-    # -- decode-step accounting -------------------------------------
+    # -- step planning ----------------------------------------------
+    def _take_block(self) -> None:
+        self.free_blocks -= 1
+        if self.free_blocks < 0:
+            raise RuntimeError(
+                f"paged pool underflow: decode growth would need a block "
+                f"with 0 free — the admission watermark "
+                f"({self.watermark}) is undersized for this workload")
+
+    def plan_step(self) -> List[Work]:
+        """Split this step's ``chunk_tokens`` budget over the running
+        slots: decode steps first (one token per decode-ready slot —
+        guaranteed to fit, chunk_tokens >= max_slots), then prompt
+        chunks FIFO in slot order with whatever budget remains. Advances
+        the host mirror (prefilled / tokens_in_cache / decode block
+        growth) — callers run every returned Work item this step.
+
+        Note: chunk writes land in pages assigned at admission and a
+        shared prefix is whole blocks (suffixes start page-aligned), so
+        neither growth nor copy-on-write can trigger for chunks — only
+        decode steps take pool blocks here."""
+        budget = self.chunk_tokens
+        work: List[Work] = []
+        for slot in sorted(self.running):
+            st = self.running[slot]
+            if st.prefilled >= len(st.req.prompt) and budget >= 1:
+                pos = st.tokens_in_cache
+                if (pos // self.block_size >= st.n_blocks
+                        and st.n_blocks < self.max_blocks_per_seq):
+                    st.n_blocks += 1
+                    self._take_block()
+                work.append(Work(slot=slot, kind="decode", start=pos, n=1))
+                st.tokens_in_cache = pos + 1
+                budget -= 1
+        for slot in sorted(self.running):
+            st = self.running[slot]
+            rem = len(st.req.prompt) - st.prefilled
+            if rem > 0 and budget > 0:
+                n = min(rem, budget)
+                work.append(Work(slot=slot, kind="chunk",
+                                 start=st.prefilled, n=n,
+                                 completes_prompt=(n == rem)))
+                st.prefilled += n
+                st.tokens_in_cache += n
+                budget -= n
+        return work
+
+    # -- legacy decode accounting (PR-3 API, kept for external callers)
     def grow_for_decode(self) -> int:
-        """Account one token appended to every running slot (the engine's
-        decode step does exactly that): slots whose new position opens a
-        fresh page take a block from the pool. Returns the number of
-        blocks taken; raises if the pool underflows — that is a watermark
-        sizing bug, and corrupting block 0 on device would be worse."""
+        """Account one token appended to every running slot: slots whose
+        new position opens a fresh page take a block from the pool.
+        Returns the number of blocks taken; raises on pool underflow.
+        The unified engine uses ``plan_step`` (which does this per
+        decode-ready slot); this whole-batch form remains for the PR-3
+        decode loop shape."""
         grown = 0
         for st in self.running.values():
             pos = st.tokens_in_cache
@@ -157,10 +316,26 @@ class Scheduler:
                 f"for this workload")
         return grown
 
-    def release(self, slot: int) -> None:
-        """Finished sequence: return its blocks, free its slot."""
+    # -- release -----------------------------------------------------
+    def release(self, slot: int, newly_indexed: Iterable[int] = ()) -> None:
+        """Finished sequence: return its slot, and return to the pool
+        every block whose refcount reaches 0 — fresh blocks not handed
+        to the prefix index (``newly_indexed``, which keep the index's
+        refcount), plus shared prefix blocks nobody else references."""
         st = self.running.pop(slot)
-        self.free_blocks += st.n_blocks
+        newly = {int(b) for b in newly_indexed}
+        freed = 0
+        for b in st.shared_ids:
+            cnt = self._shared_in_use.get(b, 1) - 1
+            if cnt > 0:
+                self._shared_in_use[b] = cnt
+            else:
+                self._shared_in_use.pop(b, None)
+                if not (self.index is not None and self.index.holds(b)):
+                    freed += 1
+        fresh = st.n_blocks - len(st.shared_ids)
+        freed += fresh - len(newly - set(st.shared_ids))
+        self.free_blocks += freed
         self._free_slots.append(slot)
         self._free_slots.sort()
         inc_counter("serving/evictions", 1)

@@ -149,22 +149,33 @@ def optim_block_rows(n_tiles: int) -> int:
 
 
 def paged_decode_config(n_slots: int, max_blocks: int, block_size: int,
-                        group: int, d: int, dtype) -> dict:
-    """Resolved paged-decode config for one shape class:
-    ``{"block_rows", "kv_fetch", "backend"}``. Cache entry wins field-wise
-    where present (clamped to legal values); the cost model fills the
-    rest. Env overrides (APEX_TPU_PAGED_BLOCK_ROWS /
-    APEX_TPU_PAGED_KV_FETCH) are applied by ops/paged_attention.py BEFORE
+                        group: int, d: int, dtype,
+                        total_q: int | None = None) -> dict:
+    """Resolved config for one ragged paged-attention shape class:
+    ``{"block_rows", "kv_fetch", "q_tile", "backend"}``. Cache entry wins
+    field-wise where present (clamped to legal values); the cost model
+    fills the rest — including the group-aware oracle-fallback backend
+    rule (cost_model.paged_backend_default). Env overrides
+    (APEX_TPU_PAGED_BLOCK_ROWS / APEX_TPU_PAGED_KV_FETCH /
+    APEX_TPU_PAGED_Q_TILE) are applied by ops/paged_attention.py BEFORE
     consulting this — the standard env > cache > model order."""
     rows_d = cost_model.paged_block_rows_default(group)
     fetch_d = cost_model.paged_kv_fetch_default(
         block_size, d, {"bf16": 2, "f16": 2}.get(dtype_token(dtype), 4))
-    cfg = {"block_rows": rows_d, "kv_fetch": fetch_d, "backend": "pallas"}
+    cfg = {
+        "block_rows": rows_d,
+        "kv_fetch": fetch_d,
+        "q_tile": cost_model.paged_q_tile_default(group),
+        "backend": cost_model.paged_backend_default(
+            n_slots, max_blocks, block_size, group),
+    }
     entry = lookup(paged_key(n_slots, max_blocks, block_size, group, d,
-                             dtype))
+                             dtype, total_q=total_q))
     if entry:
         cfg["block_rows"] = _clamp_rows(entry.get("block_rows"), rows_d,
                                         quantum=8, lo=8, hi=512)
+        cfg["q_tile"] = _clamp_rows(entry.get("q_tile"), cfg["q_tile"],
+                                    quantum=8, lo=8, hi=512)
         try:
             f = int(entry.get("kv_fetch"))
             if 1 <= f <= max(1, max_blocks):

@@ -49,6 +49,9 @@ _SWEEP_ENV = (
     "APEX_TPU_MOE_TILE_T",
     "APEX_TPU_MOE_TILE_F",
     "APEX_TPU_OPTIM_BLOCK_ROWS",
+    "APEX_TPU_PAGED_BLOCK_ROWS",
+    "APEX_TPU_PAGED_KV_FETCH",
+    "APEX_TPU_PAGED_Q_TILE",
     "APEX_TPU_SOFTMAX_CHUNK",
     "APEX_TPU_USE_PALLAS",
 )
@@ -408,92 +411,120 @@ def sweep_optim(db: cache.TuneDB, *, hardware: bool, reps: int,
 
 def sweep_paged(db: cache.TuneDB, *, hardware: bool, reps: int,
                 log=print) -> None:
-    """(block_rows, kv_fetch) sweep for the ragged paged-attention decode
-    kernel (ops/paged_attention.py, registry family ``paged_decode``).
+    """(block_rows, kv_fetch, q_tile) sweep for the ragged multi-query
+    paged-attention kernel (ops/paged_attention.py, registry family
+    ``paged_decode``), run over a MIXED ragged layout (prefill chunks +
+    decode steps + an idle slot) so every candidate is exercised on the
+    shape the unified serving step actually dispatches.
 
-    Hardware sessions time the kernel per (slots, kv span, page size,
-    group, d) class — median of ``reps`` decode calls per candidate,
+    Hardware sessions time the kernel per (slots, packed rows, kv span,
+    page size, group, d) class — median of ``reps`` calls per candidate,
     winner recorded with milliseconds. Interpret sessions VERIFY each
-    candidate against the gather oracle and record the cost-model
-    default (projections lack the resolution to overturn the measured
-    rule — same policy as the flash sweep)."""
+    candidate against the generalized gather oracle and record the
+    cost-model defaults (projections lack the resolution to overturn
+    the measured rule — same policy as the flash sweep)."""
     import jax
     import jax.numpy as jnp
 
     from apex_tpu.ops.paged_attention import (
-        _decode_pallas,
-        paged_attention_ref,
+        _ragged_pallas,
+        ragged_paged_attention_ref,
     )
 
     space = registry.TUNABLES["paged_decode"].params
     ladder = (
-        # (slots, hq, hkv, d, block_size, max_blocks)
-        (8, 8, 8, 128, 16, 64),      # dense MHA decode, 1k context
-        (8, 8, 2, 128, 16, 64),      # GQA group 4
-    ) if hardware else ((4, 4, 2, 64, 8, 4),)
-    for slots, hq, hkv, d, bs, maxb in ladder:
+        # (slots, hq, hkv, d, block_size, max_blocks, total_q)
+        (8, 8, 8, 128, 16, 64, 8),       # dense MHA pure decode
+        (8, 8, 2, 128, 16, 64, 8),       # GQA group 4 pure decode
+        (8, 8, 2, 128, 16, 64, 256),     # chunked prefill + decode mix
+    ) if hardware else ((4, 4, 2, 64, 8, 4, 20),)
+    for slots, hq, hkv, d, bs, maxb, total_q in ladder:
         nb = slots * maxb + 8
         group = hq // hkv
-        keys = jax.random.split(jax.random.PRNGKey(slots + d), 4)
+        keys = jax.random.split(jax.random.PRNGKey(slots + d + total_q), 4)
         k_pool = jax.random.normal(keys[0], (nb, bs, hkv, d), jnp.bfloat16)
         v_pool = jax.random.normal(keys[1], (nb, bs, hkv, d), jnp.bfloat16)
-        q = jax.random.normal(keys[2], (slots, hq, d), jnp.bfloat16)
+        q = jax.random.normal(keys[2], (total_q, hq, d), jnp.bfloat16)
         tables = jax.random.permutation(keys[3], nb)[: slots * maxb
                                                      ].reshape(slots, maxb)
-        lengths = jnp.full((slots,), bs * maxb - 3, jnp.int32)
-        ref = paged_attention_ref(q, k_pool, v_pool, tables, lengths)
+        # mixed layout in slot order: one big chunk takes the spare rows,
+        # one idle slot, the rest single-token decodes
+        span = bs * maxb
+        ql = [1] * slots
+        ql[1] = 0
+        ql[0] = total_q - sum(ql[1:])
+        qs, off = [], 0
+        for n in ql:
+            qs.append(off)
+            off += n
+        kl = [min(span - 3, max(n, span // 2 + i)) for i, n in enumerate(ql)]
+        kl[1] = 0
+        kl[0] = max(kl[0], ql[0])
+        qs = jnp.asarray(qs, jnp.int32)
+        qlj = jnp.asarray(ql, jnp.int32)
+        klj = jnp.asarray(kl, jnp.int32)
+        ref = ragged_paged_attention_ref(q, k_pool, v_pool, tables, qs,
+                                         qlj, klj)
         scale = 1.0 / (d ** 0.5)
         best = None
         for rows in space["block_rows"]:
             for fetch in space["kv_fetch"]:
                 if fetch > maxb:
                     continue
+                for q_tile in space["q_tile"]:
 
-                def f(q, kp, vp, t, le, rows=rows, fetch=fetch):
-                    return _decode_pallas(q, kp, vp, t, le, scale, rows,
-                                          fetch)
+                    def f(q, kp, vp, t, a, b, c, rows=rows, fetch=fetch,
+                          q_tile=q_tile):
+                        return _ragged_pallas(q, kp, vp, t, a, b, c,
+                                              scale, rows, fetch, q_tile)
 
-                try:
-                    fn = jax.jit(f)
-                    got = fn(q, k_pool, v_pool, tables, lengths)
-                    got.block_until_ready()
-                    err = float(jnp.max(jnp.abs(
-                        got.astype(jnp.float32) - ref.astype(jnp.float32))))
-                    if err > 5e-2:
-                        raise AssertionError(f"oracle mismatch {err}")
-                    times = []
-                    for _ in range(max(1, reps)):
-                        t0 = time.perf_counter()
-                        fn(q, k_pool, v_pool, tables,
-                           lengths).block_until_ready()
-                        times.append(time.perf_counter() - t0)
-                    ms = sorted(times)[len(times) // 2] * 1e3
-                except Exception as e:  # noqa: BLE001 — failing candidate
-                    log(f"autotune: paged_decode rows={rows} "
-                        f"fetch={fetch} failed: {type(e).__name__}: {e}")
-                    continue
-                if best is None or ms < best[2]:
-                    best = (rows, fetch, ms)
+                    try:
+                        fn = jax.jit(f)
+                        got = fn(q, k_pool, v_pool, tables, qs, qlj, klj)
+                        got.block_until_ready()
+                        err = float(jnp.max(jnp.abs(
+                            got.astype(jnp.float32)
+                            - ref.astype(jnp.float32))))
+                        if err > 5e-2:
+                            raise AssertionError(f"oracle mismatch {err}")
+                        times = []
+                        for _ in range(max(1, reps)):
+                            t0 = time.perf_counter()
+                            fn(q, k_pool, v_pool, tables, qs, qlj,
+                               klj).block_until_ready()
+                            times.append(time.perf_counter() - t0)
+                        ms = sorted(times)[len(times) // 2] * 1e3
+                    except Exception as e:  # noqa: BLE001 — failing cand.
+                        log(f"autotune: paged_decode rows={rows} "
+                            f"fetch={fetch} q_tile={q_tile} failed: "
+                            f"{type(e).__name__}: {e}")
+                        continue
+                    if best is None or ms < best[3]:
+                        best = (rows, fetch, q_tile, ms)
         if best is None:
             continue
         if hardware:
-            entry = {"block_rows": best[0], "kv_fetch": best[1]}
+            entry = {"block_rows": best[0], "kv_fetch": best[1],
+                     "q_tile": best[2]}
         else:  # verified, but keep the measured-rule defaults
             entry = {
                 "block_rows": cost_model.paged_block_rows_default(group),
                 "kv_fetch": cost_model.paged_kv_fetch_default(bs, d),
+                "q_tile": cost_model.paged_q_tile_default(group),
             }
         registry.validate_entry("paged_decode", entry)
         key = shape_class.paged_key(slots, maxb, bs, group, d,
-                                    jnp.bfloat16)
+                                    jnp.bfloat16, total_q=total_q)
         db.record(key, entry,
                   source="hardware" if hardware else "interpret+cost_model",
-                  ms=best[2] if hardware else None,
+                  ms=best[3] if hardware else None,
                   note=f"swept {len(space['block_rows'])}x"
-                       f"{len(space['kv_fetch'])} candidates")
-        log(f"autotune: paged_decode slots={slots} g={group} d={d} -> "
-            f"rows={entry['block_rows']} fetch={entry['kv_fetch']}"
-            + (f" ({best[2]:.3f} ms)" if hardware else " (verified)"))
+                       f"{len(space['kv_fetch'])}x"
+                       f"{len(space['q_tile'])} candidates")
+        log(f"autotune: paged_decode slots={slots} g={group} d={d} "
+            f"tq={total_q} -> rows={entry['block_rows']} "
+            f"fetch={entry['kv_fetch']} q_tile={entry['q_tile']}"
+            + (f" ({best[3]:.3f} ms)" if hardware else " (verified)"))
 
 
 def sweep_moe(db: cache.TuneDB, *, hardware: bool, reps: int,

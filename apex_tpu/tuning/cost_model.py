@@ -293,6 +293,38 @@ def paged_block_rows_default(group: int) -> int:
     return max(8, min(32, -(-int(group) // 8) * 8))
 
 
+def paged_q_tile_default(group: int) -> int:
+    """Query tokens per work item of the ragged multi-query kernel. The
+    q tile is ``q_tile x group`` rows, so the knob trades MXU occupancy
+    (taller score tiles amortize the per-page dot setup for prefill
+    chunks) against dead rows on decode-heavy mixes (a decode run is one
+    token — everything past row ``group`` is masked). 16 tokens keeps
+    dense/small-group tiles at the measured flash sweet spot; GQA groups
+    >= 4 already fill the sublanes per token, so they drop to 8. Larger
+    is autotune's to prove on chunk-heavy workloads."""
+    return 8 if int(group) >= 4 else 16
+
+
+# Oracle-fallback threshold for the paged family: below this much work
+# the unfused gather oracle beats the ragged grid's per-step overhead.
+# Work proxy = slots x paged KV span x GQA group — the group FOLDS IN
+# because the oracle's score tensor ([S, Hkv, group, T]) and the
+# kernel's useful MXU rows both scale with it, so a grouped class
+# amortizes the grid sooner than a dense one with the same span. A
+# pinned cache entry ({"backend": ...}) overrides per class;
+# APEX_TPU_USE_PALLAS=1 beats both (env > cache > model, as everywhere).
+PAGED_FALLBACK_WORK = 4096
+
+
+def paged_backend_default(n_slots: int, max_blocks: int, block_size: int,
+                          group: int) -> str:
+    """"pallas" or "jnp" — the documented oracle-fallback rule for the
+    ragged paged family (see PAGED_FALLBACK_WORK)."""
+    span = max(1, int(max_blocks)) * int(block_size)
+    work = int(n_slots) * span * max(1, int(group))
+    return "jnp" if work < PAGED_FALLBACK_WORK else "pallas"
+
+
 def paged_kv_fetch_default(block_size: int, d: int,
                            dtype_bytes: int = 2) -> int:
     """Pages pulled per grid step. More pages per step amortize the

@@ -84,6 +84,9 @@ def _paged_check(params: dict, features: dict) -> Optional[str]:
     err = _mult("block_rows", 8)(params, features)
     if err:
         return err
+    err = _mult("q_tile", 8)(params, features)
+    if err:
+        return err
     f = params.get("kv_fetch")
     if f is not None and f < 1:
         return f"kv_fetch={f} must be >= 1"
@@ -155,19 +158,24 @@ TUNABLES: Dict[str, Tunable] = {
             params={
                 "block_rows": [8, 16, 32],
                 "kv_fetch": [1, 2, 4, 8],
+                "q_tile": [8, 16, 32, 64],
                 "backend": ["pallas", "jnp"],
             },
             check=_paged_check,
-            doc="Ragged paged-attention decode kernel "
-                "(ops/paged_attention.py): block_rows = sublane padding of "
-                "the per-(slot, kv-head) query-group tile; kv_fetch = KV "
-                "pages pulled per grid step (staggered index maps pipeline "
-                "the page DMAs). Class carries slots, total paged KV span, "
-                "page size, GQA group, head dim and dtype.",
+            doc="Ragged multi-query paged-attention kernel "
+                "(ops/paged_attention.py — prefill chunks + decode in one "
+                "program): block_rows = sublane floor of the per-(work "
+                "item, kv-head) q tile; q_tile = query tokens per work "
+                "item (the tile is q_tile x GQA group rows); kv_fetch = "
+                "KV pages pulled per grid step (staggered index maps "
+                "pipeline the page DMAs). Class carries slots, packed "
+                "query rows, total paged KV span, page size, GQA group, "
+                "head dim and dtype.",
             defaults_from="cost_model.paged_block_rows_default / "
-                          "paged_kv_fetch_default",
+                          "paged_kv_fetch_default / paged_q_tile_default",
             env={"block_rows": "APEX_TPU_PAGED_BLOCK_ROWS",
                  "kv_fetch": "APEX_TPU_PAGED_KV_FETCH",
+                 "q_tile": "APEX_TPU_PAGED_Q_TILE",
                  "backend": "APEX_TPU_USE_PALLAS"},
         ),
         Tunable(

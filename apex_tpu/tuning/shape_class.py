@@ -163,13 +163,18 @@ def overlap_key(rows_local: int, n_ranks: int, dtype, device=None) -> str:
 
 
 def paged_features(n_slots: int, max_blocks: int, block_size: int,
-                   group: int, d: int, dtype) -> dict:
-    """Ragged paged-attention decode (ops/paged_attention.py): the optimum
-    moves with the decode batch width (slots), the paged KV span a slot
-    can reach (max_blocks * block_size — what the fetch loop walks), the
-    page size (DMA granule), the GQA group (q tile rows) and head dim."""
+                   group: int, d: int, dtype,
+                   total_q: int | None = None) -> dict:
+    """Ragged multi-query paged attention (ops/paged_attention.py): the
+    optimum moves with the batch width (slots), the packed query rows
+    (total_q — what separates decode-only calls from chunked-prefill
+    mixes; defaults to one query per slot, the decode entry's shape),
+    the paged KV span a slot can reach (max_blocks * block_size — what
+    the fetch loop walks), the page size (DMA granule), the GQA group
+    (q tile rows per token) and head dim."""
     return {
         "slots": pow2_bucket(n_slots, floor=8),
+        "tq": pow2_bucket(total_q if total_q else n_slots, floor=8),
         "kv": seq_bucket(max_blocks * block_size),
         "bs": int(block_size),
         "g": int(group),
@@ -179,10 +184,11 @@ def paged_features(n_slots: int, max_blocks: int, block_size: int,
 
 
 def paged_key(n_slots: int, max_blocks: int, block_size: int, group: int,
-              d: int, dtype, device=None) -> str:
+              d: int, dtype, device=None, total_q: int | None = None) -> str:
     return class_key(
         "paged_decode",
-        paged_features(n_slots, max_blocks, block_size, group, d, dtype),
+        paged_features(n_slots, max_blocks, block_size, group, d, dtype,
+                       total_q),
         device,
     )
 

@@ -74,6 +74,15 @@ run overlap_ab    5400 '"ok": true' env \
 #      16-request mix (GPT-medium-class geometry, metric
 #      apex_tpu_serving_decode_steps_per_sec).
 run serving_bench 3600 '"ok": true' python bench.py --serving
+# 4c' — prefix-cache leg (prefix-caching + chunked-prefill PR): the same
+#      request set served cold then warm through one engine — greedy
+#      output token-identical both runs and to the unpaged reference,
+#      warm run hitting the prefix index, refcount accounting clean,
+#      ONE unified-step compile. (The timed warm-vs-cold TTFT A/B rides
+#      the serving_bench item above as metric
+#      apex_tpu_serving_ttft_warm_vs_cold.)
+run prefix_cache  1800 'prefix leg: OK' \
+                       python -c 'import __graft_entry__ as g; g.dryrun_prefix()'
 # 4d — MoE dispatch A/B rung (dropless-MoE PR): tokens/s of the einsum
 #      [t,E,C] dispatch vs the sort-based grouped-matmul path (capacity
 #      parity mode AND dropless) at the fixed GPT-medium-class sweep

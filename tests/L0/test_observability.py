@@ -425,19 +425,21 @@ def _tiny_engine(**scfg_kw):
 def test_serving_run_emits_records_without_extra_compiles(
         enabled_registry, monkeypatch):
     """The acceptance pin: with histograms enabled, the 16-request
-    staggered workload still compiles exactly twice AND lands the full
-    serving series set — TTFT/TPOT histograms, occupancy/queue gauges,
-    admission/eviction counters."""
+    staggered workload still compiles the unified step exactly once AND
+    lands the full serving series set — TTFT/TPOT/chunk-utilization
+    histograms, occupancy/queue gauges, admission/eviction +
+    prefix-hit/miss counters. (prefix_cache off here so the end-of-run
+    pool drains to empty — the all-freed economy this test pins.)"""
     monkeypatch.setenv("APEX_TPU_USE_PALLAS", "0")
     from apex_tpu.serving import Request
 
-    eng, cfg = _tiny_engine()
+    eng, cfg = _tiny_engine(prefix_cache=False)
     reqs = [Request(rid=i, prompt=[1 + i, 2, 3], max_new_tokens=3,
                     arrival=i // 4)
             for i in range(16)]
     out = eng.run(reqs)
     stats = out.pop(None)
-    assert stats["trace_counts"] == {"prefill": 1, "decode": 1}
+    assert stats["trace_counts"]["step"] == 1, stats["trace_counts"]
 
     reg = enabled_registry
     ttft = reg.histogram("serving/ttft_s")
@@ -450,6 +452,12 @@ def test_serving_run_emits_records_without_extra_compiles(
     assert reg.counter("serving/admissions").value() == len(reqs)
     assert reg.counter("serving/evictions").value() == len(reqs)
     assert reg.counter("serving/preemptions").value() == 0
+    assert reg.counter("serving/prefix_hit_tokens").value() == 0
+    assert reg.counter("serving/prefix_miss_tokens").value() == \
+        sum(len(r.prompt) for r in reqs)
+    util = reg.histogram("serving/chunk_utilization")
+    assert 0 < util.count() <= stats["steps"]     # one per worked step
+    assert util.sum() <= util.count()             # fractions of budget
     assert reg.gauge("serving/kv_blocks_total").value() == 32
     assert reg.gauge("serving/kv_occupancy").value() == 0.0  # all freed
     assert reg.gauge("serving/kv_blocks_free_min").value() is not None
@@ -512,21 +520,23 @@ def test_train_step_hlo_identical_metrics_on_off(monkeypatch):
     default_registry().reset()
 
 
-def test_serving_decode_hlo_identical_metrics_on_off(monkeypatch):
+def test_serving_step_hlo_identical_metrics_on_off(monkeypatch):
     monkeypatch.setenv("APEX_TPU_USE_PALLAS", "0")
 
-    def decode_text(sink):
+    def step_text(sink):
         if sink is None:
             monkeypatch.delenv("APEX_TPU_METRICS_SINK", raising=False)
         else:
             monkeypatch.setenv("APEX_TPU_METRICS_SINK", sink)
         eng, _ = _tiny_engine()
         cache = eng.fresh_cache()
-        return eng._decode.lower(
-            eng.params, cache, jnp.zeros((2,), jnp.int32),
-            jnp.zeros((2,), bool)).as_text()
+        tq = eng.scfg.chunk_tokens
+        return eng._step.lower(
+            eng.params, cache, jnp.zeros((tq,), jnp.int32),
+            jnp.zeros((2,), jnp.int32), jnp.zeros((2,), jnp.int32)
+        ).as_text()
 
-    assert decode_text(None) == decode_text("memory")
+    assert step_text(None) == step_text("memory")
     default_registry().reset()
 
 

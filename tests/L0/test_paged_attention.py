@@ -16,14 +16,20 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from apex_tpu.ops.paged_attention import paged_attention, paged_attention_ref
+from apex_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_attention_ref,
+    ragged_paged_attention,
+    ragged_paged_attention_ref,
+)
 from apex_tpu.tuning import cache, registry, shape_class
 
 
 @pytest.fixture(autouse=True)
 def _clean_paged_env(monkeypatch, tmp_path):
     for var in ("APEX_TPU_PAGED_BLOCK_ROWS", "APEX_TPU_PAGED_KV_FETCH",
-                "APEX_TPU_USE_PALLAS", "APEX_TPU_TUNE"):
+                "APEX_TPU_PAGED_Q_TILE", "APEX_TPU_USE_PALLAS",
+                "APEX_TPU_TUNE"):
         monkeypatch.delenv(var, raising=False)
     monkeypatch.setenv("APEX_TPU_TUNEDB", str(tmp_path / "tunedb.json"))
     cache.invalidate()
@@ -222,6 +228,170 @@ def test_cost_model_defaults_legal():
         for d in (64, 128, 256):
             f = cost_model.paged_kv_fetch_default(bs, d)
             registry.validate_entry("paged_decode", {"kv_fetch": f})
+
+
+# ---------------------------------------------------------------------------
+# ragged multi-query layouts (the unified prefill-chunk + decode shape)
+# ---------------------------------------------------------------------------
+
+def _ragged_setup(slots, hq, hkv, d, nb, bs, maxb, qs, ql, kl, dtype,
+                  seed=0, tq=None):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    k_pool = jax.random.normal(ks[0], (nb, bs, hkv, d), dtype)
+    v_pool = jax.random.normal(ks[1], (nb, bs, hkv, d), dtype)
+    tables = jax.random.permutation(ks[3], nb)[: slots * maxb].reshape(
+        slots, maxb).astype(jnp.int32)
+    if tq is None:
+        tq = int(sum(ql))
+    q = jax.random.normal(ks[2], (tq, hq, d), dtype)
+    return (q, k_pool, v_pool, tables, jnp.asarray(qs, jnp.int32),
+            jnp.asarray(ql, jnp.int32), jnp.asarray(kl, jnp.int32))
+
+
+@pytest.mark.parametrize("case,qs,ql,kl", [
+    # the satellite's edge grid (4 slots, bs=8, maxb=4 -> span 32):
+    ("all_empty", [0, 0, 0, 0], [0, 0, 0, 0], [0, 0, 0, 0]),
+    ("chunk_crosses_block", [0, 11, 12, 12], [11, 1, 0, 3],
+     [19, 30, 0, 11]),                     # 11-token chunk spans pages
+    ("pure_prefill", [0, 17, 17, 39], [17, 0, 22, 1],
+     [17, 0, 22, 32]),                     # kv_len == query_len
+    ("decode_long_ctx", [0, 1, 2, 3], [1, 1, 1, 1],
+     [32, 31, 9, 1]),                      # kv_len >> query_len
+    ("mixed_unaligned", [0, 13, 14, 14], [13, 1, 0, 9],
+     [20, 31, 0, 9]),                      # total 23: not sublane-aligned
+])
+def test_ragged_layouts_vs_oracle(case, qs, ql, kl):
+    args = _ragged_setup(slots=4, hq=4, hkv=2, d=64, nb=24, bs=8, maxb=4,
+                         qs=qs, ql=ql, kl=kl, dtype=jnp.float32,
+                         seed=sum(kl) + 1, tq=max(int(sum(ql)), 4))
+    got = ragged_paged_attention(*args, use_pallas=True)
+    ref = ragged_paged_attention_ref(*args)
+    assert _maxdiff(got, ref) < _TOL[jnp.float32], case
+    # rows outside every run (including an all-idle batch) are exactly 0
+    covered = np.zeros(args[0].shape[0], bool)
+    for s, n in zip(qs, ql):
+        covered[s:s + n] = True
+    dead = np.flatnonzero(~covered)
+    if dead.size:
+        assert float(jnp.max(jnp.abs(
+            got[jnp.asarray(dead)].astype(jnp.float32)))) == 0.0
+
+
+def test_ragged_decode_entry_equivalence():
+    """The decode wrapper IS the ragged kernel at query_len == 1: both
+    entries agree bitwise on the same cache."""
+    lens = [24, 1, 0, 17]
+    args = _ragged_setup(slots=4, hq=4, hkv=2, d=64, nb=24, bs=8, maxb=4,
+                         qs=[0, 1, 2, 3], ql=[1, 1, 0, 1], kl=lens,
+                         dtype=jnp.float32, seed=2, tq=4)
+    q, kp, vp, tbl = args[:4]
+    via_decode = paged_attention(q, kp, vp, tbl,
+                                 jnp.asarray(lens, jnp.int32),
+                                 use_pallas=True)
+    via_ragged = ragged_paged_attention(*args, use_pallas=True)
+    assert _maxdiff(via_decode, via_ragged) == 0.0
+
+
+def test_ragged_chunk_matches_flash_rows():
+    """Cross-oracle: a prefill chunk over a contiguous cache equals the
+    corresponding rows of causal flash attention."""
+    from apex_tpu.ops.attention import attention_reference
+
+    b_s, hq, d, t = 8, 4, 64, 24
+    k = jax.random.normal(jax.random.PRNGKey(0), (1, hq, t, d))
+    v = jax.random.normal(jax.random.PRNGKey(1), (1, hq, t, d))
+    q = jax.random.normal(jax.random.PRNGKey(2), (1, hq, t, d))
+    full = attention_reference(q, k, v, causal=True)[0]      # [hq, t, d]
+
+    maxb = -(-t // b_s)
+    pad = maxb * b_s - t
+    k_pool = jnp.pad(k[0].transpose(1, 0, 2), ((0, pad), (0, 0), (0, 0))
+                     ).reshape(maxb, b_s, hq, d)
+    v_pool = jnp.pad(v[0].transpose(1, 0, 2), ((0, pad), (0, 0), (0, 0))
+                     ).reshape(maxb, b_s, hq, d)
+    # the last 9 positions as one chunk (kv = all 24, query run = 9)
+    run = 9
+    got = ragged_paged_attention(
+        q[0, :, t - run:].transpose(1, 0, 2), k_pool, v_pool,
+        jnp.arange(maxb, dtype=jnp.int32)[None],
+        jnp.array([0], jnp.int32), jnp.array([run], jnp.int32),
+        jnp.array([t], jnp.int32), use_pallas=True)
+    ref_rows = full[:, t - run:].transpose(1, 0, 2)          # [run, hq, d]
+    assert _maxdiff(got, ref_rows) < 1e-4
+
+
+@pytest.mark.parametrize("case", range(8))
+def test_fuzz_ragged_layouts_and_config_space(case):
+    """Seeded fuzz over (query_start, query_len, kv_len) layouts AND the
+    full paged_decode tunable space (block_rows x kv_fetch x q_tile),
+    pinned through the tune cache exactly as the autotuner writes them
+    — the satellite's interpret-mode grid."""
+    rng = random.Random(7000 + case)
+    space = registry.TUNABLES["paged_decode"].params
+    slots = rng.choice([1, 3, 4])
+    hkv = rng.choice([1, 2])
+    group = rng.choice([1, 2, 4])
+    d = rng.choice([32, 64])
+    bs = rng.choice([4, 8])
+    maxb = rng.choice([2, 4])
+    span = bs * maxb
+    qs, ql, kl = [], [], []
+    off = 0
+    for _ in range(slots):
+        n = rng.choice([0, 1, rng.randint(0, span)])
+        k_len = 0 if n == 0 else rng.randint(n, span)
+        qs.append(off)
+        ql.append(n)
+        kl.append(k_len)
+        off += n
+    dtype = rng.choice([jnp.float32, jnp.bfloat16])
+    args = _ragged_setup(slots, group * hkv, hkv, d,
+                         max(slots * maxb, 8), bs, maxb, qs, ql, kl,
+                         dtype, seed=case, tq=max(off, 1))
+    entry = {"block_rows": rng.choice(space["block_rows"]),
+             "kv_fetch": rng.choice(space["kv_fetch"]),
+             "q_tile": rng.choice(space["q_tile"])}
+    registry.validate_entry("paged_decode", entry)
+    db = cache.TuneDB()
+    db.record(shape_class.paged_key(slots, maxb, bs, group, d, dtype,
+                                    total_q=max(off, 1)),
+              entry, source="fuzz")
+    with cache.pinned(db):
+        got = ragged_paged_attention(*args, use_pallas=True)
+    ref = ragged_paged_attention_ref(*args)
+    assert _maxdiff(got, ref) < _TOL[dtype], (case, qs, ql, kl, entry)
+
+
+def test_q_tile_resolution_order(monkeypatch):
+    """env > tune cache > cost model for the new q_tile knob (the same
+    pin as block_rows/kv_fetch)."""
+    from apex_tpu.ops import paged_attention as mod
+    from apex_tpu.tuning import cost_model
+
+    db = cache.TuneDB()
+    db.record(shape_class.paged_key(2, 2, 8, 2, 64, jnp.float32),
+              {"q_tile": 64}, source="test")
+    with cache.pinned(db):
+        monkeypatch.setenv("APEX_TPU_PAGED_Q_TILE", "8")
+        assert mod._paged_params(2, 2, 8, 2, 64,
+                                 jnp.float32)["q_tile"] == 8   # env
+        monkeypatch.delenv("APEX_TPU_PAGED_Q_TILE")
+        assert mod._paged_params(2, 2, 8, 2, 64,
+                                 jnp.float32)["q_tile"] == 64  # cache
+    with cache.pinned(cache.TuneDB()):
+        assert mod._paged_params(2, 2, 8, 2, 64, jnp.float32)["q_tile"] \
+            == cost_model.paged_q_tile_default(2)              # model
+
+
+def test_ragged_shape_validation_errors():
+    q = jnp.zeros((6, 4, 16))
+    k_pool = jnp.zeros((4, 8, 2, 16))
+    tbl = jnp.zeros((2, 2), jnp.int32)
+    v = jnp.zeros((2,), jnp.int32)
+    with pytest.raises(ValueError, match="total_q"):
+        ragged_paged_attention(q[0], k_pool, k_pool, tbl, v, v, v)
+    with pytest.raises(ValueError, match="query_len"):
+        ragged_paged_attention(q, k_pool, k_pool, tbl, v, v[:1], v)
 
 
 def test_interpret_mode_on_cpu():

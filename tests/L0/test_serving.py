@@ -1,10 +1,10 @@
-"""Serving subsystem: paged-cache invariants, continuous batching, greedy
-decode parity.
+"""Serving subsystem: paged-cache refcount invariants, prefix sharing,
+chunked-prefill continuous batching, unified-step greedy parity.
 
 Tier-1 hygiene: runs on the hermetic CPU mesh (tests/conftest.py pins
-JAX_PLATFORMS=cpu) with the paged-decode Pallas kernel in interpret mode,
-mirroring test_tuning_fuzz.py — no TPU anywhere. The heavyweight engine
-is built ONCE per module (the prefill/decode programs compile a single
+JAX_PLATFORMS=cpu) with the ragged paged-attention kernel in interpret
+mode, mirroring test_tuning_fuzz.py — no TPU anywhere. The heavyweight
+engine is built ONCE per module (the unified step compiles a single
 time; the no-recompile test depends on exactly that).
 """
 
@@ -16,6 +16,7 @@ import numpy as np
 import pytest
 
 from apex_tpu.serving import (
+    PrefixIndex,
     Request,
     Scheduler,
     ServingConfig,
@@ -23,17 +24,20 @@ from apex_tpu.serving import (
     alloc_decode_blocks,
     allocate_slot,
     check_invariants,
+    cow_append,
     free_block_count,
     free_slot,
     greedy_reference,
     paged_kv_cache,
+    retain_blocks,
+    share_prefix,
     write_prefill,
 )
 from apex_tpu.testing import TransformerConfig, transformer_init
 
 
 # ---------------------------------------------------------------------------
-# kv cache invariants
+# kv cache invariants (refcount accounting)
 # ---------------------------------------------------------------------------
 
 def _small_cache():
@@ -55,6 +59,80 @@ def test_alloc_free_roundtrip_invariants():
     c = jax.jit(free_slot)(c, 0)          # idempotent on an empty slot
     check_invariants(c)
     assert int(free_block_count(c)) == 12 - 2
+
+
+def test_share_prefix_refcounts_and_free_decrements():
+    """The prefix-sharing contract: shared blocks are referenced twice,
+    freeing one sharer keeps them resident, freeing both releases."""
+    c = _small_cache()
+    c = allocate_slot(c, 0, 3)
+    ids = np.asarray(c.block_tables)[0]
+    shared = jnp.zeros((4,), jnp.int32).at[:2].set(
+        jnp.asarray(ids[:2], jnp.int32))
+    c = jax.jit(share_prefix)(c, 1, shared, 2, 3)
+    check_invariants(c)
+    rc = np.asarray(c.refcount)
+    assert rc[ids[0]] == 2 and rc[ids[1]] == 2
+    # the sharer starts with the prefix tokens already resident
+    assert int(c.seq_lens[1]) == 2 * 4 and int(c.n_blocks[1]) == 3
+    assert int(free_block_count(c)) == 12 - 4      # 3 + 1 fresh suffix
+    c = jax.jit(free_slot)(c, 0)
+    check_invariants(c)
+    rc = np.asarray(c.refcount)
+    assert rc[ids[0]] == 1 and rc[ids[1]] == 1     # still held by slot 1
+    assert rc[ids[2]] == 0                         # unshared: freed
+    c = jax.jit(free_slot)(c, 1)
+    check_invariants(c)
+    assert int(free_block_count(c)) == 12
+
+
+def test_cow_append_copies_shared_partial_block():
+    """A slot about to append into a PARTIALLY-filled shared page gets a
+    private copy (fresh block, contents cloned, refcount moved) — the
+    correctness lynchpin of partial-page sharing."""
+    c = _small_cache()
+    c = allocate_slot(c, 0, 2)
+    k = jnp.arange(2 * 8 * 2 * 8, dtype=jnp.float32).reshape(2, 8, 2, 8)
+    c = write_prefill(c, 0, k, -k, 6)
+    ids = np.asarray(c.block_tables)[0]
+    shared = jnp.zeros((4,), jnp.int32).at[:2].set(
+        jnp.asarray(ids[:2], jnp.int32))
+    c = share_prefix(c, 1, shared, 2, 2)
+    # slot 1 "inherits" only 6 of the 8 shared positions: its next write
+    # position lands inside shared block ids[1]
+    c = c._replace(seq_lens=c.seq_lens.at[1].set(6))
+    c2 = jax.jit(cow_append)(c, jnp.array([False, True, False]))
+    tbl1 = np.asarray(c2.block_tables)[1]
+    assert tbl1[1] != ids[1], "COW must repoint the shared partial page"
+    rc = np.asarray(c2.refcount)
+    assert rc[ids[1]] == 1 and rc[tbl1[1]] == 1
+    np.testing.assert_array_equal(np.asarray(c2.k_pool)[:, tbl1[1]],
+                                  np.asarray(c2.k_pool)[:, ids[1]])
+    check_invariants(c2)
+    # a full-page boundary (pos % bs == 0) must NOT copy
+    c3 = c._replace(seq_lens=c.seq_lens.at[1].set(8))
+    c4 = jax.jit(cow_append)(c3, jnp.array([False, True, False]))
+    assert np.asarray(c4.block_tables)[1][1] == ids[1]
+
+
+def test_check_invariants_catches_refcount_leak():
+    """Satellite pin: a refcount leak (block neither reachable nor free)
+    and an under-counted shared block both fail fast."""
+    c = _small_cache()
+    c = allocate_slot(c, 0, 2)
+    leaked = c._replace(refcount=c.refcount.at[7].set(1))  # unreachable
+    with pytest.raises(AssertionError, match="refcount leak"):
+        check_invariants(leaked)
+    ids = np.asarray(c.block_tables)[0]
+    dropped = c._replace(refcount=c.refcount.at[ids[0]].set(0))
+    with pytest.raises(AssertionError, match="refcount 0"):
+        check_invariants(dropped)
+    # index holds reconcile through index_refs
+    held = jax.jit(retain_blocks)(
+        c, jnp.zeros((4,), jnp.int32).at[0].set(7), 1)
+    with pytest.raises(AssertionError, match="refcount leak"):
+        check_invariants(held)
+    check_invariants(held, index_refs={7: 1})
 
 
 def test_decode_growth_allocates_on_page_boundary():
@@ -92,11 +170,11 @@ def test_prefill_write_masks_pad_rows():
     np.testing.assert_array_equal(pool[:, tbl[1], 1:], 0.0)
 
 
-def test_cache_fuzz_alloc_free_cycles():
+def test_cache_fuzz_alloc_share_free_cycles():
     rng = random.Random(7)
     c = paged_kv_cache(1, 16, 4, 1, 8, 4, 6, jnp.float32)
     held = {}
-    for _ in range(40):
+    for _ in range(60):
         s = rng.randrange(4)
         if s in held:
             if rng.random() < 0.3:
@@ -108,7 +186,16 @@ def test_cache_fuzz_alloc_free_cycles():
                     c, _, _ = alloc_decode_blocks(c, act)
         else:
             n = rng.randint(1, 3)
-            if int(free_block_count(c)) >= n:
+            donors = [d for d in held if held[d] >= 1]
+            if donors and rng.random() < 0.4:
+                # share the donor's first block + (n-1) fresh
+                d = rng.choice(donors)
+                if int(free_block_count(c)) >= n - 1:
+                    row = jnp.zeros((6,), jnp.int32).at[0].set(
+                        c.block_tables[d, 0])
+                    c = share_prefix(c, s, row, 1, n)
+                    held[s] = n
+            elif int(free_block_count(c)) >= n:
                 c = allocate_slot(c, s, n)
                 held[s] = n
         check_invariants(c)
@@ -127,13 +214,12 @@ def test_watermark_defers_admission_until_release():
     first = sched.admit()
     # each prompt needs 2 blocks; 8 - 2*2 = 4 >= watermark 2, but a third
     # would leave 8 - 6 = 2... slots cap at 2 anyway
-    assert [s for s, _, _ in first] == [0, 1]
+    assert [a.slot for a in first] == [0, 1]
     assert sched.free_blocks == 4
     assert sched.admit() == []              # no slot free
     sched.release(0)
     assert sched.free_blocks == 6
-    nxt = sched.admit()
-    assert [s for s, _, _ in nxt] == [0]
+    assert [a.slot for a in sched.admit()] == [0]
 
 
 def test_watermark_blocks_admission_on_low_pool():
@@ -144,7 +230,86 @@ def test_watermark_blocks_admission_on_low_pool():
     # 5 - 3 = 2 < watermark 3 -> deferred despite free slots
     assert sched.admit() == []
     sched.free_blocks = 6
-    assert [r.rid for _, r, _ in sched.admit()] == ["a"]
+    assert [a.req.rid for a in sched.admit()] == ["a"]
+
+
+def test_refcount_aware_admission_not_blocked_by_shared_blocks():
+    """Satellite pin: when most resident blocks are SHARED prefixes, a
+    prefix-hit request charges only its suffix — admission must not be
+    spuriously blocked by counting shared blocks against the pool."""
+    ix = PrefixIndex(block_size=4)
+    ix.insert(list(range(12)), [0, 1, 2])   # 3 cached full blocks
+    # pool of 6: 3 held by the index, 3 genuinely free, watermark 2
+    sched = Scheduler(max_slots=2, num_blocks=3, block_size=4,
+                      max_blocks_per_seq=8, watermark=2,
+                      prefix_index=ix)
+    # prompt = the cached 12 tokens + 2 new: 4 blocks total, 3 shared ->
+    # charges ONE fresh block; 3 - 1 = 2 >= watermark -> admitted.
+    # Naive (share-blind) accounting would need 4 and block.
+    sched.add(Request(rid="hit", prompt=list(range(12)) + [90, 91],
+                      max_new_tokens=2))
+    sched.tick(0)
+    adm = sched.admit()
+    assert [a.req.rid for a in adm] == ["hit"]
+    assert adm[0].shared_ids == [0, 1, 2]
+    assert sched.free_blocks == 2
+    st = sched.running[adm[0].slot]
+    assert st.prefilled == 12 and st.tokens_in_cache == 12
+
+
+def test_admission_caps_prefix_to_leave_one_token():
+    """A full-prompt cache hit must still recompute >= 1 token — its
+    logits emit the first generated token."""
+    ix = PrefixIndex(block_size=4)
+    ix.insert(list(range(8)), [0, 1])
+    sched = Scheduler(max_slots=1, num_blocks=8, block_size=4,
+                      max_blocks_per_seq=8, watermark=0, prefix_index=ix)
+    sched.add(Request(rid="full", prompt=list(range(8)), max_new_tokens=2))
+    sched.tick(0)
+    adm = sched.admit()
+    # (8 - 1) // 4 = 1 shared block, NOT both
+    assert adm[0].shared_ids == [0]
+    assert sched.running[adm[0].slot].prefilled == 4
+
+
+def test_prefix_eviction_makes_room_and_drains_releases():
+    """Pool pressure evicts least-recently-matched index entries; their
+    device refcount release is drained by the engine."""
+    ix = PrefixIndex(block_size=4)
+    ix.insert(list(range(8)), [0, 1])       # 2 cached blocks
+    sched = Scheduler(max_slots=1, num_blocks=1, block_size=4,
+                      max_blocks_per_seq=4, watermark=0, prefix_index=ix)
+    sched.add(Request(rid="cold", prompt=[99] * 8, max_new_tokens=1))
+    sched.tick(0)
+    adm = sched.admit()                     # needs 2 blocks, 1 free
+    assert [a.req.rid for a in adm] == ["cold"]
+    assert len(ix) < 2                      # had to evict
+    rel = sched.drain_releases()
+    assert rel and sched.drain_releases() == []
+
+
+def test_chunked_prefill_budget_split_and_decode_priority():
+    """plan_step packs decodes first, then prompt chunks FIFO under the
+    fixed budget; a long prompt spans several steps."""
+    sched = Scheduler(max_slots=2, num_blocks=32, block_size=4,
+                      max_blocks_per_seq=8, watermark=0, chunk_tokens=6)
+    sched.add(Request(rid="long", prompt=list(range(1, 11)),
+                      max_new_tokens=2))
+    sched.tick(0)
+    sched.admit()
+    w1 = sched.plan_step()
+    assert [(w.kind, w.start, w.n, w.completes_prompt) for w in w1] == [
+        ("chunk", 0, 6, False)]
+    w2 = sched.plan_step()
+    assert [(w.kind, w.start, w.n, w.completes_prompt) for w in w2] == [
+        ("chunk", 6, 4, True)]
+    # now decode-ready: decodes get budget before any new chunk
+    sched.add(Request(rid="late", prompt=[7] * 9, max_new_tokens=1))
+    sched.tick(0)
+    sched.admit()
+    w3 = sched.plan_step()
+    assert [(w.slot, w.kind, w.n) for w in w3] == [
+        (0, "decode", 1), (1, "chunk", 5)]
 
 
 def test_pool_underflow_raises():
@@ -153,8 +318,9 @@ def test_pool_underflow_raises():
     sched.add(Request(rid=0, prompt=[1], max_new_tokens=9))
     sched.tick(0)
     assert len(sched.admit()) == 1
+    sched.plan_step()                       # the 1-token prefill chunk
     with pytest.raises(RuntimeError, match="underflow"):
-        sched.grow_for_decode()             # 0 free, growth needed
+        sched.plan_step()                   # decode growth: 0 free
 
 
 def test_request_exceeding_lifetime_capacity_rejected_at_add():
@@ -169,15 +335,14 @@ def test_request_exceeding_lifetime_capacity_rejected_at_add():
 
 
 def test_engine_rejects_oversized_requests_at_intake():
-    """Bad requests fail loudly at run() intake, not as an opaque shape
-    error (prompt > max_prefill_len) or silent KV corruption
-    (prompt + max_new > max_seq_len) mid-batch."""
+    """Requests that cannot fit their lifetime fail loudly at run()
+    intake, not as silent KV corruption mid-batch. (Prompts longer than
+    the old padded-prefill shape are now simply CHUNKED — only the
+    max_seq_len cap remains.)"""
     params = transformer_init(jax.random.PRNGKey(0), _CFG)
     scfg = ServingConfig(model=_CFG, num_blocks=16, block_size=4,
                          max_slots=2, max_prefill_len=4, max_seq_len=8)
     eng = ServingEngine(scfg, params)
-    with pytest.raises(ValueError, match="max_prefill_len"):
-        eng.run([Request(rid=0, prompt=[1] * 6, max_new_tokens=1)])
     with pytest.raises(ValueError, match="max_seq_len"):
         eng.run([Request(rid=0, prompt=[1] * 3, max_new_tokens=12)])
 
@@ -195,17 +360,26 @@ def test_rope_max_seq_len_past_position_range_rejected():
                       params)
 
 
+def test_chunk_budget_must_cover_decode_round():
+    params = transformer_init(jax.random.PRNGKey(0), _CFG)
+    with pytest.raises(ValueError, match="chunk_tokens"):
+        ServingEngine(ServingConfig(model=_CFG, num_blocks=16,
+                                    block_size=4, max_slots=4,
+                                    max_seq_len=16, chunk_tokens=2),
+                      params)
+
+
 def test_arrival_staggering_gates_queue():
     sched = Scheduler(max_slots=4, num_blocks=64, block_size=4,
                       max_blocks_per_seq=8)
     sched.add(Request(rid="late", prompt=[1], arrival=5))
     sched.add(Request(rid="early", prompt=[1], arrival=0))
     sched.tick(0)
-    assert [r.rid for _, r, _ in sched.admit()] == ["early"]
+    assert [a.req.rid for a in sched.admit()] == ["early"]
     sched.tick(4)
     assert sched.admit() == []
     sched.tick(5)
-    assert [r.rid for _, r, _ in sched.admit()] == ["late"]
+    assert [a.req.rid for a in sched.admit()] == ["late"]
 
 
 # ---------------------------------------------------------------------------
@@ -236,11 +410,19 @@ def _workload(n=16, seed=0):
     ]
 
 
-def test_16_request_workload_compiles_at_most_twice_and_matches_oracle(
-        engine):
+def _check_engine_cache(eng, stats):
+    held = eng.index.held_ids() if eng.index is not None else {}
+    check_invariants(stats["cache"], index_refs=held)
+    # every non-cached block returned; host mirror exact
+    assert int(free_block_count(stats["cache"])) == stats["free_blocks"]
+    assert (int(free_block_count(stats["cache"])) + len(held)
+            == eng.scfg.num_blocks)
+
+
+def test_16_request_workload_compiles_once_and_matches_oracle(engine):
     """The acceptance pin: over a scripted 16-request workload with
-    staggered arrivals, the jitted steps trace at most twice total —
-    once for the prefill shape, once for the decode shape — and every
+    staggered arrivals, the UNIFIED step traces exactly once — one
+    fixed-shape program for every prefill-chunk/decode mix — and every
     request's greedy output is token-identical to the unpaged
     full-context reference loop on standalone_gpt."""
     eng, params = engine
@@ -248,15 +430,15 @@ def test_16_request_workload_compiles_at_most_twice_and_matches_oracle(
     out = eng.run(reqs)
     stats = out.pop(None)
 
-    assert stats["trace_counts"]["prefill"] == 1, stats["trace_counts"]
-    assert stats["trace_counts"]["decode"] == 1, stats["trace_counts"]
-    assert sum(stats["trace_counts"].values()) <= 2
+    assert stats["trace_counts"]["step"] == 1, stats["trace_counts"]
+    # the admission/indexing helpers are one-compile programs too
+    assert all(v <= 1 for v in stats["trace_counts"].values()), (
+        stats["trace_counts"])
 
-    # all blocks returned, accounting consistent
-    check_invariants(stats["cache"])
-    assert int(free_block_count(stats["cache"])) == eng.scfg.num_blocks
+    _check_engine_cache(eng, stats)
 
-    # staggered arrivals actually interleaved prefills into live decodes
+    # staggered arrivals actually interleaved chunk prefills into live
+    # decodes
     assert stats["prefills"] == 16
     assert stats["decode_steps"] < sum(r.max_new_tokens for r in reqs)
 
@@ -281,9 +463,71 @@ def test_reused_engine_still_does_not_retrace(engine):
         params, _CFG, r.prompt, r.max_new_tokens)
 
 
+def test_prefix_hit_requests_bitwise_identical_to_cold(engine):
+    """The prefix-caching acceptance pin: re-serving the same prompts
+    through the warmed engine hits the prefix cache (suffix-only
+    prefill) and produces EXACTLY the cold tokens."""
+    eng, params = engine
+    reqs = _workload(n=8, seed=11)
+    cold = eng.run(reqs)
+    cold_stats = cold.pop(None)
+    warm = eng.run([Request(rid=f"w{r.rid}", prompt=r.prompt,
+                            max_new_tokens=r.max_new_tokens)
+                    for r in reqs])
+    warm_stats = warm.pop(None)
+    assert warm_stats["trace_counts"] == cold_stats["trace_counts"]
+    assert warm_stats["prefix_hit_tokens"] > 0
+    assert (warm_stats["prefix_hit_tokens"]
+            > cold_stats["prefix_hit_tokens"])
+    for r in reqs:
+        assert warm[f"w{r.rid}"]["tokens"] == cold[r.rid]["tokens"], r.rid
+    _check_engine_cache(eng, warm_stats)
+
+
+def test_long_prompt_chunked_prefill_matches_oracle():
+    """A prompt longer than one step's budget prefills across several
+    chunked steps — and the tokens still match the unpaged loop, with
+    rope + GQA exercising the per-row position path."""
+    cfg = TransformerConfig(vocab_size=128, seq_len=64, hidden=32,
+                            layers=2, heads=4, kv_heads=2, rope=True,
+                            causal=True)
+    params = transformer_init(jax.random.PRNGKey(1), cfg)
+    scfg = ServingConfig(model=cfg, num_blocks=96, block_size=4,
+                         max_slots=2, max_seq_len=48, chunk_tokens=5)
+    eng = ServingEngine(scfg, params)
+    rng = np.random.RandomState(5)
+    reqs = [Request(rid=i, prompt=rng.randint(1, 128, size=21).tolist(),
+                    max_new_tokens=3) for i in range(3)]
+    out = eng.run(reqs)
+    stats = out.pop(None)
+    assert stats["trace_counts"]["step"] == 1
+    assert stats["chunk_steps"] > 4        # 21 tokens through budget 5
+    for r in reqs:
+        ref = greedy_reference(params, cfg, r.prompt, r.max_new_tokens)
+        assert out[r.rid]["tokens"] == ref, (r.rid, out[r.rid]["tokens"],
+                                             ref)
+    _check_engine_cache(eng, stats)
+
+
+def test_prefix_cache_off_frees_everything():
+    """prefix_cache=False restores the PR-3 economy: no index, every
+    block returns to the pool at the end of the run."""
+    params = transformer_init(jax.random.PRNGKey(0), _CFG)
+    scfg = ServingConfig(model=_CFG, num_blocks=48, block_size=4,
+                         max_slots=2, max_seq_len=32, prefix_cache=False)
+    eng = ServingEngine(scfg, params)
+    out = eng.run([Request(rid=i, prompt=[3 + i, 5, 7], max_new_tokens=3)
+                   for i in range(3)])
+    stats = out.pop(None)
+    assert eng.index is None
+    check_invariants(stats["cache"])
+    assert int(free_block_count(stats["cache"])) == 48
+
+
 def test_eos_evicts_early(engine):
-    """max_new_tokens=1 finishes at prefill; an eos_id matching the first
-    generated token finishes without a decode step for that slot."""
+    """max_new_tokens=1 finishes at the completing chunk; an eos_id
+    matching the first generated token finishes without a decode step
+    for that slot."""
     eng, params = engine
     prompt = [3, 5, 7, 11]
     first = greedy_reference(params, _CFG, prompt, 1)[0]
@@ -292,8 +536,7 @@ def test_eos_evicts_early(engine):
     stats = out.pop(None)
     assert out["one"]["tokens"] == [first]
     assert stats["decode_steps"] == 0
-    check_invariants(stats["cache"])
-    assert int(free_block_count(stats["cache"])) == eng.scfg.num_blocks
+    _check_engine_cache(eng, stats)
 
     scfg = ServingConfig(model=_CFG, num_blocks=96, block_size=4,
                          max_slots=4, max_prefill_len=16, max_seq_len=32,
@@ -303,11 +546,12 @@ def test_eos_evicts_early(engine):
     assert out2["e"]["tokens"] == [first]   # stopped at eos, not at 8
 
 
-def test_tp2_sharded_decode_token_identical(engine):
-    """2-device TP-sharded decode (weights via param_specs, cache KV
+def test_tp2_sharded_step_token_identical(engine):
+    """2-device TP-sharded serving (weights via param_specs, cache KV
     heads on the model axis) produces token-identical greedy output vs
-    the single-device unpaged loop — the acceptance criterion the dryrun
-    serving leg re-checks in the driver artifact."""
+    the single-device unpaged loop — cold AND prefix-warm — the
+    acceptance criterion the dryrun serving/prefix legs re-check in the
+    driver artifact."""
     from jax.sharding import Mesh
 
     _, params = engine
@@ -317,14 +561,42 @@ def test_tp2_sharded_decode_token_identical(engine):
     scfg = ServingConfig(model=_CFG, num_blocks=48, block_size=4,
                          max_slots=2, max_prefill_len=16, max_seq_len=32)
     eng_tp = ServingEngine(scfg, params, mesh=mesh)
-    reqs = [Request(rid=i, prompt=[2 + i, 40 + i, 9], max_new_tokens=4,
-                    arrival=i) for i in range(3)]
-    out = eng_tp.run(reqs)
-    out.pop(None)
+    reqs = [Request(rid=i, prompt=[2 + i, 40 + i, 9] * 2,
+                    max_new_tokens=4, arrival=i) for i in range(3)]
+    cold = eng_tp.run(reqs)
+    cold.pop(None)
+    warm = eng_tp.run([Request(rid=f"w{r.rid}", prompt=r.prompt,
+                               max_new_tokens=r.max_new_tokens)
+                       for r in reqs])
+    warm_stats = warm.pop(None)
+    assert warm_stats["prefix_hit_tokens"] > 0
     for r in reqs:
         ref = greedy_reference(params, _CFG, r.prompt, r.max_new_tokens)
-        assert out[r.rid]["tokens"] == ref, (r.rid, out[r.rid]["tokens"],
-                                             ref)
+        assert cold[r.rid]["tokens"] == ref, (r.rid, "cold")
+        assert warm[f"w{r.rid}"]["tokens"] == ref, (r.rid, "warm")
+
+
+def test_failed_run_cold_starts_next_run(engine):
+    """A run that dies mid-loop has already donated the persistent cache
+    into the jitted step — the engine must cold-start the next run
+    (reset_state) instead of serving from deleted arrays or a desynced
+    prefix index."""
+    _, params = engine
+    scfg = ServingConfig(model=_CFG, num_blocks=48, block_size=4,
+                         max_slots=2, max_seq_len=32)
+    eng = ServingEngine(scfg, params)
+    prompt = [3, 5, 7, 11, 13]
+    ref = greedy_reference(params, _CFG, prompt, 3)
+    out = eng.run([Request(rid=0, prompt=prompt, max_new_tokens=3)])
+    out.pop(None)
+    assert out[0]["tokens"] == ref
+    with pytest.raises(RuntimeError, match="exceeded"):
+        eng.run([Request(rid=1, prompt=[9] * 8, max_new_tokens=5)],
+                max_steps=1)
+    assert eng._cache is None                # cold-started
+    out2 = eng.run([Request(rid=2, prompt=prompt, max_new_tokens=3)])
+    out2.pop(None)
+    assert out2[2]["tokens"] == ref          # recovered, still correct
 
 
 def test_unsupported_configs_raise():
@@ -342,9 +614,13 @@ def test_unsupported_configs_raise():
 def test_serving_env_knob_defaults(monkeypatch):
     monkeypatch.setenv("APEX_TPU_PAGED_BLOCK_SIZE", "32")
     monkeypatch.setenv("APEX_TPU_SERVING_MAX_SLOTS", "3")
+    monkeypatch.setenv("APEX_TPU_SERVING_CHUNK_TOKENS", "96")
+    monkeypatch.setenv("APEX_TPU_PREFIX_CACHE", "0")
     scfg = ServingConfig(model=_CFG, num_blocks=8)
     assert scfg.block_size == 32 and scfg.max_slots == 3
+    assert scfg.chunk_tokens == 96 and scfg.prefix_cache is False
     # explicit arguments beat the env
     scfg = ServingConfig(model=_CFG, num_blocks=8, block_size=8,
-                         max_slots=2)
+                         max_slots=2, chunk_tokens=16, prefix_cache=True)
     assert scfg.block_size == 8 and scfg.max_slots == 2
+    assert scfg.chunk_tokens == 16 and scfg.prefix_cache is True

@@ -25,8 +25,10 @@ def _clean_tuning_env(monkeypatch, tmp_path):
     for var in ("APEX_TPU_FLASH_BLOCK", "APEX_TPU_FLASH_BLOCK_BWD",
                 "APEX_TPU_FLASH_STREAM", "APEX_TPU_LN_BLOCK_ROWS",
                 "APEX_TPU_MOE_TILE_T", "APEX_TPU_MOE_TILE_F",
-                "APEX_TPU_OPTIM_BLOCK_ROWS", "APEX_TPU_SOFTMAX_CHUNK",
-                "APEX_TPU_USE_PALLAS", "APEX_TPU_TUNE"):
+                "APEX_TPU_OPTIM_BLOCK_ROWS", "APEX_TPU_PAGED_BLOCK_ROWS",
+                "APEX_TPU_PAGED_KV_FETCH", "APEX_TPU_PAGED_Q_TILE",
+                "APEX_TPU_SOFTMAX_CHUNK", "APEX_TPU_USE_PALLAS",
+                "APEX_TPU_TUNE"):
         monkeypatch.delenv(var, raising=False)
     monkeypatch.setenv("APEX_TPU_TUNEDB", str(tmp_path / "tunedb.json"))
     cache.invalidate()
@@ -389,6 +391,74 @@ def test_moe_grouped_resolution_order(monkeypatch):
     with cache.pinned(db):
         p = _gmm_params(t, e, h, f, jnp.bfloat16)
         assert p == {"tile_t": 512, "tile_f": 256, "backend": "pallas"}
+
+
+def test_paged_q_tile_resolution_order(monkeypatch):
+    """env > tune cache > cost model for the paged family's new q_tile
+    knob — the satellite acceptance pin (same shape as the
+    moe_grouped/overlap_tp pins), checked through the resolved view the
+    kernel consumes (ops.paged_attention._paged_params)."""
+    from apex_tpu.ops.paged_attention import _paged_params
+
+    monkeypatch.delenv("APEX_TPU_PAGED_Q_TILE", raising=False)
+    slots, maxb, bs, group, d = 8, 16, 16, 2, 128
+    # 1) empty cache -> pure cost-model defaults (incl. the group-aware
+    #    backend rule: 8 * 256 * 2 work >> threshold -> pallas)
+    with cache.pinned(cache.TuneDB()):
+        p = _paged_params(slots, maxb, bs, group, d, jnp.bfloat16)
+        assert p["q_tile"] == cost_model.paged_q_tile_default(group)
+        assert p["backend"] == "pallas"
+    # 2) cache entry beats the cost model (field-wise; other fields keep
+    #    their defaults)
+    db = cache.TuneDB()
+    db.record(shape_class.paged_key(slots, maxb, bs, group, d,
+                                    jnp.bfloat16, total_q=slots),
+              {"q_tile": 64}, source="test")
+    with cache.pinned(db):
+        p = _paged_params(slots, maxb, bs, group, d, jnp.bfloat16)
+        assert p["q_tile"] == 64
+        assert p["block_rows"] == cost_model.paged_block_rows_default(group)
+        # 3) env beats the cache
+        monkeypatch.setenv("APEX_TPU_PAGED_Q_TILE", "32")
+        p = _paged_params(slots, maxb, bs, group, d, jnp.bfloat16)
+        assert p["q_tile"] == 32
+    # malformed cache values clamp to the default, never crash
+    monkeypatch.delenv("APEX_TPU_PAGED_Q_TILE")
+    db = cache.TuneDB()
+    db.record(shape_class.paged_key(slots, maxb, bs, group, d,
+                                    jnp.bfloat16, total_q=slots),
+              {"q_tile": 12}, source="test")       # not a multiple of 8
+    with cache.pinned(db):
+        p = _paged_params(slots, maxb, bs, group, d, jnp.bfloat16)
+        assert p["q_tile"] == cost_model.paged_q_tile_default(group)
+
+
+def test_paged_backend_default_folds_gqa_group(monkeypatch):
+    """The satellite pin: the paged oracle-fallback threshold folds the
+    GQA group into its work estimate — the same (slots, span) geometry
+    routes to the oracle dense but to the kernel grouped, and auto mode
+    (_auto_use_kernel) follows."""
+    from apex_tpu.ops import paged_attention as mod
+
+    slots, maxb, bs, d = 2, 16, 16, 64        # span 256
+    # work = slots * span * group vs threshold 4096: 2*256*1 = 512 stays
+    # on the oracle; widening slots to 16 (4096) or the GROUP to 8
+    # (2*256*8 = 4096) crosses to the kernel — group folds in
+    assert cost_model.paged_backend_default(slots, maxb, bs, 1) == "jnp"
+    assert cost_model.paged_backend_default(slots * 8, maxb, bs, 1) \
+        == "pallas"
+    assert cost_model.paged_backend_default(slots, maxb, bs, 8) == "pallas"
+    # auto mode consumes the rule (env unset, empty cache)
+    monkeypatch.setattr(mod, "default_use_pallas", lambda fam: True)
+    with cache.pinned(cache.TuneDB()):
+        assert not mod._auto_use_kernel(slots, maxb, bs, 1, d,
+                                        jnp.bfloat16)
+        assert mod._auto_use_kernel(slots, maxb, bs, 8, d, jnp.bfloat16)
+    # defaults stay legal registry entries (autotuner invariant)
+    for group in (1, 2, 4, 8, 16):
+        registry.validate_entry(
+            "paged_decode",
+            {"q_tile": cost_model.paged_q_tile_default(group)})
 
 
 def test_moe_grouped_auto_backend_routing(monkeypatch):

@@ -326,6 +326,37 @@ def test_paged_attention_compiled(dtype, group):
 
 
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("group", [1, 4])
+def test_ragged_paged_attention_compiled(dtype, group):
+    """Mosaic-compiled ragged MULTI-QUERY paged attention (prefill chunks
+    + decode steps in one program) vs the generalized oracle — the
+    work-list grid + packed-q dynamic slices are the novel lowering
+    surface of the unified serving step."""
+    from apex_tpu.ops.paged_attention import (
+        ragged_paged_attention,
+        ragged_paged_attention_ref,
+    )
+
+    slots, hkv, d, nb, bs, maxb = 4, 2, 128, 64, 16, 4
+    hq = group * hkv
+    ks = jax.random.split(jax.random.PRNGKey(group + 7), 4)
+    k_pool = jax.random.normal(ks[0], (nb, bs, hkv, d), dtype)
+    v_pool = jax.random.normal(ks[1], (nb, bs, hkv, d), dtype)
+    tables = jax.random.permutation(ks[3], nb)[: slots * maxb].reshape(
+        slots, maxb)
+    # chunk mid-sequence, decode, idle, pure prefill; non-aligned total
+    qs = jnp.array([0, 29, 30, 30], jnp.int32)
+    ql = jnp.array([29, 1, 0, 23], jnp.int32)
+    kl = jnp.array([61, 33, 0, 23], jnp.int32)
+    q = jax.random.normal(ks[2], (53, hq, d), dtype)
+    got = jax.jit(
+        lambda *a: ragged_paged_attention(*a, use_pallas=True))(
+        q, k_pool, v_pool, tables, qs, ql, kl)
+    ref = ragged_paged_attention_ref(q, k_pool, v_pool, tables, qs, ql, kl)
+    assert _md(got, ref) < ATOL[dtype]
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
 def test_grouped_matmul_compiled(dtype):
     """Mosaic-compiled ragged grouped matmul vs the segment oracle — the
     scalar-prefetch work-list index maps over ragged group boundaries are
