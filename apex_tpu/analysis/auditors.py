@@ -378,4 +378,27 @@ def default_entry_points() -> List[EntryPoint]:
         name="serving_paged_decode", fn=jax.jit(decode),
         args=_decode_args, args_variant=_decode_args))
 
+    # -- 6. serving ragged verify (speculative K+1 windows over the
+    #       multi-query oracle; dtype-drift pinned on the ragged lengths)
+    from apex_tpu.ops.paged_attention import ragged_paged_attention_ref
+
+    def verify(q, kp, vp, tables, qs, ql, kl):
+        return ragged_paged_attention_ref(q, kp, vp, tables, qs, ql, kl)
+
+    def _verify_args(len_dtype=np.int32):
+        # a K=3 verify window, a plain decode row, an idle slot — the
+        # packed layout the speculative engine hands the unified step
+        q = np.zeros((5, 4, 16), np.float32)
+        kp = np.zeros((8, 4, 2, 16), np.float32)
+        vp = np.zeros((8, 4, 2, 16), np.float32)
+        tables = np.zeros((3, 3), np.int32)
+        qs = np.array([0, 4, 5], np.int32)
+        ql = np.array([4, 1, 0], np.int32)
+        kl = np.array([9, 6, 0], len_dtype)
+        return (q, kp, vp, tables, qs, ql, kl)
+
+    eps.append(EntryPoint(
+        name="serving_ragged_verify", fn=jax.jit(verify),
+        args=_verify_args, args_variant=_verify_args))
+
     return eps

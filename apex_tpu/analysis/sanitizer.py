@@ -561,10 +561,14 @@ def _paged_shapes() -> List[dict]:
 
 def _paged_layout(s_n: int, tq: int, q_tile: int) -> List[int]:
     """Adversarial per-slot query lengths for the work-list model: an
-    idle slot, single-token decodes, and one chunk taking every
-    remaining row (crossing q_tile boundaries whenever tq allows)."""
+    idle slot, a single-token decode, a speculative K=3 verify window
+    (query_len 4 — the serving engine's spec-on run shape) when tq
+    allows, and one chunk taking every remaining row (crossing q_tile
+    boundaries whenever tq allows)."""
     ql = [1] * s_n
     ql[1 % s_n] = 0
+    if s_n > 2 and tq >= s_n + 6:
+        ql[2] = 4
     ql[0] = max(1, tq - sum(ql[1:]))
     del q_tile  # the chunk crosses tiles for any q_tile < ql[0]
     return ql

@@ -11,12 +11,19 @@ Three layers (docs/serving.md):
                    free-block-watermark admission with prefix sharing,
                    chunked-prefill step planning under a fixed token
                    budget, slot accounting, eviction.
-- ``engine``     — ONE fixed-shape jitted step (prefill chunks + decode
-                   steps packed through the ragged multi-query
-                   paged-attention kernel, ops/paged_attention.py)
-                   driven by the scheduler, with optional
-                   tensor-parallel sharded weights reusing the training
-                   layout.
+- ``engine``     — ONE fixed-shape jitted step (prefill chunks, decode
+                   steps AND speculative verify windows packed through
+                   the ragged multi-query paged-attention kernel,
+                   ops/paged_attention.py) driven by the scheduler, with
+                   optional tensor-parallel sharded weights reusing the
+                   training layout.
+- ``speculative`` — drafters for speculative decoding (host n-gram
+                   prompt lookup, a small draft model over its own
+                   paged pool, a forced-profile stub for benches):
+                   propose K tokens, the unified step verifies them as
+                   one ``query_len = K + 1`` run, greedy longest-prefix
+                   acceptance keeps output bitwise identical to
+                   non-speculative decode.
 """
 
 from apex_tpu.serving.engine import (  # noqa: F401
@@ -37,19 +44,28 @@ from apex_tpu.serving.kv_cache import (  # noqa: F401
     extend_slots,
     free_block_count,
     free_slot,
+    grow_slots,
     paged_kv_cache,
     release_blocks,
     retain_blocks,
     share_prefix,
+    truncate_slots,
     write_prefill,
 )
 from apex_tpu.serving.scheduler import Request, Scheduler  # noqa: F401
+from apex_tpu.serving.speculative import (  # noqa: F401
+    Drafter,
+    DraftModelDrafter,
+    NgramDrafter,
+    StubDrafter,
+)
 
 __all__ = [
-    "PagedKVCache", "PrefixIndex", "Request", "Scheduler", "ServingConfig",
-    "ServingEngine", "alloc_decode_blocks", "allocate_slot", "append_layer",
-    "blocks_needed", "cache_pspecs", "check_invariants", "cow_append",
-    "extend_slots", "free_block_count", "free_slot", "greedy_reference",
-    "paged_kv_cache", "release_blocks", "retain_blocks", "share_prefix",
-    "write_prefill",
+    "Drafter", "DraftModelDrafter", "NgramDrafter", "PagedKVCache",
+    "PrefixIndex", "Request", "Scheduler", "ServingConfig",
+    "ServingEngine", "StubDrafter", "alloc_decode_blocks", "allocate_slot",
+    "append_layer", "blocks_needed", "cache_pspecs", "check_invariants",
+    "cow_append", "extend_slots", "free_block_count", "free_slot",
+    "greedy_reference", "grow_slots", "paged_kv_cache", "release_blocks",
+    "retain_blocks", "share_prefix", "truncate_slots", "write_prefill",
 ]

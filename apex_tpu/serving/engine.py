@@ -36,6 +36,24 @@ sequences by returning non-shared blocks to the pool, so later arrivals
 join mid-flight and long prompts prefill in chunks without stalling
 running decodes.
 
+Speculative decoding (``ServingConfig.spec``, serving/speculative.py):
+decode is memory-bandwidth-bound, so a drafter proposes K tokens per
+decode-ready slot and the SAME unified step verifies the whole window
+as one ``query_len = K + 1`` ragged run — one weight-read per K + 1
+candidate tokens instead of per token. Greedy longest-prefix acceptance
+keeps the drafts the model itself would have emitted plus one bonus
+token (every emitted token IS the model's greedy output at its
+position, so speculative output is bitwise token-identical to
+non-speculative decode at any accept rate); rejected tokens' cache
+positions roll back through ``kv_cache.truncate_slots`` (refcount-aware
+— over-allocated suffix pages return to the pool, prefix-shared pages
+just drop this table's reference). Window block growth is pre-staged by
+a ``grow_slots`` helper call so the step program stays byte-identical
+spec-on vs spec-off, and the scheduler charges drafted tokens against
+the same ``chunk_tokens`` budget while adapting each slot's depth to
+its observed accept rate. ``spec`` off (the default) runs today's path
+unchanged — no drafter, no helper calls, same compiled step.
+
 Tensor parallelism is the training layout re-used verbatim: weights
 shard via ``param_specs``, the cache's KV heads ride the model axis
 (kv_cache.cache_pspecs), logits stay vocab-parallel and greedy sampling
@@ -45,13 +63,16 @@ single-device argmax (first-max-wins tie-break in both).
 Env knobs (docs/serving.md): ``APEX_TPU_PAGED_BLOCK_SIZE`` (cache page
 size, default 16), ``APEX_TPU_SERVING_MAX_SLOTS`` (slot count, default
 8), ``APEX_TPU_SERVING_CHUNK_TOKENS`` (per-step token budget),
-``APEX_TPU_PREFIX_CACHE`` (0 disables prefix sharing) — defaults for
-ServingConfig, explicit arguments win.
+``APEX_TPU_PREFIX_CACHE`` (0 disables prefix sharing),
+``APEX_TPU_SERVING_SPEC`` (1 enables speculative decoding, default
+off), ``APEX_TPU_SERVING_SPEC_K`` (max draft depth, default 4) —
+defaults for ServingConfig, explicit arguments win.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 import time
 from typing import Dict, List, Optional
@@ -99,6 +120,9 @@ from apex_tpu.utils.profiling import host_trace_range, trace_range
 # serving/chunk_utilization histogram: fraction of the step budget
 # actually carrying query tokens
 UTIL_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+# serving/spec_accept_rate histogram: accepted / drafted per verify run
+SPEC_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+_I32_MAX = 2**31 - 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,6 +142,8 @@ class ServingConfig:
     dtype: object = None                    # cache dtype (None = model's)
     chunk_tokens: Optional[int] = None      # APEX_TPU_SERVING_CHUNK_TOKENS
     prefix_cache: Optional[bool] = None     # APEX_TPU_PREFIX_CACHE | on
+    spec: Optional[bool] = None             # APEX_TPU_SERVING_SPEC | off
+    spec_k: Optional[int] = None            # APEX_TPU_SERVING_SPEC_K | 4
 
     def __post_init__(self):
         s = object.__setattr__
@@ -138,6 +164,22 @@ class ServingConfig:
         if self.prefix_cache is None:
             env = env_flag("APEX_TPU_PREFIX_CACHE")
             s(self, "prefix_cache", True if env is None else env)
+        if self.spec is None:
+            # default OFF: unset leaves the engine byte-for-byte on the
+            # non-speculative path (acceptance contract, docs/serving.md)
+            s(self, "spec", bool(env_flag("APEX_TPU_SERVING_SPEC",
+                                          default=False)))
+        if self.spec_k is None:
+            # the depth knob is read (and validated) only when
+            # speculation is ON — a stray APEX_TPU_SERVING_SPEC_K must
+            # not break plain non-speculative serving construction
+            s(self, "spec_k",
+              env_int("APEX_TPU_SERVING_SPEC_K", default=4)
+              if self.spec else 4)
+        if self.spec and self.spec_k < 1:
+            raise ValueError(
+                f"spec_k {self.spec_k} must be >= 1 (set spec=False to "
+                f"disable speculation)")
         if self.dtype is None:
             s(self, "dtype", self.model.dtype)
 
@@ -198,6 +240,23 @@ def _check_supported(cfg: TransformerConfig):
         if flag:
             raise NotImplementedError(
                 f"serving engine does not support {msg}")
+
+
+def counted_cache_op(counts, name, fn, mesh, cspec, n_scalar_args):
+    """One-compile jitted wrapper for a pure cache op
+    ``(cache, *scalars) -> cache``: shard over ``mesh`` with the cache
+    donated, counting traces into ``counts[name]``. THE factory behind
+    the engine's share/retain/release/free/grow/truncate helpers AND
+    the draft runner's grow/truncate/free copies — one definition of
+    the jit/smap/donation wiring, so the two paths cannot diverge."""
+
+    def wrapped(*args):
+        counts[name] += 1                  # trace-time side effect
+        return fn(*args)
+
+    return jax.jit(
+        smap(wrapped, mesh, (cspec,) + (P(),) * n_scalar_args, cspec),
+        donate_argnums=(0,))
 
 
 # ---------------------------------------------------------------------------
@@ -281,7 +340,7 @@ class ServingEngine:
     other loop state is per-run host python."""
 
     def __init__(self, scfg: ServingConfig, params,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None, drafter=None):
         cfg = scfg.model
         _check_supported(cfg)
         if mesh is None:
@@ -309,7 +368,18 @@ class ServingEngine:
             kc.PrefixIndex(scfg.block_size) if scfg.prefix_cache else None)
         self._cache: Optional[kc.PagedKVCache] = None
         self.trace_counts = {"step": 0, "share": 0, "retain": 0,
-                             "release": 0, "free": 0}
+                             "release": 0, "free": 0, "grow": 0,
+                             "truncate": 0}
+        # speculative decoding (docs/serving.md): the drafter proposes K
+        # tokens per decode-ready slot and the SAME unified step verifies
+        # them as one (K+1)-token ragged run — speculation changes run
+        # metadata, never the compiled program
+        self.drafter = None
+        self._pending_drafter = drafter
+        if not scfg.spec and drafter is not None:
+            raise ValueError(
+                "a drafter was supplied but ServingConfig.spec is off "
+                "(set spec=True or APEX_TPU_SERVING_SPEC=1)")
 
         pspec = param_specs(cfg)
         cspec = kc.cache_pspecs(tp_axis="model")
@@ -321,30 +391,48 @@ class ServingEngine:
             with trace_range("serving.step"):
                 return _step_body(params, cache, tokens, qs, ql, **opts)
 
-        def counted(name, fn):
-            def wrapped(*args):
-                counts[name] += 1
-                return fn(*args)
-            return wrapped
-
         self._step = jax.jit(
             smap(step, mesh, (pspec, cspec, P(), P(), P()), (cspec, P())),
             donate_argnums=(1,))
-        self._share = jax.jit(
-            smap(counted("share", kc.share_prefix), mesh,
-                 (cspec, P(), P(), P(), P()), cspec),
-            donate_argnums=(0,))
-        self._retain = jax.jit(
-            smap(counted("retain", kc.retain_blocks), mesh,
-                 (cspec, P(), P()), cspec),
-            donate_argnums=(0,))
-        self._release = jax.jit(
-            smap(counted("release", kc.release_blocks), mesh,
-                 (cspec, P(), P()), cspec),
-            donate_argnums=(0,))
-        self._free = jax.jit(
-            smap(counted("free", kc.free_slot), mesh, (cspec, P()), cspec),
-            donate_argnums=(0,))
+        self._share = counted_cache_op(
+            counts, "share", kc.share_prefix, mesh, cspec, 4)
+        self._retain = counted_cache_op(
+            counts, "retain", kc.retain_blocks, mesh, cspec, 2)
+        self._release = counted_cache_op(
+            counts, "release", kc.release_blocks, mesh, cspec, 2)
+        self._free = counted_cache_op(
+            counts, "free", kc.free_slot, mesh, cspec, 1)
+        # speculation's pre-staged block growth (a verify window may
+        # cross more than one page boundary) and post-verify rollback —
+        # tiny one-compile programs like share/retain/release/free,
+        # touched only when speculation is on
+        self._max_grow = min(scfg.max_blocks_per_seq,
+                             -(-scfg.chunk_tokens // scfg.block_size) + 1)
+        self._grow = counted_cache_op(
+            counts, "grow",
+            functools.partial(kc.grow_slots, max_grow=self._max_grow),
+            mesh, cspec, 1)
+        self._truncate = counted_cache_op(
+            counts, "truncate", kc.truncate_slots, mesh, cspec, 1)
+        if scfg.spec:
+            if self._pending_drafter is None:
+                from apex_tpu.serving.speculative import NgramDrafter
+                self._pending_drafter = NgramDrafter()
+            self.set_drafter(self._pending_drafter)
+
+    def set_drafter(self, drafter) -> None:
+        """Install (and ``bind``) a drafter on a speculation-enabled
+        engine — the supported way to swap drafting strategies between
+        runs (the bench A/B swaps a StubDrafter profile per run; a
+        DraftModelDrafter builds its device state here, so attribute
+        assignment would skip it). The compiled step is untouched:
+        drafters only change run metadata."""
+        if not self.scfg.spec:
+            raise ValueError(
+                "set_drafter on a non-speculative engine (set spec=True "
+                "or APEX_TPU_SERVING_SPEC=1)")
+        drafter.bind(self)
+        self.drafter = drafter
 
     def reset_state(self) -> None:
         """Forget the persistent KV cache and prefix index (the next run
@@ -353,6 +441,8 @@ class ServingEngine:
         self._cache = None
         if self.index is not None:
             self.index = kc.PrefixIndex(self.scfg.block_size)
+        if self.drafter is not None:
+            self.drafter.reset()
 
     def fresh_cache(self) -> kc.PagedKVCache:
         s = self.scfg
@@ -361,6 +451,15 @@ class ServingEngine:
             block_size=s.block_size, n_kv_heads=s.n_kv_heads,
             head_dim=self.cfg.head_dim, max_slots=s.max_slots,
             max_blocks_per_seq=s.max_blocks_per_seq, dtype=s.dtype)
+
+    @staticmethod
+    def _table_row(cache: kc.PagedKVCache, slot: int, n: int) -> np.ndarray:
+        """Fetch ONE slot's first ``n`` block-table entries: slice on
+        DEVICE first, so the host transfer is the [n] row — not the
+        whole [max_slots, max_blocks_per_seq] table per finished
+        request (pinned by test: the fetched array has the row's
+        shape)."""
+        return np.asarray(cache.block_tables[slot, :n])
 
     def _ids_row(self, ids: List[int]) -> jax.Array:
         row = jnp.zeros((self.scfg.max_blocks_per_seq,), jnp.int32)
@@ -389,7 +488,8 @@ class ServingEngine:
             block_size=s.block_size,
             max_blocks_per_seq=s.max_blocks_per_seq,
             watermark=s.watermark, chunk_tokens=s.chunk_tokens,
-            prefix_index=self.index)
+            prefix_index=self.index,
+            spec_k=s.spec_k if self.drafter is not None else 0)
         for r in requests:
             # fail fast at intake: a bad request must not surface as
             # silent KV corruption mid-batch, after other requests
@@ -405,6 +505,7 @@ class ServingEngine:
         stats = {"steps": 0, "prefills": 0, "decode_steps": 0,
                  "decode_tokens": 0, "chunk_steps": 0, "chunk_tokens": 0,
                  "prefix_hit_tokens": 0, "prefix_miss_tokens": 0,
+                 "spec_drafted_tokens": 0, "spec_accepted_tokens": 0,
                  "prefill_s": 0.0, "decode_s": 0.0}
         waiting_since: Dict[object, float] = {}        # rid -> wall ts
         # host-side telemetry (docs/observability.md): everything below
@@ -417,11 +518,15 @@ class ServingEngine:
             # preempts today; the counter is the dashboard's contract
             # for when it does)
             reg = default_registry()
-            for name in ("serving/admissions", "serving/evictions",
-                         "serving/preemptions",
-                         "serving/admission_blocked",
-                         "serving/prefix_hit_tokens",
-                         "serving/prefix_miss_tokens"):
+            names = ["serving/admissions", "serving/evictions",
+                     "serving/preemptions",
+                     "serving/admission_blocked",
+                     "serving/prefix_hit_tokens",
+                     "serving/prefix_miss_tokens"]
+            if self.drafter is not None:
+                names += ["serving/spec_drafted_tokens",
+                          "serving/spec_accepted_tokens"]
+            for name in names:
                 reg.counter(name).inc(0)
             set_gauge("serving/kv_blocks_total", s.num_blocks)
             set_gauge("serving/kv_watermark", sched.watermark)
@@ -436,7 +541,7 @@ class ServingEngine:
                 if n_full:
                     # one small host fetch per FINISHED request — the
                     # index needs the slot's concrete page ids
-                    row = np.asarray(cache.block_tables)[slot][:n_full]
+                    row = self._table_row(cache, slot, n_full)
                     newly = self.index.insert(st.req.prompt,
                                               [int(b) for b in row])
                     if newly:
@@ -444,6 +549,8 @@ class ServingEngine:
                                              jnp.int32(len(newly)))
             cache = self._free(cache, jnp.int32(slot))
             sched.release(slot, newly)
+            if self.drafter is not None:
+                self.drafter.on_finish(slot)
 
         step = 0
         ok = False
@@ -466,7 +573,31 @@ class ServingEngine:
                         self._ids_row(adm.shared_ids),
                         jnp.int32(len(adm.shared_ids)),
                         jnp.int32(adm.n_blocks))
-                work = sorted(sched.plan_step(), key=lambda w: w.slot)
+                drafts: Dict[int, List[int]] = {}
+                if self.drafter is not None:
+                    # draft BEFORE planning so the scheduler charges the
+                    # actual draft counts against the chunk budget
+                    want = [(slot, k) for slot, k
+                            in sorted(sched.spec_quota().items()) if k > 0]
+                    if want:
+                        got = self.drafter.draft_batch(
+                            [(slot,
+                              sched.running[slot].req.prompt + gen[slot],
+                              k) for slot, k in want])
+                        drafts = {slot: list(got.get(slot) or [])[:k]
+                                  for slot, k in want if got.get(slot)}
+                work = sorted(
+                    sched.plan_step({sl: len(d) for sl, d in drafts.items()}
+                                    if self.drafter is not None else None),
+                    key=lambda w: w.slot)
+                if self.drafter is not None and any(w.grow for w in work):
+                    # pre-stage every page the verify windows touch, so
+                    # the in-step one-block growth stays a no-op and the
+                    # step program is byte-identical spec-on vs spec-off
+                    grow_row = np.zeros((s.max_slots,), np.int32)
+                    for w in work:
+                        grow_row[w.slot] = w.grow
+                    cache = self._grow(cache, jnp.asarray(grow_row))
                 if work:
                     tokens = np.zeros((s.chunk_tokens,), np.int32)
                     qs = np.zeros((s.max_slots,), np.int32)
@@ -480,7 +611,12 @@ class ServingEngine:
                             tokens[off:off + w.n] = st.req.prompt[
                                 w.start:w.start + w.n]
                         else:
+                            # a decode row, or a verify window: the last
+                            # generated token followed by the drafts
                             tokens[off] = gen[w.slot][-1]
+                            if w.n > 1:
+                                tokens[off + 1:off + w.n] = \
+                                    drafts[w.slot][:w.n - 1]
                         off += w.n
                     t0 = time.perf_counter()
                     # host-side profiler seam: marks the dispatch+wait span
@@ -497,24 +633,68 @@ class ServingEngine:
                     n_dec = sum(1 for w in work if w.kind == "decode")
                     if n_dec:
                         stats["decode_steps"] += 1
-                        stats["decode_tokens"] += n_dec
                         stats["decode_s"] += dt
-                        # one decode item = one token for that slot, so the
-                        # step latency IS its per-token latency (TPOT)
-                        observe("serving/tpot_s", dt, buckets=TIME_BUCKETS)
                     else:
                         stats["prefill_s"] += dt
+                    dec_emitted = 0
                     if any(w.kind == "chunk" for w in work):
                         stats["chunk_steps"] += 1
                         stats["chunk_tokens"] += sum(
                             w.n for w in work if w.kind == "chunk")
+                    trunc = None
                     for w in work:
                         st = sched.running[w.slot]
                         rid = st.req.rid
-                        if w.kind == "decode":
+                        if w.kind == "decode" and w.n > 1:
+                            # speculative verify: greedy longest-prefix
+                            # acceptance — row j's output is the model's
+                            # next token after [last, d1..dj], so every
+                            # emitted token is EXACTLY the greedy
+                            # continuation (the bitwise-identity
+                            # contract), whatever the drafter proposed
+                            nd = w.n - 1
+                            d = drafts[w.slot][:nd]
+                            base = qs[w.slot]
+                            outs = [int(nxt[base + i]) for i in range(w.n)]
+                            acc = 0
+                            while acc < nd and outs[acc] == d[acc]:
+                                acc += 1
+                            emitted = outs[:acc + 1]
+                            rem = st.req.max_new_tokens - len(gen[w.slot])
+                            emitted = emitted[:rem]
+                            if s.eos_id is not None and s.eos_id in emitted:
+                                emitted = emitted[
+                                    :emitted.index(s.eos_id) + 1]
+                            gen[w.slot].extend(emitted)
+                            out[rid]["steps"] = step
+                            stats["decode_tokens"] += len(emitted)
+                            dec_emitted += len(emitted)
+                            stats["spec_drafted_tokens"] += nd
+                            stats["spec_accepted_tokens"] += acc
+                            inc_counter("serving/spec_drafted_tokens", nd)
+                            inc_counter("serving/spec_accepted_tokens", acc)
+                            observe("serving/spec_accept_rate", acc / nd,
+                                    buckets=SPEC_BUCKETS)
+                            fin = (len(gen[w.slot])
+                                   >= st.req.max_new_tokens
+                                   or emitted[-1] == s.eos_id)
+                            new_len = sched.note_spec(w.slot, nd, acc, fin)
+                            if fin:
+                                finish(w.slot)
+                            elif acc < nd:
+                                # rejected drafts: roll their K/V
+                                # positions back and release the
+                                # over-allocated suffix pages
+                                if trunc is None:
+                                    trunc = np.full((s.max_slots,),
+                                                    _I32_MAX, np.int32)
+                                trunc[w.slot] = new_len
+                        elif w.kind == "decode":
                             tok = int(nxt[qs[w.slot]])
                             gen[w.slot].append(tok)
                             out[rid]["steps"] = step
+                            stats["decode_tokens"] += 1
+                            dec_emitted += 1
                             if (len(gen[w.slot]) >= st.req.max_new_tokens
                                     or tok == s.eos_id):
                                 finish(w.slot)
@@ -529,6 +709,18 @@ class ServingEngine:
                                         "ttft_s": ttft}
                             if st.req.max_new_tokens == 1 or tok == s.eos_id:
                                 finish(w.slot)
+                    if trunc is not None:
+                        cache = self._truncate(cache, jnp.asarray(trunc))
+                    if n_dec:
+                        # per-token decode latency: the step emitted
+                        # dec_emitted tokens across n_dec decode slots.
+                        # Without speculation dec_emitted == n_dec and
+                        # this is exactly the step latency; a verify
+                        # window emitting K+1 tokens divides its step
+                        # cost across them, keeping TPOT honest spec-on
+                        observe("serving/tpot_s",
+                                dt * n_dec / max(dec_emitted, 1),
+                                buckets=TIME_BUCKETS)
                 kv_free_min = min(kv_free_min, sched.free_blocks)
                 set_gauge("serving/kv_blocks_free", sched.free_blocks)
                 set_gauge("serving/kv_occupancy",

@@ -349,13 +349,8 @@ def extend_slots(cache: PagedKVCache, active, ql) -> PagedKVCache:
 
     def body(carry, s):
         rc, tables, nblk = carry
-        blk = jnp.argmax(rc == 0).astype(jnp.int32)            # first free
-        grow = need[s]
-        ti = jnp.clip(nblk[s], 0, cache.max_blocks_per_seq - 1)
-        rc = rc.at[blk].set(jnp.where(grow, 1, rc[blk]))
-        tables = tables.at[s, ti].set(jnp.where(grow, blk, tables[s, ti]))
-        nblk = nblk.at[s].add(jnp.where(grow, 1, 0))
-        return (rc, tables, nblk), None
+        return _tail_alloc(rc, tables, nblk, s, need[s],
+                           cache.max_blocks_per_seq), None
 
     (rc, tables, nblk), _ = jax.lax.scan(
         body, (cache.refcount, cache.block_tables, cache.n_blocks),
@@ -363,6 +358,86 @@ def extend_slots(cache: PagedKVCache, active, ql) -> PagedKVCache:
     return cache._replace(
         block_tables=tables, n_blocks=nblk, refcount=rc,
         seq_lens=pos_end,
+    )
+
+
+def _tail_alloc(rc, tables, nblk, s, grow, max_blocks_per_seq: int):
+    """One scan step of first-free tail allocation — THE shared body of
+    ``extend_slots`` and ``grow_slots``: when ``grow``, hand slot ``s``
+    the first free pool block (rc 0 -> 1) at its table tail. Callers
+    guarantee a free block exists whenever ``grow`` is true (the
+    admission watermark); with the pool full, argmax would return
+    block 0 — the documented allocate-on-empty invariant violation."""
+    blk = jnp.argmax(rc == 0).astype(jnp.int32)
+    ti = jnp.clip(nblk[s], 0, max_blocks_per_seq - 1)
+    rc = rc.at[blk].set(jnp.where(grow, 1, rc[blk]))
+    tables = tables.at[s, ti].set(jnp.where(grow, blk, tables[s, ti]))
+    nblk = nblk.at[s].add(jnp.where(grow, 1, 0))
+    return rc, tables, nblk
+
+
+def grow_slots(cache: PagedKVCache, counts, *, max_grow: int) -> PagedKVCache:
+    """Assign ``counts[s]`` fresh pool blocks to each slot's table tail
+    (refcount 1 each, ``n_blocks`` advanced; ``seq_lens`` untouched) —
+    the engine's pre-staging call for runs that may cross MORE than one
+    page boundary in a single step (a speculative verify window of
+    ``K + 1`` tokens), which ``extend_slots``'s one-block-per-step
+    growth cannot cover. Pre-grown slots make the in-step growth a
+    no-op, so the unified step's program is byte-identical whether
+    growth happened here or there.
+
+    ``max_grow`` is the STATIC per-slot ceiling (callers jit one wrapper
+    per engine); ``counts`` entries above it are a caller bug and are
+    clamped. Callers keep ``free_block_count >= sum(counts)`` via the
+    scheduler's watermark, and ``n_blocks + counts <=
+    max_blocks_per_seq`` via the per-request capacity check."""
+    counts = jnp.clip(jnp.asarray(counts, jnp.int32), 0, max_grow)
+
+    def body(carry, sj):
+        rc, tables, nblk = carry
+        s = sj // max_grow
+        j = sj % max_grow
+        grow = (j < counts[s]) & (nblk[s] < cache.max_blocks_per_seq)
+        return _tail_alloc(rc, tables, nblk, s, grow,
+                           cache.max_blocks_per_seq), None
+
+    (rc, tables, nblk), _ = jax.lax.scan(
+        body, (cache.refcount, cache.block_tables, cache.n_blocks),
+        jnp.arange(cache.max_slots * max_grow))
+    return cache._replace(block_tables=tables, n_blocks=nblk, refcount=rc)
+
+
+def truncate_slots(cache: PagedKVCache, new_lens) -> PagedKVCache:
+    """Roll slots BACK to ``new_lens[s]`` tokens, releasing the
+    over-allocated suffix: every table entry past
+    ``ceil(new_len / block_size)`` has its refcount DECREMENTED (a page
+    still shared by another table or held by the prefix index stays
+    resident — rollback must never free pages the index holds) and is
+    cleared from the table; ``n_blocks`` shrinks to the kept count.
+
+    Only slots with ``new_lens[s] < seq_lens[s]`` change — pass the
+    current length (or any value >= it, e.g. INT32_MAX) to leave a slot
+    untouched. The engine calls this after speculative verification to
+    drop rejected draft tokens' positions; callers must not truncate a
+    slot holding pages assigned for UNWRITTEN future tokens (a
+    mid-prefill slot's admitted suffix pages), because the kept count is
+    derived from ``new_lens`` alone. Stale K/V past ``new_lens`` in
+    kept pages is unreachable (the kernel masks columns >= kv_len) and
+    is overwritten before the positions become visible again."""
+    mb = cache.max_blocks_per_seq
+    bs = cache.block_size
+    nl = jnp.minimum(jnp.asarray(new_lens, jnp.int32), cache.seq_lens)
+    do = nl < cache.seq_lens
+    keep_n = jnp.minimum((nl + bs - 1) // bs, cache.n_blocks)
+    keep_n = jnp.where(do, keep_n, cache.n_blocks)             # [S]
+    lane = jnp.arange(mb)[None, :]
+    drop = (lane >= keep_n[:, None]) & (lane < cache.n_blocks[:, None])
+    ids = jnp.where(drop, cache.block_tables, cache.num_blocks)
+    return cache._replace(
+        block_tables=jnp.where(drop, 0, cache.block_tables),
+        n_blocks=keep_n,
+        seq_lens=jnp.where(do, nl, cache.seq_lens),
+        refcount=cache.refcount.at[ids.reshape(-1)].add(-1, mode="drop"),
     )
 
 

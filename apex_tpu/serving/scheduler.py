@@ -34,6 +34,16 @@ scheduler evicts least-recently-matched index entries (their device
 refcount release is drained by the engine via ``drain_releases``)
 before blocking admission.
 
+**Speculative decoding** (``spec_k > 0``): a decode-ready slot's step
+item becomes a verify window of ``1 + K`` tokens (``spec_quota`` asks
+the drafter, ``plan_step(spec_drafts=...)`` charges the drafts against
+the SAME ``chunk_tokens`` budget — decodes first, chunks in what
+remains; while prompt chunks are pending, speculation may take at most
+HALF the leftover budget so prefill always progresses), and
+``note_spec`` adapts each slot's depth to its observed accept rate
+while reconciling the host mirror with the engine's device-side
+rollback (``kv_cache.truncate_slots``).
+
 Admission policy (free-block watermark): a request is admitted only when
 a slot is free AND the pool would retain >= ``watermark`` free blocks
 after its suffix allocation. The watermark reserves decode headroom for
@@ -89,6 +99,7 @@ class _Running:
     tokens_in_cache: int   # prefix + chunk + decode tokens written so far
     prefilled: int         # prompt tokens resident (prefix hit + chunks)
     shared_ids: List[int]  # prefix blocks borrowed from the index
+    spec_depth: int = 0    # current adaptive draft depth (speculation on)
 
 
 @dataclasses.dataclass
@@ -112,7 +123,8 @@ class Admission:
 class Work:
     """One slot's share of a step's token budget: a prompt chunk
     (``kind == "chunk"``, prompt[start : start+n]) or a decode step
-    (``kind == "decode"``, n == 1, the slot's last generated token).
+    (``kind == "decode"``; n == 1 plain, n == 1 + K a speculative verify
+    window of the slot's last generated token plus K drafts).
     ``completes_prompt`` marks the chunk whose last-row logits emit the
     request's FIRST generated token."""
 
@@ -121,6 +133,10 @@ class Work:
     start: int
     n: int
     completes_prompt: bool = False
+    # speculative verify runs only: blocks the engine's grow helper must
+    # pre-stage before the step (a K+1-token window may cross more page
+    # boundaries than the in-step one-block growth covers)
+    grow: int = 0
 
 
 class Scheduler:
@@ -130,8 +146,13 @@ class Scheduler:
                  max_blocks_per_seq: int,
                  watermark: Optional[int] = None,
                  chunk_tokens: Optional[int] = None,
-                 prefix_index: Optional[PrefixIndex] = None):
+                 prefix_index: Optional[PrefixIndex] = None,
+                 spec_k: int = 0):
         self.max_slots = max_slots
+        # speculative decoding: spec_k is the MAX draft depth per slot
+        # (0 = off); each running slot adapts its own depth within
+        # [1, spec_k] to the accept rates note_spec observes
+        self.spec_k = int(spec_k)
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
         self.free_blocks = num_blocks
@@ -235,7 +256,7 @@ class Scheduler:
             self.running[slot] = _Running(
                 req=req, slot=slot, n_blocks=need,
                 tokens_in_cache=prefix_tokens, prefilled=prefix_tokens,
-                shared_ids=list(shared_ids))
+                shared_ids=list(shared_ids), spec_depth=self.spec_k)
             inc_counter("serving/admissions", 1)
             inc_counter("serving/prefix_hit_tokens", prefix_tokens)
             inc_counter("serving/prefix_miss_tokens",
@@ -254,13 +275,106 @@ class Scheduler:
                 f"with 0 free — the admission watermark "
                 f"({self.watermark}) is undersized for this workload")
 
-    def plan_step(self) -> List[Work]:
+    def _decode_ready(self, st: _Running) -> bool:
+        return st.prefilled >= len(st.req.prompt)
+
+    def _emit_headroom(self, st: _Running) -> int:
+        """Tokens the request may still EMIT (decode-ready slots only).
+        The host's generated list runs one token ahead of the cache (the
+        completing chunk emits the first token before any decode write),
+        so generated-so-far = tokens_in_cache - prompt + 1."""
+        return (st.req.max_new_tokens
+                - (st.tokens_in_cache - len(st.req.prompt)) - 1)
+
+    def spec_quota(self) -> Dict[int, int]:
+        """Per decode-ready slot, the max draft tokens the engine should
+        request from the drafter THIS step: the slot's adaptive depth,
+        capped so the verify window never out-emits the request
+        (accepting every draft plus the bonus token must not exceed
+        max_new_tokens — that cap also keeps spec writes inside the
+        lifetime block capacity checked at ``add``), so drafted tokens
+        fit the step budget after every decode-ready slot's guaranteed
+        one token, and so the windows' block growth fits the FREE pool —
+        the admission watermark only reserves single-token growth, so
+        speculation shrinks before it can underflow what plain decode is
+        entitled to. Pure read — ``plan_step`` is then called with the
+        draft counts the drafter actually produced."""
+        ready = [s for s in sorted(self.running)
+                 if self._decode_ready(self.running[s])]
+        spare = self.chunk_tokens - len(ready)
+        # mid-prefill slots must keep making progress: speculation may
+        # take at most HALF the leftover budget while prompt chunks are
+        # pending (spec-off gave chunks the whole leftover; a sustained
+        # high accept rate must not push queued prompts' TTFT out
+        # indefinitely)
+        pending = sum(len(self.running[s].req.prompt)
+                      - self.running[s].prefilled
+                      for s in self.running
+                      if not self._decode_ready(self.running[s]))
+        spare -= min(pending, (spare + 1) // 2)
+        free = self.free_blocks
+        quota: Dict[int, int] = {}
+        for slot in ready:
+            st = self.running[slot]
+            k = max(0, min(st.spec_depth, self._emit_headroom(st), spare))
+
+            def _growth(n_tok):
+                return max(0, blocks_needed(st.tokens_in_cache + n_tok,
+                                            self.block_size) - st.n_blocks)
+
+            while k > 0 and _growth(1 + k) > free:
+                k -= 1
+            free -= _growth(1 + k)
+            quota[slot] = k
+            spare -= k
+        return quota
+
+    def note_spec(self, slot: int, drafted: int, accepted: int,
+                  finished: bool) -> int:
+        """Record one verify outcome: adapt the slot's draft depth to
+        the observed accept rate (full acceptance probes one deeper,
+        accepting under half backs off — bounded [1, spec_k]) and, for a
+        slot that keeps running with rejected drafts in its cache, roll
+        the host mirror back alongside the engine's device
+        ``truncate_slots`` (tokens shrink to the accepted prefix, blocks
+        past the kept span return to the pool — always fresh rc=1 spec
+        growth, never prefix-shared pages, because rollback stops at
+        this step's own writes). Returns the slot's post-rollback token
+        count (the row the engine hands the device truncate). Finishing
+        slots skip the rollback: ``free_slot``/``release`` retire the
+        whole table, so mirror and device stay aligned without it."""
+        st = self.running[slot]
+        if drafted > 0:
+            if accepted >= drafted:
+                st.spec_depth = min(st.spec_depth + 1, self.spec_k)
+            elif accepted * 2 < drafted:
+                st.spec_depth = max(1, st.spec_depth - 1)
+        new_len = st.tokens_in_cache - (drafted - accepted)
+        if finished or accepted >= drafted:
+            return st.tokens_in_cache
+        kept = min(blocks_needed(new_len, self.block_size), st.n_blocks)
+        self.free_blocks += st.n_blocks - kept
+        st.n_blocks = kept
+        st.tokens_in_cache = new_len
+        return new_len
+
+    def plan_step(self,
+                  spec_drafts: Optional[Dict[int, int]] = None
+                  ) -> List[Work]:
         """Split this step's ``chunk_tokens`` budget over the running
         slots: decode steps first (one token per decode-ready slot —
         guaranteed to fit, chunk_tokens >= max_slots), then prompt
         chunks FIFO in slot order with whatever budget remains. Advances
         the host mirror (prefilled / tokens_in_cache / decode block
         growth) — callers run every returned Work item this step.
+
+        With ``spec_drafts`` (slot -> draft-token count, from the
+        engine's drafter under ``spec_quota``) a decode-ready slot's
+        item becomes a VERIFY run of ``1 + drafts`` tokens, charged
+        against the same budget; its block growth (``Work.grow``) is
+        whatever the whole window needs and is pre-staged by the
+        engine's grow helper, so the in-step one-block growth stays a
+        no-op.
 
         Note: chunk writes land in pages assigned at admission and a
         shared prefix is whole blocks (suffixes start page-aligned), so
@@ -270,15 +384,21 @@ class Scheduler:
         work: List[Work] = []
         for slot in sorted(self.running):
             st = self.running[slot]
-            if st.prefilled >= len(st.req.prompt) and budget >= 1:
+            if self._decode_ready(st) and budget >= 1:
                 pos = st.tokens_in_cache
-                if (pos // self.block_size >= st.n_blocks
+                n = 1 + (spec_drafts.get(slot, 0) if spec_drafts else 0)
+                n = min(n, budget)
+                grow = 0
+                need_blocks = blocks_needed(pos + n, self.block_size)
+                while (st.n_blocks < need_blocks
                         and st.n_blocks < self.max_blocks_per_seq):
                     st.n_blocks += 1
                     self._take_block()
-                work.append(Work(slot=slot, kind="decode", start=pos, n=1))
-                st.tokens_in_cache = pos + 1
-                budget -= 1
+                    grow += 1
+                work.append(Work(slot=slot, kind="decode", start=pos, n=n,
+                                 grow=grow))
+                st.tokens_in_cache = pos + n
+                budget -= n
         for slot in sorted(self.running):
             st = self.running[slot]
             rem = len(st.req.prompt) - st.prefilled

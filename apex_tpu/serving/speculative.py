@@ -1,0 +1,435 @@
+"""Speculative-decoding drafters — propose K tokens, let the unified
+step verify them as one ragged run.
+
+Decode is memory-bandwidth-bound at serving batch sizes (the TPU serving
+comparison in PAPERS.md): every generated token re-reads the whole
+weight set for one row of useful work. Speculative decoding turns that
+into one weight-read per ``K + 1`` CANDIDATE tokens: a cheap drafter
+proposes K continuations, the target model scores all of them in a
+single call to the existing ragged multi-query paged-attention step
+(``query_len = K + 1`` — exactly the run shape PR 7's kernel already
+serves for prefill chunks), and greedy longest-prefix acceptance keeps
+the verified prefix plus one bonus token. Because every emitted token is
+the TARGET model's own greedy output at its position, speculative
+output is bitwise token-identical to non-speculative greedy decode for
+ANY drafter at ANY accept rate — the drafter only moves throughput,
+never content (the acceptance contract tests/L0/test_speculative.py
+pins).
+
+Three drafters behind one interface:
+
+- ``NgramDrafter`` — host-side self-drafting (prompt lookup): match the
+  request's trailing n-gram against its own earlier prompt+generated
+  tokens and propose what followed last time. Zero extra device work;
+  shines on extractive/repetitive continuations.
+- ``DraftModelDrafter`` — a small draft model with its OWN paged pool
+  sharing the engine's block machinery (same ``kv_cache`` ops, same
+  unified ``_step_body`` program, same mesh): the draft cache lazily
+  re-syncs to each slot's accepted context as a ragged chunk, then
+  autoregressively proposes K tokens, then rolls its lookahead back
+  with ``truncate_slots``. All device work flows through ONE jitted
+  draft step plus the grow/truncate/free helpers — one-compile, like
+  the engine's own programs.
+- ``StubDrafter`` — a forced-acceptance-profile oracle for tests and
+  the bench A/B rung: drafts the true greedy continuation for a fixed
+  fraction of each window and deliberately-wrong tokens for the rest,
+  so throughput can be measured at a synthetic accept rate while the
+  bitwise-output contract stays checkable.
+
+Engine protocol (serving/engine.py): ``bind(engine)`` once at
+construction; per step ``draft_batch([(slot, context, k), ...])`` with
+``context = prompt + generated`` (the accepted stream — rejected drafts
+never appear here); ``on_finish(slot)`` when a request retires;
+``reset()`` alongside ``ServingEngine.reset_state``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.serving import kv_cache as kc
+
+DraftItem = Tuple[int, List[int], int]         # (slot, context, max drafts)
+
+
+class Drafter:
+    """Interface every drafter implements. Drafts are PROPOSALS — the
+    engine's verify step decides what survives, so a drafter may return
+    fewer tokens than asked (or none) whenever it has no confident
+    continuation; over-long returns are truncated by the engine."""
+
+    def bind(self, engine) -> None:
+        """One-time attach to the engine (geometry, mesh). Host-only
+        drafters ignore it."""
+
+    def draft_batch(self, items: List[DraftItem]) -> Dict[int, List[int]]:
+        """Propose up to ``k`` tokens continuing ``context`` for every
+        ``(slot, context, k)`` item. Default: loop over ``draft``."""
+        return {slot: self.draft(slot, context, k)
+                for slot, context, k in items}
+
+    def draft(self, slot: int, context: List[int], k: int) -> List[int]:
+        raise NotImplementedError
+
+    def on_finish(self, slot: int) -> None:
+        """The request in ``slot`` retired (per-slot state can drop)."""
+
+    def reset(self) -> None:
+        """Forget everything (the engine cold-started)."""
+
+
+# ---------------------------------------------------------------------------
+# n-gram self-drafting (prompt lookup)
+# ---------------------------------------------------------------------------
+
+class NgramDrafter(Drafter):
+    """Prompt-lookup decoding: the continuation most likely to verify is
+    the one that followed the SAME trailing n-gram earlier in this very
+    request (system prompts quoted back, code identifiers, retrieved
+    passages). Tries the longest suffix n-gram first (``max_ngram``
+    down to ``min_ngram``), takes the MOST RECENT earlier occurrence,
+    and proposes the tokens that followed it.
+
+    Per-slot incremental index: a slot's context is append-only between
+    ``on_finish`` calls (the engine feeds the accepted stream), so each
+    n-gram length keeps a dict of ``n-gram -> position just after its
+    latest occurrence``, extended only over the NEW tail each call —
+    drafting is O(new tokens), not a rescan of the whole context on
+    every decode step. A context that shrinks or is replaced (off the
+    engine's contract, but legal through the public API) drops the
+    slot's index and rebuilds."""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"[{min_ngram}, {max_ngram}]")
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+        self._index: Dict[int, Dict[int, dict]] = {}  # slot -> n -> map
+        self._seen: Dict[int, int] = {}               # slot -> indexed len
+        self._tail: Dict[int, List[int]] = {}         # slot -> last tokens
+
+    def on_finish(self, slot: int) -> None:
+        self._index.pop(slot, None)
+        self._seen.pop(slot, None)
+        self._tail.pop(slot, None)
+
+    def reset(self) -> None:
+        self._index.clear()
+        self._seen.clear()
+        self._tail.clear()
+
+    def _catch_up(self, slot: int, context: List[int]) -> Dict[int, dict]:
+        seen = self._seen.get(slot, 0)
+        tail = self._tail.get(slot, [])
+        if seen > len(context) or context[seen - len(tail):seen] != tail:
+            # context shrank or was replaced (off the engine's
+            # append-only contract): drop the stale index and rebuild
+            self.on_finish(slot)
+            seen = 0
+        maps = self._index.setdefault(
+            slot, {n: {} for n in range(self.min_ngram,
+                                        self.max_ngram + 1)})
+        for n, m in maps.items():
+            # windows ENDING strictly before the tail (i + n < len), so
+            # the trailing n-gram never matches its own position; the
+            # ones the last call excluded re-index now that the tail
+            # moved. Later windows overwrite: the map always holds the
+            # most recent occurrence.
+            for i in range(max(0, seen - n), len(context) - n):
+                m[tuple(context[i:i + n])] = i + n
+        self._seen[slot] = len(context)
+        self._tail[slot] = list(context[max(0, len(context)
+                                            - self.max_ngram):])
+        return maps
+
+    def draft(self, slot: int, context: List[int], k: int) -> List[int]:
+        maps = self._catch_up(slot, context)
+        n_hi = min(self.max_ngram, len(context) - 1)
+        for n in range(n_hi, self.min_ngram - 1, -1):
+            pos = maps[n].get(tuple(context[-n:]))
+            if pos is not None:
+                return context[pos:pos + k]
+        return []
+
+
+# ---------------------------------------------------------------------------
+# forced-acceptance-profile stub (tests / bench A/B)
+# ---------------------------------------------------------------------------
+
+class StubDrafter(Drafter):
+    """Oracle drafter with a dialed-in accept rate: given each request's
+    TRUE greedy continuation (``targets``: ``(prompt, continuation)``
+    pairs — e.g. a spec-off run's outputs), drafts
+    ``floor(accept_rate * k)`` correct tokens and deliberately-wrong
+    ones for the rest of the window, so a bench rung measures
+    tokens-per-step at a FIXED synthetic accept profile while the
+    engine's bitwise-output contract stays fully exercised (wrong
+    drafts must be rejected, right ones accepted). A context matching
+    no target drafts nothing."""
+
+    def __init__(self, targets: Sequence[Tuple[Sequence[int],
+                                               Sequence[int]]],
+                 accept_rate: float, vocab_size: int):
+        if not 0.0 <= accept_rate <= 1.0:
+            raise ValueError(f"accept_rate {accept_rate} not in [0, 1]")
+        self.targets = [(list(p), list(c)) for p, c in targets]
+        self.accept_rate = accept_rate
+        self.vocab_size = int(vocab_size)
+
+    def draft(self, slot: int, context: List[int], k: int) -> List[int]:
+        for prompt, cont in self.targets:
+            full = prompt + cont
+            if (len(context) >= len(prompt)
+                    and context == full[:len(context)]):
+                true = full[len(context):len(context) + k]
+                good = int(self.accept_rate * len(true))
+                return (true[:good]
+                        + [(t + 1) % self.vocab_size for t in true[good:]])
+        return []
+
+
+# ---------------------------------------------------------------------------
+# draft-model path (its own paged pool, the engine's block machinery)
+# ---------------------------------------------------------------------------
+
+class DraftModelDrafter(Drafter):
+    """A small target-architecture model drafts autoregressively against
+    its OWN block-paged KV pool. Device work reuses the engine's exact
+    machinery: the same ``_step_body`` (ragged multi-query attention
+    over a ``PagedKVCache``) jitted ONCE on the engine's mesh, plus the
+    grow / truncate / free cache helpers. Per ``draft_batch`` call the
+    runner (1) pre-grows each slot's table to cover context + lookahead,
+    (2) catches the draft cache up to the accepted context as ragged
+    chunk runs (the last context row's greedy output IS the first
+    draft), (3) runs ``k - 1`` single-token decode rounds for the rest,
+    and (4) rolls the lookahead back with ``truncate_slots`` so the
+    cache ends every call holding exactly the accepted context — the
+    invariant that makes re-sync after the engine's own rollback free.
+
+    The draft model must cover the engine's position range plus the
+    draft window (``seq_len >= max_seq_len + spec_k``) and its KV heads
+    must divide the mesh's model axis, checked at ``bind``."""
+
+    def __init__(self, model_cfg, params, num_blocks: Optional[int] = None):
+        self.cfg = model_cfg
+        self.params = params
+        self._num_blocks = num_blocks
+        self._engine = None
+        self.trace_counts: Dict[str, int] = {
+            "draft_step": 0, "draft_grow": 0, "draft_truncate": 0,
+            "draft_free": 0}
+
+    # -- engine attach ----------------------------------------------
+    def bind(self, engine) -> None:
+        from apex_tpu.serving.engine import (
+            _check_supported, _step_body, counted_cache_op)
+        from apex_tpu.testing.commons import smap
+        from apex_tpu.testing.standalone_transformer import param_specs
+
+        cfg = self.cfg
+        _check_supported(cfg)
+        scfg = engine.scfg
+        mesh = engine.mesh
+        tp = mesh.shape.get("model", 1)
+        n_kv = cfg.kv_heads or cfg.heads
+        if n_kv % tp:
+            raise ValueError(
+                f"draft model kv heads {n_kv} not divisible by tp={tp}")
+        if scfg.max_seq_len + scfg.spec_k > cfg.seq_len:
+            raise ValueError(
+                f"draft model position range ({cfg.seq_len}) cannot cover "
+                f"max_seq_len {scfg.max_seq_len} + spec_k {scfg.spec_k} "
+                f"of lookahead")
+        self._engine = engine
+        self._bs = scfg.block_size
+        self._width = scfg.chunk_tokens
+        self._max_slots = scfg.max_slots
+        self._mbps = kc.blocks_needed(
+            scfg.max_seq_len + scfg.spec_k, self._bs)
+        self._pool = (self._num_blocks if self._num_blocks is not None
+                      else scfg.num_blocks)
+        self._layers = cfg.layers
+        self._kv_heads = n_kv
+        self._head_dim = cfg.head_dim
+        self._dtype = cfg.dtype
+
+        cspec = kc.cache_pspecs(tp_axis="model")
+        counts = self.trace_counts
+        opts = {"cfg": cfg, "scfg": {"tp": tp}}
+
+        def step(params, cache, tokens, qs, ql):
+            counts["draft_step"] += 1          # trace-time side effect
+            return _step_body(params, cache, tokens, qs, ql, **opts)
+
+        pspec = param_specs(cfg)
+        self._step = jax.jit(
+            smap(step, mesh, (pspec, cspec, P(), P(), P()), (cspec, P())),
+            donate_argnums=(1,))
+        self._grow = counted_cache_op(
+            counts, "draft_grow",
+            functools.partial(kc.grow_slots, max_grow=self._mbps),
+            mesh, cspec, 1)
+        self._truncate = counted_cache_op(
+            counts, "draft_truncate", kc.truncate_slots, mesh, cspec, 1)
+        self._free = counted_cache_op(
+            counts, "draft_free", kc.free_slot, mesh, cspec, 1)
+        self.reset()
+
+    def _fresh_cache(self) -> kc.PagedKVCache:
+        return kc.paged_kv_cache(
+            layers=self._layers, num_blocks=self._pool,
+            block_size=self._bs, n_kv_heads=self._kv_heads,
+            head_dim=self._head_dim, max_slots=self._max_slots,
+            max_blocks_per_seq=self._mbps, dtype=self._dtype)
+
+    # -- host state --------------------------------------------------
+    def reset(self) -> None:
+        if self._engine is None:
+            return
+        self._cache = self._fresh_cache()
+        self._synced: Dict[int, int] = {}      # slot -> resident tokens
+        self._blocks: Dict[int, int] = {}      # slot -> table entries
+        self._free_blocks = self._pool
+
+    def on_finish(self, slot: int) -> None:
+        if self._engine is None or slot not in self._synced:
+            return
+        self._cache = self._free(self._cache, jnp.int32(slot))
+        self._free_blocks += self._blocks.pop(slot, 0)
+        self._synced.pop(slot, None)
+
+    # -- the drafting loop -------------------------------------------
+    def _run(self, tokens: np.ndarray, qs: np.ndarray,
+             ql: np.ndarray) -> np.ndarray:
+        self._cache, nxt = self._step(
+            self.params, self._cache, jnp.asarray(tokens),
+            jnp.asarray(qs), jnp.asarray(ql))
+        return jax.device_get(nxt)
+
+    def draft_batch(self, items: List[DraftItem]) -> Dict[int, List[int]]:
+        if self._engine is None:
+            raise RuntimeError("DraftModelDrafter.bind was never called")
+        items = [(slot, list(ctx), k) for slot, ctx, k in items if k > 0]
+        if not items:
+            return {}
+        for slot, ctx, _k in items:
+            if self._synced.get(slot, 0) >= len(ctx):
+                raise RuntimeError(
+                    f"slot {slot}: draft context did not advance past the "
+                    f"synced length ({len(ctx)}) — the engine feeds the "
+                    f"accepted stream, which grows every verify step")
+        # 1. pre-grow every slot's table over context + lookahead (the
+        #    catch-up chunk may cross many page boundaries; in-step
+        #    growth then stays a no-op, as in the engine)
+        grow_row = np.zeros((self._max_slots,), np.int32)
+        total = 0
+        budget = self._free_blocks
+        kept: List[DraftItem] = []
+        for slot, ctx, k in items:
+            # the runner writes AT MOST len(ctx) + k - 1 positions (the
+            # catch-up chunk plus k-1 draft rounds — the k-th draft is
+            # returned, never appended), so grow for exactly that:
+            # growing for an unwritten position would leave a page the
+            # step-4 truncate cannot see (it derives the kept count from
+            # seq_lens, which never covers the phantom position) and
+            # desync the host mirror from the device refcounts.
+            # A full draft pool DEGRADES speculation (shallower windows,
+            # then no drafts for the slot) — drafts are proposals, so
+            # running out of draft pages must never crash serving; the
+            # engine pool prefix-shares and this one cannot, so it can
+            # legitimately run out first
+            have = self._blocks.get(slot, 0)
+            while k >= 1:
+                g = max(0, kc.blocks_needed(len(ctx) + k - 1, self._bs)
+                        - have)
+                if g <= budget:
+                    break
+                k -= 1
+            if k < 1:
+                continue           # not even the context fits: sit out
+            g = max(0, kc.blocks_needed(len(ctx) + k - 1, self._bs) - have)
+            budget -= g
+            grow_row[slot] = g
+            total += g
+            kept.append((slot, ctx, k))
+        items = kept
+        if not items:
+            return {}
+        for slot, _ctx, _k in items:
+            self._blocks[slot] = (self._blocks.get(slot, 0)
+                                  + int(grow_row[slot]))
+            self._synced.setdefault(slot, 0)
+        if total:
+            self._free_blocks -= total
+            self._cache = self._grow(self._cache, jnp.asarray(grow_row))
+
+        # 2. catch up to the accepted context (ragged chunks under the
+        #    fixed width); a slot's LAST context row emits draft 1
+        drafts: Dict[int, List[int]] = {slot: [] for slot, _, _ in items}
+        pending = {slot: self._synced[slot] for slot, _, _ in items}
+        while True:
+            tokens = np.zeros((self._width,), np.int32)
+            qs = np.zeros((self._max_slots,), np.int32)
+            ql = np.zeros((self._max_slots,), np.int32)
+            off = 0
+            tail: List[Tuple[int, int]] = []   # (slot, its last-row index)
+            for slot, ctx, _k in items:
+                done = pending[slot]
+                rem = len(ctx) - done
+                if rem <= 0 or off >= self._width:
+                    continue
+                n = min(rem, self._width - off)
+                tokens[off:off + n] = ctx[done:done + n]
+                qs[slot] = off
+                ql[slot] = n
+                pending[slot] = done + n
+                if done + n == len(ctx):
+                    tail.append((slot, off + n - 1))
+                off += n
+            if off == 0:
+                break
+            nxt = self._run(tokens, qs, ql)
+            for slot, row in tail:
+                drafts[slot].append(int(nxt[row]))
+
+        # 3. k-1 autoregressive rounds, all drafting slots packed ql=1
+        rounds = max(k for _, _, k in items)
+        for r in range(1, rounds):
+            tokens = np.zeros((self._width,), np.int32)
+            qs = np.zeros((self._max_slots,), np.int32)
+            ql = np.zeros((self._max_slots,), np.int32)
+            off = 0
+            live = [slot for slot, _ctx, k in items
+                    if k > r and len(drafts[slot]) == r]
+            if not live:
+                break
+            for slot in live:
+                tokens[off] = drafts[slot][-1]
+                qs[slot] = off
+                ql[slot] = 1
+                off += 1
+            nxt = self._run(tokens, qs, ql)
+            for i, slot in enumerate(live):
+                drafts[slot].append(int(nxt[qs[slot]]))
+
+        # 4. roll the lookahead back: the cache ends the call holding
+        #    exactly the accepted context (drafted rows' K/V dropped,
+        #    over-grown pages released) — rejected drafts then cost the
+        #    draft cache nothing next call
+        trunc = np.full((self._max_slots,), 2**31 - 1, np.int32)
+        for slot, ctx, _k in items:
+            trunc[slot] = len(ctx)
+            kept = kc.blocks_needed(len(ctx), self._bs)
+            self._free_blocks += self._blocks[slot] - kept
+            self._blocks[slot] = kept
+            self._synced[slot] = len(ctx)
+        self._cache = self._truncate(self._cache, jnp.asarray(trunc))
+        return {slot: drafts[slot][:k] for slot, _ctx, k in items}

@@ -32,9 +32,12 @@ _METRIC = "bert_large_amp_o2_fused_lamb_samples_per_sec_per_chip"
 # sweep (apex_tpu.tuning.autotune) instead of the step benchmark and write
 # the tune cache. --serving: run the inference-serving rung
 # (apex_tpu.serving continuous batching: decode steps/s + time-to-first-
-# token at a fixed request mix) instead of the training sweep; the serving
-# prefill/decode programs are ALSO dry-compiled by --compile-only as their
-# own rung. --moe: the MoE dispatch A/B rung — tokens/s of a full f+b
+# token at a fixed request mix, PLUS the shared-prefix warm-vs-cold A/B
+# and the speculative-decoding A/B at fixed synthetic acceptance
+# profiles) instead of the training sweep; the serving unified step is
+# ALSO dry-compiled by --compile-only as its own rung, and the
+# speculation-enabled engine (step + grow/truncate helpers) as a "spec"
+# rung. --moe: the MoE dispatch A/B rung — tokens/s of a full f+b
 # step over transformer.moe at a fixed (t, E, top_k, h, f) point, einsum
 # dispatch vs the sort-based grouped-matmul path (capacity parity mode
 # AND dropless), also dry-compiled by --compile-only as its own rung.
@@ -384,14 +387,17 @@ def _measure_with_timeout(step, args, iters, timeout_s):
     return box["result"], None
 
 
-def _serving_setup(on_cpu: bool):
+def _serving_setup(on_cpu: bool, spec: bool = False):
     """Engine + workload geometry for the serving rung. One definition
-    shared by the timed run (--serving) and the dry-compile gate."""
+    shared by the timed run (--serving) and the dry-compile gate; with
+    ``spec`` the SAME geometry comes back speculation-enabled (max draft
+    depth 4) for the spec A/B rung and its compile gate."""
     import jax.numpy as jnp  # noqa: F811 — bench defers jax-heavy imports
 
     from apex_tpu.serving import ServingConfig, ServingEngine
     from apex_tpu.testing import TransformerConfig, transformer_init
 
+    extra = {"spec": True, "spec_k": 4} if spec else {}
     if on_cpu:
         cfg = TransformerConfig(
             vocab_size=512, seq_len=128, hidden=128, layers=2, heads=4,
@@ -399,7 +405,7 @@ def _serving_setup(on_cpu: bool):
         )
         scfg = ServingConfig(model=cfg, num_blocks=128, block_size=8,
                              max_slots=4, max_prefill_len=32,
-                             max_seq_len=64)
+                             max_seq_len=64, **extra)
     else:
         # GPT-medium-class decode: big enough for a real HBM-bound decode
         # signal, small enough that prefill+decode compile inside the gate
@@ -408,7 +414,8 @@ def _serving_setup(on_cpu: bool):
             heads=16, causal=True, dtype=jnp.bfloat16,
         )
         scfg = ServingConfig(model=cfg, num_blocks=2048,
-                             max_prefill_len=512, max_seq_len=2048)
+                             max_prefill_len=512, max_seq_len=2048,
+                             **extra)
     params = transformer_init(jax.random.PRNGKey(0), cfg)
     return ServingEngine(scfg, params), cfg, scfg
 
@@ -492,6 +499,65 @@ def _serving_prefix_ab(on_cpu: bool, eng=None, cfg=None, scfg=None) -> dict:
     }
 
 
+def _serving_spec_ab(on_cpu: bool, params, cfg, scfg, reqs, base_tokens,
+                     base_stats) -> dict:
+    """Speculative decoding A/B at FIXED synthetic acceptance profiles:
+    the spec-off run's own outputs become a StubDrafter oracle dialed
+    to 50% and 100% accept, served through ONE spec-enabled engine
+    (max depth 4). The rung's number is decode tokens-per-step at the
+    50% profile (metric ``apex_tpu_serving_spec_tokens_per_step``) with
+    the spec-off tokens-per-step as the uplift denominator; ok requires
+    token identity at EVERY profile AND uplift > 1.0 — speculation that
+    changes output or loses throughput at a 50% accept rate is a
+    regression, not a result."""
+    import dataclasses
+
+    from apex_tpu.serving import Request, ServingEngine, StubDrafter
+
+    targets = [(r.prompt, base_tokens[r.rid]) for r in reqs]
+    eng = ServingEngine(dataclasses.replace(scfg, spec=True, spec_k=4),
+                        params)
+    base_tps = (base_stats["decode_tokens"]
+                / max(base_stats["decode_steps"], 1))
+    profiles = {}
+    identical = True
+    for prof in (0.5, 1.0):
+        eng.set_drafter(StubDrafter(targets, prof, cfg.vocab_size))
+        eng.reset_state()
+        out = eng.run([Request(rid=f"s{prof}-{r.rid}", prompt=r.prompt,
+                               max_new_tokens=r.max_new_tokens,
+                               arrival=r.arrival) for r in reqs])
+        st = out.pop(None)
+        same = all(out[f"s{prof}-{r.rid}"]["tokens"] == base_tokens[r.rid]
+                   for r in reqs)
+        identical = identical and same
+        tps = st["decode_tokens"] / max(st["decode_steps"], 1)
+        profiles[prof] = {
+            "tokens_per_step": round(tps, 3),
+            "uplift_vs_off": round(tps / max(base_tps, 1e-9), 3),
+            "accept_rate": round(
+                st["spec_accepted_tokens"]
+                / max(st["spec_drafted_tokens"], 1), 3),
+            "drafted": st["spec_drafted_tokens"],
+            "accepted": st["spec_accepted_tokens"],
+            "steps": st["steps"],
+            "tokens_identical": same,
+        }
+        _obs_gauge("bench/serving_spec_tokens_per_step", tps,
+                   profile=str(prof))
+    uplift = profiles[0.5]["uplift_vs_off"]
+    return {
+        "metric": "apex_tpu_serving_spec_tokens_per_step",
+        "value": profiles[0.5]["tokens_per_step"],
+        "ok": identical and uplift > 1.0,
+        "tokens_per_step_off": round(base_tps, 3),
+        "uplift_at_50pct": uplift,
+        "profiles": profiles,
+        "spec_k": 4,
+        "trace_counts": dict(eng.trace_counts),
+    }
+
+
 def _serving_payload(on_cpu: bool) -> dict:
     eng, cfg, scfg = _serving_setup(on_cpu)
     reqs = _serving_requests(cfg, scfg, on_cpu)
@@ -505,12 +571,16 @@ def _serving_payload(on_cpu: bool) -> dict:
     _obs_gauge("bench/serving_ttft_p95_s",
                ttfts[int(0.95 * (len(ttfts) - 1))])
     prefix_ab = _serving_prefix_ab(on_cpu, eng, cfg, scfg)
+    spec_ab = _serving_spec_ab(
+        on_cpu, eng.params, cfg, scfg, reqs,
+        {r.rid: out[r.rid]["tokens"] for r in reqs}, stats)
     return {
         "metric": _SERVING_METRIC,
         "value": round(decode_sps, 2),
         "unit": "decode_steps/sec",
         "vs_baseline": 0.0,
-        "ok": len(out) == len(reqs) and bool(prefix_ab["ok"]),
+        "ok": (len(out) == len(reqs) and bool(prefix_ab["ok"])
+               and bool(spec_ab["ok"])),
         "serving": True,
         "detail": {
             "decode_tokens_per_sec": round(
@@ -524,6 +594,7 @@ def _serving_payload(on_cpu: bool) -> dict:
             "decode_s": round(stats["decode_s"], 3),
             "trace_counts": stats["trace_counts"],
             "prefix_ab": prefix_ab,
+            "spec_ab": spec_ab,
             "config": {
                 "hidden": cfg.hidden, "layers": cfg.layers,
                 "heads": cfg.heads, "vocab": cfg.vocab_size,
@@ -570,6 +641,52 @@ def _serving_compile_rung(on_cpu: bool, timeout_s: float) -> dict:
         rung.update(ok=True, compile_s=round(t_total, 1))
     except Exception as e:  # noqa: BLE001 — a failing rung is data
         print(f"bench: compile-only rung serving: FAILED — marked skipped "
+              f"({type(e).__name__}: {str(e).splitlines()[0][:200]})",
+              file=sys.stderr, flush=True)
+        rung.update(ok=False, skipped=True,
+                    error=str(e).splitlines()[0][:200])
+    return rung
+
+
+def _spec_compile_rung(on_cpu: bool, timeout_s: float) -> dict:
+    """Dry-compile the SPECULATION-enabled serving engine: the unified
+    step (verify windows are run metadata, so this is the same program
+    the serving rung compiles — proving exactly that is the point) plus
+    the grow/truncate helpers only speculation touches."""
+    import jax.numpy as jnp  # noqa: F811
+
+    rung = {"rung": "spec", "batch": None, "remat": "spec"}
+    t_total = 0.0
+    try:
+        eng, cfg, scfg = _serving_setup(on_cpu, spec=True)
+        for name, step, args in (
+            ("step", eng._step,
+             (eng.params, eng.fresh_cache(),
+              jnp.zeros((scfg.chunk_tokens,), jnp.int32),
+              jnp.zeros((scfg.max_slots,), jnp.int32),
+              jnp.zeros((scfg.max_slots,), jnp.int32))),
+            ("grow", eng._grow,
+             (eng.fresh_cache(), jnp.zeros((scfg.max_slots,), jnp.int32))),
+            ("truncate", eng._truncate,
+             (eng.fresh_cache(),
+              jnp.zeros((scfg.max_slots,), jnp.int32))),
+        ):
+            compile_s, err = _compile_with_timeout(step, args, timeout_s)
+            if err is not None:
+                msg = ("compile hung" if err == "hung"
+                       else f"{type(err).__name__}: "
+                            f"{str(err).splitlines()[0][:200]}")
+                print(f"bench: compile-only rung spec/{name}: FAILED — "
+                      f"marked skipped ({msg})", file=sys.stderr,
+                      flush=True)
+                rung.update(ok=False, skipped=True, error=f"{name}: {msg}")
+                return rung
+            t_total += compile_s
+        print(f"bench: compile-only rung spec: OK ({t_total:.1f}s)",
+              file=sys.stderr, flush=True)
+        rung.update(ok=True, compile_s=round(t_total, 1))
+    except Exception as e:  # noqa: BLE001 — a failing rung is data
+        print(f"bench: compile-only rung spec: FAILED — marked skipped "
               f"({type(e).__name__}: {str(e).splitlines()[0][:200]})",
               file=sys.stderr, flush=True)
         rung.update(ok=False, skipped=True,
@@ -1165,6 +1282,7 @@ def main():
         # either costs seconds, not the measurement window
         gate_timeout = float(os.environ.get("BENCH_BATCH_TIMEOUT_S", "900"))
         compile_rungs.append(_serving_compile_rung(on_cpu, gate_timeout))
+        compile_rungs.append(_spec_compile_rung(on_cpu, gate_timeout))
         compile_rungs.extend(_moe_compile_rungs(on_cpu, gate_timeout))
         compile_rungs.append(_obs_compile_rung(on_cpu, gate_timeout))
         compile_rungs.append(_analysis_compile_rung())

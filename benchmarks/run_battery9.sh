@@ -83,6 +83,18 @@ run serving_bench 3600 '"ok": true' python bench.py --serving
 #      apex_tpu_serving_ttft_warm_vs_cold.)
 run prefix_cache  1800 'prefix leg: OK' \
                        python -c 'import __graft_entry__ as g; g.dryrun_prefix()'
+# 4c'' — speculative-decoding leg (speculative-decoding PR): the same
+#      staggered workload spec-off then spec-on (n-gram self-drafter +
+#      forced-acceptance stub) — greedy output bitwise identical in
+#      every configuration, 1 unified-step compile per engine, rollback
+#      refcount accounting exact. (The timed spec-on vs spec-off
+#      tokens-per-step A/B at fixed synthetic acceptance profiles rides
+#      the serving_bench item above as metric
+#      apex_tpu_serving_spec_tokens_per_step, and the spec-enabled
+#      engine dry-compiles in the overlap_gate compile-only item as its
+#      own "spec" rung.)
+run spec_bench    1800 'spec leg: OK' \
+                       python -c 'import __graft_entry__ as g; g.dryrun_spec()'
 # 4d — MoE dispatch A/B rung (dropless-MoE PR): tokens/s of the einsum
 #      [t,E,C] dispatch vs the sort-based grouped-matmul path (capacity
 #      parity mode AND dropless) at the fixed GPT-medium-class sweep

@@ -554,13 +554,13 @@ def test_apx203_silent_on_valid_ring():
 
 def test_default_entry_points_audit_clean():
     """The repo's own representative programs (train step, DDP/ZeRO
-    flushes, decomposed TP matmul, paged decode) pass all three
-    audits."""
+    flushes, decomposed TP matmul, paged decode, ragged speculative
+    verify) pass all three audits."""
     from apex_tpu.analysis.auditors import (audit_entry_points,
                                             default_entry_points)
 
     eps = default_entry_points()
-    assert len(eps) == 5
+    assert len(eps) == 6
     findings = audit_entry_points(eps)
     assert [f.format() for f in findings] == []
 
@@ -744,4 +744,4 @@ def test_self_run_is_clean():
     assert report["exit_code"] == 0
     assert report["errors"] == 0
     assert report["stats"]["lint_files"] > 40
-    assert report["stats"]["audited_entry_points"] == 5
+    assert report["stats"]["audited_entry_points"] == 6

@@ -277,6 +277,38 @@ def test_ragged_layouts_vs_oracle(case, qs, ql, kl):
             got[jnp.asarray(dead)].astype(jnp.float32)))) == 0.0
 
 
+@pytest.mark.parametrize("case,qs,ql,kl", [
+    # speculative verify windows (query_len = K + 1, the engine's
+    # spec-on run shape) — covered independently of the engine so the
+    # kernel's spec-window geometry is pinned at the kernel layer
+    ("verify_k1_all_slots", [0, 2, 4, 6], [2, 2, 2, 2],
+     [9, 2, 30, 17]),                      # every slot a K=1 window
+    ("verify_k3_all_slots", [0, 4, 8, 12], [4, 4, 4, 4],
+     [20, 4, 31, 12]),                     # K=3, one pure-prefill kv==ql
+    ("verify_k7_with_idle", [0, 8, 8, 16], [8, 0, 8, 8],
+     [25, 0, 8, 32]),                      # K=7 spans pages; idle slot
+    ("verify_mixed_decode_chunk", [0, 8, 9, 13], [8, 1, 4, 11],
+     [32, 30, 9, 11]),                     # K=7 + decode + K=3 + chunk
+])
+def test_ragged_verify_layouts_vs_oracle(case, qs, ql, kl):
+    """The speculative-decoding satellite grid: many slots at
+    query_len = K + 1 for K in {1, 3, 7}, mixed with ql = 1 decode rows
+    and a prompt chunk, kernel vs generalized oracle."""
+    args = _ragged_setup(slots=4, hq=4, hkv=2, d=64, nb=24, bs=8, maxb=4,
+                         qs=qs, ql=ql, kl=kl, dtype=jnp.float32,
+                         seed=sum(kl) + 17, tq=max(int(sum(ql)), 4))
+    got = ragged_paged_attention(*args, use_pallas=True)
+    ref = ragged_paged_attention_ref(*args)
+    assert _maxdiff(got, ref) < _TOL[jnp.float32], case
+    covered = np.zeros(args[0].shape[0], bool)
+    for s, n in zip(qs, ql):
+        covered[s:s + n] = True
+    dead = np.flatnonzero(~covered)
+    if dead.size:
+        assert float(jnp.max(jnp.abs(
+            got[jnp.asarray(dead)].astype(jnp.float32)))) == 0.0
+
+
 def test_ragged_decode_entry_equivalence():
     """The decode wrapper IS the ragged kernel at query_len == 1: both
     entries agree bitwise on the same cache."""

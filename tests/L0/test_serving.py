@@ -23,14 +23,17 @@ from apex_tpu.serving import (
     ServingEngine,
     alloc_decode_blocks,
     allocate_slot,
+    blocks_needed,
     check_invariants,
     cow_append,
     free_block_count,
     free_slot,
     greedy_reference,
+    grow_slots,
     paged_kv_cache,
     retain_blocks,
     share_prefix,
+    truncate_slots,
     write_prefill,
 )
 from apex_tpu.testing import TransformerConfig, transformer_init
@@ -168,6 +171,127 @@ def test_prefill_write_masks_pad_rows():
     # rows 5..7 (pad) must not have landed anywhere: the second block's
     # tail offsets stay zero
     np.testing.assert_array_equal(pool[:, tbl[1], 1:], 0.0)
+
+
+def test_grow_slots_assigns_fresh_blocks():
+    """The speculative pre-staging helper: counts[s] fresh pages land on
+    each slot's table tail (rc = 1, n_blocks advanced, seq_lens
+    untouched) so a K+1-token verify window never needs in-step
+    growth."""
+    c = _small_cache()
+    c = allocate_slot(c, 0, 1)
+    c = allocate_slot(c, 2, 1)
+    c2 = jax.jit(lambda cc, n: grow_slots(cc, n, max_grow=3))(
+        c, jnp.array([2, 0, 1]))
+    check_invariants(c2)
+    assert np.asarray(c2.n_blocks).tolist() == [3, 0, 2]
+    np.testing.assert_array_equal(np.asarray(c2.seq_lens),
+                                  np.asarray(c.seq_lens))
+    assert int(free_block_count(c2)) == 12 - 5
+    # grown entries are real, distinct, refcount-1 pages
+    tbl = np.asarray(c2.block_tables)
+    grown = list(tbl[0][1:3]) + [tbl[2][1]]
+    assert len(set(grown)) == 3
+    assert all(np.asarray(c2.refcount)[g] == 1 for g in grown)
+
+
+def test_truncate_slots_rollback_invariants():
+    """Satellite pin: truncate_slots after arbitrary accept/reject
+    patterns leaves the refcount accounting exact — including rollback
+    ACROSS a block boundary and rollback that drops a PREFIX-SHARED
+    block (the index's hold must survive; only this table's reference
+    drops)."""
+    c = _small_cache()                       # bs=4, 12 blocks, 3 slots
+    # slot 0: 3 blocks, 11 tokens -> roll back to 5 (crosses a boundary:
+    # blocks 2 and 3 release, block 2 is mid-page)
+    c = allocate_slot(c, 0, 3)
+    c = c._replace(seq_lens=c.seq_lens.at[0].set(11))
+    ids0 = np.asarray(c.block_tables)[0][:3].copy()
+    c = jax.jit(truncate_slots)(c, jnp.array([5, 2**31 - 1, 2**31 - 1]))
+    check_invariants(c)
+    assert int(c.seq_lens[0]) == 5 and int(c.n_blocks[0]) == 2
+    rc = np.asarray(c.refcount)
+    assert rc[ids0[2]] == 0                  # released past the boundary
+    assert rc[ids0[0]] == 1 and rc[ids0[1]] == 1
+    # idempotent: truncating to the current length changes nothing
+    c2 = truncate_slots(c, jnp.array([5, 2**31 - 1, 2**31 - 1]))
+    np.testing.assert_array_equal(np.asarray(c2.refcount), rc)
+
+    # slot 1 shares slot 0's first block via the index contract, then
+    # rolls back INTO the shared region: the shared page must stay
+    # resident (slot 0's table + the index hold survive)
+    shared = jnp.zeros((4,), jnp.int32).at[0].set(int(ids0[0]))
+    c = share_prefix(c, 1, shared, 1, 3)
+    c = retain_blocks(c, shared, 1)          # the index's own hold
+    c = c._replace(seq_lens=c.seq_lens.at[1].set(10))
+    ids1 = np.asarray(c.block_tables)[1][:3].copy()
+    check_invariants(c, index_refs={int(ids0[0]): 1})
+    c = jax.jit(truncate_slots)(c, jnp.array([2**31 - 1, 0, 2**31 - 1]))
+    check_invariants(c, index_refs={int(ids0[0]): 1})
+    rc = np.asarray(c.refcount)
+    assert int(c.n_blocks[1]) == 0 and int(c.seq_lens[1]) == 0
+    assert rc[ids0[0]] == 2                  # slot 0 + index: NOT freed
+    assert rc[ids1[1]] == 0 and rc[ids1[2]] == 0
+
+
+def test_truncate_slots_property_random_accept_patterns():
+    """Property-style: random speculative advance/rollback cycles over
+    shared and unshared slots keep ``check_invariants(...,
+    index_refs=...)`` clean at every step and never leak a block."""
+    rng = random.Random(23)
+    c = paged_kv_cache(1, 24, 4, 1, 8, 4, 6, jnp.float32)
+    lens = {}                                # slot -> tokens
+    index_hold = {}
+    # seed a shared prefix: slot 0 owns 2 blocks, the index holds both,
+    # slots 1/2 share them
+    c = allocate_slot(c, 0, 2)
+    ids = np.asarray(c.block_tables)[0][:2]
+    row = jnp.zeros((6,), jnp.int32).at[:2].set(jnp.asarray(ids))
+    c = retain_blocks(c, row, 2)
+    index_hold = {int(ids[0]): 1, int(ids[1]): 1}
+    lens[0] = 8
+    c = c._replace(seq_lens=c.seq_lens.at[0].set(8))
+    for s in (1, 2):
+        c = share_prefix(c, s, row, 2, 2)
+        lens[s] = 8
+    check_invariants(c, index_refs=index_hold)
+    for _ in range(40):
+        s = rng.randrange(4)
+        if s not in lens:
+            if int(free_block_count(c)) >= 1:
+                c = allocate_slot(c, s, 1)
+                lens[s] = rng.randint(1, 4)
+                c = c._replace(seq_lens=c.seq_lens.at[s].set(lens[s]))
+            continue
+        if rng.random() < 0.5:
+            # speculative advance: grow + extend by a window
+            k = rng.randint(1, 6)
+            if lens[s] + k > 6 * 4:          # slot capacity (mbps * bs)
+                continue
+            need = blocks_needed(lens[s] + k, 4) - int(c.n_blocks[s])
+            if need > int(free_block_count(c)):
+                continue
+            if need > 0:
+                counts = jnp.zeros((4,), jnp.int32).at[s].set(need)
+                c = grow_slots(c, counts, max_grow=3)
+            lens[s] += k
+            c = c._replace(seq_lens=c.seq_lens.at[s].set(lens[s]))
+        else:
+            # rollback to a random accepted prefix (never below the
+            # shared region for the sharing slots — the engine's case)
+            floor = 8 if s in (0, 1, 2) else 0
+            if lens[s] <= floor:
+                continue
+            new = rng.randint(floor, lens[s] - 1)
+            tr = jnp.full((4,), 2**31 - 1, jnp.int32).at[s].set(new)
+            c = truncate_slots(c, tr)
+            lens[s] = new
+        check_invariants(c, index_refs=index_hold)
+    # drain everything; only the index holds survive
+    for s in list(lens):
+        c = free_slot(c, s)
+    check_invariants(c, index_refs=index_hold)
+    assert int(free_block_count(c)) == 24 - 2
 
 
 def test_cache_fuzz_alloc_share_free_cycles():
@@ -574,6 +698,20 @@ def test_tp2_sharded_step_token_identical(engine):
         ref = greedy_reference(params, _CFG, r.prompt, r.max_new_tokens)
         assert cold[r.rid]["tokens"] == ref, (r.rid, "cold")
         assert warm[f"w{r.rid}"]["tokens"] == ref, (r.rid, "warm")
+
+
+def test_finish_fetches_one_table_row_not_whole_table(engine):
+    """Satellite pin: the per-finished-request host fetch slices the
+    block table on DEVICE first — the fetched array has the ROW's
+    shape, not the whole [max_slots, max_blocks_per_seq] table."""
+    eng, _ = engine
+    cache = eng.fresh_cache()
+    cache = allocate_slot(cache, 1, 3)
+    row = eng._table_row(cache, 1, 2)
+    assert isinstance(row, np.ndarray)
+    assert row.shape == (2,)                 # the row slice, nothing more
+    np.testing.assert_array_equal(
+        row, np.asarray(cache.block_tables)[1][:2])
 
 
 def test_failed_run_cold_starts_next_run(engine):

@@ -1,0 +1,448 @@
+"""Speculative decoding: drafter units + the engine acceptance pins.
+
+The correctness bar is the repo's standard one: speculative greedy
+output is BITWISE token-identical to non-speculative greedy (and to the
+unpaged ``greedy_reference`` loop) for every request at every
+acceptance profile — verification makes the drafter a pure throughput
+lever. Runs on the hermetic CPU mesh like test_serving.py; the
+heavyweight engines are module fixtures so each unified step compiles
+once.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.serving import (
+    DraftModelDrafter,
+    NgramDrafter,
+    Request,
+    ServingConfig,
+    ServingEngine,
+    StubDrafter,
+    check_invariants,
+    free_block_count,
+    greedy_reference,
+)
+from apex_tpu.testing import TransformerConfig, transformer_init
+
+_CFG = TransformerConfig(vocab_size=128, seq_len=64, hidden=32, layers=2,
+                         heads=4, causal=True)
+_GEOM = dict(num_blocks=96, block_size=4, max_slots=4, max_prefill_len=16,
+             max_seq_len=32)
+
+
+def _workload(n=16, seed=0, max_new=(3, 8)):
+    rng = np.random.RandomState(seed)
+    return [
+        Request(rid=i,
+                prompt=rng.randint(1, _CFG.vocab_size,
+                                   size=rng.randint(2, 12)).tolist(),
+                max_new_tokens=int(rng.randint(*max_new)),
+                arrival=int(i // 3))
+        for i in range(n)
+    ]
+
+
+def _requests(reqs, tag=""):
+    return [Request(rid=f"{tag}{r.rid}", prompt=r.prompt,
+                    max_new_tokens=r.max_new_tokens, arrival=r.arrival)
+            for r in reqs]
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    """Params + the spec-OFF outputs of the 16-request staggered mix,
+    cross-checked against the unpaged reference — the bitwise target
+    every speculative configuration must reproduce."""
+    params = transformer_init(jax.random.PRNGKey(0), _CFG)
+    eng_off = ServingEngine(ServingConfig(model=_CFG, **_GEOM), params)
+    reqs = _workload()
+    out = eng_off.run(_requests(reqs))
+    stats = out.pop(None)
+    tokens = {r.rid: out[f"{r.rid}"]["tokens"] for r in reqs}
+    for r in reqs[:4]:      # spot-check the baseline itself vs the oracle
+        assert tokens[r.rid] == greedy_reference(
+            params, _CFG, r.prompt, r.max_new_tokens)
+    return params, reqs, tokens, stats
+
+
+def _check_clean(eng, stats):
+    held = eng.index.held_ids() if eng.index is not None else {}
+    check_invariants(stats["cache"], index_refs=held)
+    assert int(free_block_count(stats["cache"])) == stats["free_blocks"]
+
+
+# ---------------------------------------------------------------------------
+# drafter units (pure host)
+# ---------------------------------------------------------------------------
+
+def test_ngram_drafter_prompt_lookup():
+    d = NgramDrafter(max_ngram=3, min_ngram=1)
+    #       0  1  2  3  4  5  6  7
+    ctx = [10, 20, 30, 40, 50, 20, 30, 40]
+    # suffix 3-gram (20,30,40) recurs at 1..3 -> propose what followed
+    assert d.draft(0, ctx, 2) == [50, 20]
+    assert d.draft(0, ctx, 8) == [50, 20, 30, 40]   # runs off the end
+    # no repeated n-gram at any length -> no proposal
+    assert d.draft(0, [1, 2, 3, 4], 4) == []
+    # the MOST RECENT earlier occurrence wins (1-gram fallback)
+    ctx2 = [7, 1, 7, 2, 7]
+    assert d.draft(0, ctx2, 1) == [2]
+    with pytest.raises(ValueError, match="min_ngram"):
+        NgramDrafter(max_ngram=2, min_ngram=3)
+
+
+def test_stub_drafter_profiles():
+    prompt, cont = [1, 2, 3], [10, 11, 12, 13, 14, 15]
+    full = StubDrafter([(prompt, cont)], 1.0, vocab_size=128)
+    assert full.draft(0, prompt, 4) == [10, 11, 12, 13]
+    assert full.draft(0, prompt + [10, 11], 3) == [12, 13, 14]
+    half = StubDrafter([(prompt, cont)], 0.5, vocab_size=128)
+    got = half.draft(0, prompt, 4)
+    assert got[:2] == [10, 11]                      # floor(0.5 * 4) right
+    assert got[2:] == [13, 14]                      # rest deliberately wrong
+    none = StubDrafter([(prompt, cont)], 0.0, vocab_size=128)
+    assert all(a != b for a, b in zip(none.draft(0, prompt, 4), cont))
+    # unknown context drafts nothing
+    assert full.draft(0, [9, 9, 9], 4) == []
+    with pytest.raises(ValueError, match="accept_rate"):
+        StubDrafter([], 1.5, vocab_size=128)
+
+
+def test_spec_env_knobs_and_validation(monkeypatch):
+    scfg = ServingConfig(model=_CFG, num_blocks=8)
+    assert scfg.spec is False and scfg.spec_k == 4   # default OFF
+    # a stray depth knob (even an invalid one) is IGNORED while
+    # speculation is off — it must not break plain serving construction
+    monkeypatch.setenv("APEX_TPU_SERVING_SPEC_K", "0")
+    scfg = ServingConfig(model=_CFG, num_blocks=8)
+    assert scfg.spec is False and scfg.spec_k == 4
+    monkeypatch.delenv("APEX_TPU_SERVING_SPEC_K")
+    monkeypatch.setenv("APEX_TPU_SERVING_SPEC", "1")
+    monkeypatch.setenv("APEX_TPU_SERVING_SPEC_K", "7")
+    scfg = ServingConfig(model=_CFG, num_blocks=8)
+    assert scfg.spec is True and scfg.spec_k == 7
+    # explicit arguments beat the env
+    scfg = ServingConfig(model=_CFG, num_blocks=8, spec=False, spec_k=2)
+    assert scfg.spec is False and scfg.spec_k == 2
+    # malformed values raise naming the variable (utils/envvars contract)
+    monkeypatch.setenv("APEX_TPU_SERVING_SPEC_K", "nope")
+    with pytest.raises(ValueError, match="APEX_TPU_SERVING_SPEC_K"):
+        ServingConfig(model=_CFG, num_blocks=8)
+    monkeypatch.delenv("APEX_TPU_SERVING_SPEC_K")
+    with pytest.raises(ValueError, match="spec_k"):
+        ServingConfig(model=_CFG, num_blocks=8, spec_k=0)
+
+
+def test_spec_quota_respects_budget_headroom_and_pool():
+    """The quota caps the draft ask three ways: the step budget, the
+    request's remaining emit allowance, and the FREE pool — the
+    admission watermark only reserves single-token growth, so a window
+    whose pages would not fit shrinks instead of underflowing."""
+    from apex_tpu.serving import Scheduler
+
+    sched = Scheduler(max_slots=1, num_blocks=3, block_size=2,
+                      max_blocks_per_seq=8, watermark=0, chunk_tokens=8,
+                      spec_k=6)
+    sched.add(Request(rid=0, prompt=[1, 2], max_new_tokens=12))
+    sched.tick(0)
+    sched.admit()
+    sched.plan_step()                        # the 2-token prefill chunk
+    # pool: 3 blocks, 1 held -> 2 free; a 1+k window from position 2
+    # grows ceil((3+k)/2) - 1 pages, so k caps at 3 (depth 6 shrinks)
+    assert sched.spec_quota() == {0: 3}
+    # emit headroom caps harder than the pool when the request is short
+    sched2 = Scheduler(max_slots=1, num_blocks=64, block_size=2,
+                       max_blocks_per_seq=8, watermark=0, chunk_tokens=8,
+                       spec_k=6)
+    sched2.add(Request(rid=0, prompt=[1, 2], max_new_tokens=3))
+    sched2.tick(0)
+    sched2.admit()
+    sched2.plan_step()
+    assert sched2.spec_quota() == {0: 2}     # 3 to emit, 1 already pending
+
+
+def test_spec_quota_reserves_budget_for_pending_chunks():
+    """Speculation must not starve mid-prefill slots: while prompt
+    chunks are pending, verify windows may take at most half the
+    leftover budget, so queued prompts keep advancing every step."""
+    from apex_tpu.serving import Scheduler
+
+    sched = Scheduler(max_slots=3, num_blocks=64, block_size=4,
+                      max_blocks_per_seq=8, watermark=0, chunk_tokens=8,
+                      spec_k=6)
+    sched.add(Request(rid=0, prompt=[1], max_new_tokens=8))
+    sched.add(Request(rid=1, prompt=[2], max_new_tokens=8))
+    sched.add(Request(rid=2, prompt=[3] * 20, max_new_tokens=2))
+    sched.tick(0)
+    sched.admit()
+    sched.plan_step()        # slots 0/1 complete their prompts; 2 chunks
+    quota = sched.spec_quota()
+    # spare = 8 - 2 ready; half (3) reserved for slot 2's pending chunk
+    assert sum(quota.values()) <= 3
+    work = sched.plan_step(dict(quota))
+    assert sum(w.n for w in work if w.kind == "chunk") >= 3
+
+
+def test_drafter_without_spec_rejected(baseline):
+    params, _, _, _ = baseline
+    with pytest.raises(ValueError, match="spec"):
+        ServingEngine(ServingConfig(model=_CFG, **_GEOM), params,
+                      drafter=NgramDrafter())
+
+
+# ---------------------------------------------------------------------------
+# the engine acceptance pins
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def spec_engine(baseline):
+    params, _, _, _ = baseline
+    scfg = ServingConfig(model=_CFG, spec=True, spec_k=3, **_GEOM)
+    return ServingEngine(scfg, params)
+
+
+def test_spec_ngram_16_requests_bitwise_and_one_compile(baseline,
+                                                        spec_engine):
+    """The tentpole pin: the 16-request staggered mix under the n-gram
+    self-drafter is bitwise token-identical to the spec-off engine, the
+    unified step still traces exactly ONCE (verify windows are just
+    ragged runs of the same program), and the refcount accounting —
+    including every speculative rollback — ends exact."""
+    _, reqs, tokens, off_stats = baseline
+    out = spec_engine.run(_requests(reqs, "s"))
+    stats = out.pop(None)
+    assert stats["trace_counts"]["step"] == 1, stats["trace_counts"]
+    assert all(v <= 1 for v in stats["trace_counts"].values()), (
+        stats["trace_counts"])
+    assert stats["spec_drafted_tokens"] > 0
+    for r in reqs:
+        assert out[f"s{r.rid}"]["tokens"] == tokens[r.rid], r.rid
+    _check_clean(spec_engine, stats)
+    # spec-off stats carry the speculation keys at zero
+    assert off_stats["spec_drafted_tokens"] == 0
+    assert off_stats["trace_counts"]["grow"] == 0
+    assert off_stats["trace_counts"]["truncate"] == 0
+
+
+def test_spec_stub_profiles_bitwise(baseline, spec_engine):
+    """Forced acceptance profiles 0 / 0.5 / 1.0 through the SAME
+    compiled engine (the drafter is host state): outputs stay bitwise
+    identical at every profile, the accept counters track the profile,
+    and full acceptance finishes the workload in fewer steps than full
+    rejection."""
+    params, reqs, tokens, _ = baseline
+    targets = [(r.prompt, tokens[r.rid]) for r in reqs]
+    saved = spec_engine.drafter
+    steps = {}
+    try:
+        for prof in (0.0, 0.5, 1.0):
+            spec_engine.set_drafter(StubDrafter(targets, prof,
+                                                 _CFG.vocab_size))
+            out = spec_engine.run(_requests(reqs, f"p{prof}-"))
+            stats = out.pop(None)
+            for r in reqs:
+                assert out[f"p{prof}-{r.rid}"]["tokens"] == \
+                    tokens[r.rid], (prof, r.rid)
+            assert stats["trace_counts"]["step"] == 1
+            assert stats["spec_drafted_tokens"] > 0
+            if prof == 0.0:
+                assert stats["spec_accepted_tokens"] == 0
+            if prof == 1.0:
+                assert (stats["spec_accepted_tokens"]
+                        == stats["spec_drafted_tokens"])
+            steps[prof] = stats["steps"]
+            _check_clean(spec_engine, stats)
+    finally:
+        spec_engine.set_drafter(saved)
+    assert steps[1.0] < steps[0.0]
+
+
+def test_spec_off_step_program_identical(baseline):
+    """The HLO pin behind "APEX_TPU_SERVING_SPEC unset leaves the engine
+    byte-for-byte on today's path": speculation never touches the
+    unified step — a spec-on and a spec-off engine LOWER the very same
+    step program (verify windows are run metadata, growth is pre-staged
+    by a separate helper). Engine construction does not compile, so
+    this costs two lowerings, not two compiles."""
+    params, _, _, _ = baseline
+    eng_off = ServingEngine(ServingConfig(model=_CFG, **_GEOM), params)
+    eng_on = ServingEngine(
+        ServingConfig(model=_CFG, spec=True, spec_k=3, **_GEOM), params)
+    cache_args = lambda eng: (  # noqa: E731
+        params, eng.fresh_cache(),
+        jnp.zeros((eng.scfg.chunk_tokens,), jnp.int32),
+        jnp.zeros((eng.scfg.max_slots,), jnp.int32),
+        jnp.zeros((eng.scfg.max_slots,), jnp.int32))
+    hlo_off = eng_off._step.lower(*cache_args(eng_off)).as_text()
+    hlo_on = eng_on._step.lower(*cache_args(eng_on)).as_text()
+    assert hlo_off == hlo_on
+    assert eng_off.drafter is None and eng_on.drafter is not None
+
+
+def test_spec_tp2_bitwise(baseline):
+    """2-device TP-sharded speculative serving: the 16-request mix under
+    the n-gram drafter is token-identical to the single-device spec-off
+    outputs (vocab-parallel greedy + ragged verify windows compose)."""
+    from jax.sharding import Mesh
+
+    params, reqs, tokens, _ = baseline
+    devs = jax.devices("cpu")
+    assert len(devs) >= 2
+    mesh = Mesh(np.array(devs[:2]), ("model",))
+    scfg = ServingConfig(model=_CFG, spec=True, spec_k=3, **_GEOM)
+    eng = ServingEngine(scfg, params, mesh=mesh)
+    out = eng.run(_requests(reqs, "t"))
+    stats = out.pop(None)
+    assert stats["trace_counts"]["step"] == 1
+    assert stats["spec_drafted_tokens"] > 0
+    for r in reqs:
+        assert out[f"t{r.rid}"]["tokens"] == tokens[r.rid], r.rid
+    _check_clean(eng, stats)
+
+
+def test_draft_model_path_bitwise_and_one_compile(baseline):
+    """The draft-model drafter: a 1-layer draft over its OWN paged pool
+    drafts through one jitted draft step; outputs stay bitwise
+    identical, and a second run through the same engine retraces
+    NOTHING (engine or draft runner)."""
+    params, reqs, tokens, _ = baseline
+    dcfg = TransformerConfig(vocab_size=_CFG.vocab_size, seq_len=64,
+                             hidden=16, layers=1, heads=2, causal=True)
+    dparams = transformer_init(jax.random.PRNGKey(7), dcfg)
+    drafter = DraftModelDrafter(dcfg, dparams)
+    scfg = ServingConfig(model=_CFG, spec=True, spec_k=3, **_GEOM)
+    eng = ServingEngine(scfg, params, drafter=drafter)
+    sub = reqs[:8]
+    out = eng.run(_requests(sub, "d"))
+    stats = out.pop(None)
+    assert stats["trace_counts"]["step"] == 1
+    assert stats["spec_drafted_tokens"] > 0
+    for r in sub:
+        assert out[f"d{r.rid}"]["tokens"] == tokens[r.rid], r.rid
+    assert all(v == 1 for v in drafter.trace_counts.values()), (
+        drafter.trace_counts)
+    _check_clean(eng, stats)
+    before = dict(eng.trace_counts)
+    dbefore = dict(drafter.trace_counts)
+    out2 = eng.run(_requests(sub, "d2"))
+    out2.pop(None)
+    assert eng.trace_counts == before
+    assert drafter.trace_counts == dbefore
+    for r in sub:
+        assert out2[f"d2{r.rid}"]["tokens"] == tokens[r.rid], r.rid
+
+
+def test_draft_model_block_mirror_exact_at_boundary_k1(baseline):
+    """Regression: a depth-1 draft ask at a block-aligned context writes
+    NO lookahead position, so the post-draft truncate is a device no-op
+    — the runner must not pre-grow (and then host-free) a page the
+    device would keep, or the host block mirror desyncs from the device
+    refcounts and a later grow can clobber a live page."""
+    params, _, _, _ = baseline
+    dcfg = TransformerConfig(vocab_size=_CFG.vocab_size, seq_len=64,
+                             hidden=16, layers=1, heads=2, causal=True)
+    dparams = transformer_init(jax.random.PRNGKey(7), dcfg)
+    drafter = DraftModelDrafter(dcfg, dparams)
+    scfg = ServingConfig(model=_CFG, spec=True, spec_k=3, **_GEOM)
+    ServingEngine(scfg, params, drafter=drafter)   # bind only
+    bs = scfg.block_size
+    ctx = list(range(1, 2 * bs + 1))               # exactly 2 full blocks
+    got = drafter.draft_batch([(0, ctx, 1)])
+    assert len(got[0]) == 1
+    # host mirror == device truth, block for block
+    assert drafter._blocks[0] == int(drafter._cache.n_blocks[0])
+    assert drafter._free_blocks == int(free_block_count(drafter._cache))
+    check_invariants(drafter._cache)
+    # and again after the context advances past the boundary
+    got = drafter.draft_batch([(0, ctx + [7], 2)])
+    assert len(got[0]) == 2
+    assert drafter._blocks[0] == int(drafter._cache.n_blocks[0])
+    assert drafter._free_blocks == int(free_block_count(drafter._cache))
+    check_invariants(drafter._cache)
+
+
+def test_draft_model_pool_exhaustion_degrades(baseline):
+    """A too-small draft pool DEGRADES speculation (shallower windows,
+    then slots sitting out) — drafts are proposals, so running out of
+    draft pages must never crash serving, and outputs stay bitwise
+    identical regardless of how little got drafted."""
+    params, reqs, tokens, _ = baseline
+    dcfg = TransformerConfig(vocab_size=_CFG.vocab_size, seq_len=64,
+                             hidden=16, layers=1, heads=2, causal=True)
+    dparams = transformer_init(jax.random.PRNGKey(7), dcfg)
+    drafter = DraftModelDrafter(dcfg, dparams, num_blocks=4)
+    scfg = ServingConfig(model=_CFG, spec=True, spec_k=3, **_GEOM)
+    eng = ServingEngine(scfg, params, drafter=drafter)
+    sub = reqs[:6]
+    out = eng.run(_requests(sub, "x"))
+    stats = out.pop(None)
+    for r in sub:
+        assert out[f"x{r.rid}"]["tokens"] == tokens[r.rid], r.rid
+    # the tiny pool really did constrain drafting, and the mirror held
+    assert drafter._free_blocks >= 0
+    assert drafter._free_blocks == int(free_block_count(drafter._cache))
+    _check_clean(eng, stats)
+
+
+def test_draft_model_position_range_validated(baseline):
+    """A draft model whose RoPE/position table cannot cover
+    max_seq_len + spec_k of lookahead is rejected at bind."""
+    params, _, _, _ = baseline
+    dcfg = TransformerConfig(vocab_size=_CFG.vocab_size, seq_len=32,
+                             hidden=16, layers=1, heads=2, causal=True)
+    dparams = transformer_init(jax.random.PRNGKey(7), dcfg)
+    scfg = ServingConfig(model=_CFG, spec=True, spec_k=3, **_GEOM)
+    with pytest.raises(ValueError, match="position range"):
+        ServingEngine(scfg, params,
+                      drafter=DraftModelDrafter(dcfg, dparams))
+
+
+def test_spec_eos_inside_window_finishes_early(baseline):
+    """An eos accepted mid-window must end the request AT the eos — the
+    rest of the verified window is discarded, its cache positions roll
+    away with the freed slot. The prompt is chosen so the greedy
+    continuation changes value at position 5; a depth-6 window then
+    covers the eos strictly inside the accepted run."""
+    params, _, _, _ = baseline
+    prompt = [1, 9, 17, 25]
+    ref = greedy_reference(params, _CFG, prompt, 8)
+    eos = ref[5]
+    if eos in ref[:5]:
+        pytest.skip("greedy continuation repeats the eos token early")
+    scfg = ServingConfig(model=_CFG, spec=True, spec_k=6, eos_id=int(eos),
+                         **_GEOM)
+    eng = ServingEngine(
+        scfg, params,
+        drafter=StubDrafter([(prompt, ref)], 1.0, _CFG.vocab_size))
+    out = eng.run([Request(rid="e", prompt=prompt, max_new_tokens=8)])
+    stats = out.pop(None)
+    assert out["e"]["tokens"] == ref[:6]          # cut at eos inclusive
+    assert stats["spec_accepted_tokens"] >= 5     # eos sat mid-window
+    _check_clean(eng, stats)
+
+
+def test_spec_metrics_counters_and_histogram(baseline, spec_engine,
+                                             monkeypatch):
+    """serving/spec_drafted_tokens + spec_accepted_tokens counters and
+    the accept-rate histogram land in the registry (host-side — the
+    compiled step untouched, same contract as every serving metric)."""
+    from apex_tpu.observability import default_registry
+
+    _, reqs, _, _ = baseline
+    monkeypatch.setenv("APEX_TPU_METRICS_SINK", "memory")
+    reg = default_registry()
+    reg.reset()
+    try:
+        out = spec_engine.run(_requests(reqs[:6], "m"))
+        stats = out.pop(None)
+        assert stats["spec_drafted_tokens"] > 0
+        assert (reg.counter("serving/spec_drafted_tokens").value()
+                == stats["spec_drafted_tokens"])
+        assert (reg.counter("serving/spec_accepted_tokens").value()
+                == stats["spec_accepted_tokens"])
+        assert reg.histogram("serving/spec_accept_rate").count() > 0
+    finally:
+        reg.reset()
