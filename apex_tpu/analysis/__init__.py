@@ -1,12 +1,12 @@
 """apex_tpu.analysis — static correctness tooling for the library itself.
 
-Three layers, one finding vocabulary, one CLI
+Five layers, one finding vocabulary, one CLI
 (``python -m apex_tpu.analysis``):
 
 * :mod:`apex_tpu.analysis.lint` — AST trace-hygiene linter (APX1xx):
   env reads frozen at import, ad-hoc env parsing, host syncs in jitted
   code, decorators without ``functools.wraps``, truthiness on traced
-  values.
+  values, late-binding index-map closures.
 * :mod:`apex_tpu.analysis.auditors` — jaxpr auditors (APX2xx): donated
   buffers referenced after donation, argument-signature drift that
   retraces, collective/axis consistency over shard_map programs.
@@ -14,6 +14,15 @@ Three layers, one finding vocabulary, one CLI
   BlockSpec/grid divisibility, VMEM budgets, index-map bounds at grid
   corners, and the grouped-matmul revisit-chain replay — over every
   registered tunable family's full candidate space.
+* :mod:`apex_tpu.analysis.memory` — static peak-HBM/liveness estimator
+  (APX4xx): donation- and sharding-aware per-equation live-set bytes
+  over every entry point, with :func:`estimate_peak_hbm` as the public
+  API the auto-parallelism planner scores configurations with
+  (re-exported by ``tuning/cost_model.py``).
+* :mod:`apex_tpu.analysis.spmd` — SPMD collective-consistency /
+  deadlock checker (APX5xx): per-control-flow-path collective
+  sequences, axis_index-divergent branches, ppermute pairing and
+  pipeline-phase consistency over the stage ring.
 
 The analyzer is **self-hosted**: a tier-1 test runs it over the package
 and pins zero unsuppressed findings, so the suite lints every future PR.
@@ -23,5 +32,6 @@ a comment saying why). See docs/analysis.md for the rule catalog.
 
 from apex_tpu.analysis.findings import Finding, Rule, RULES  # noqa: F401
 from apex_tpu.analysis.cli import run  # noqa: F401
+from apex_tpu.analysis.memory import estimate_peak_hbm  # noqa: F401
 
-__all__ = ["Finding", "Rule", "RULES", "run"]
+__all__ = ["Finding", "Rule", "RULES", "run", "estimate_peak_hbm"]

@@ -1,0 +1,66 @@
+"""Shared jaxpr-walk helpers for the auditor/memory/spmd layers.
+
+Three walkers (auditors.py, memory.py, spmd.py) traverse the same
+equation tree with the same binding conventions; the conventions encode
+subtle jax facts, so they live in exactly one place:
+
+* :func:`sub_jaxprs` — every sub-jaxpr riding an equation's params
+  (ClosedJaxpr unwrapped, branch tuples flattened), keyed for site
+  strings.
+* :func:`align_right` — how outer operands map onto a sub-jaxpr's
+  invars: positionally from the right, which is exact for ``pjit``
+  (1:1), ``scan`` (consts+carry+xs), ``cond`` branches (the predicate
+  is dropped from the left) and ``while`` body jaxprs (cond_nconsts
+  dropped from the left); ``while`` *cond* jaxprs lose their
+  cond_consts alignment — the documented approximation.
+* :func:`axes_of` — the axis names of a collective equation, whichever
+  param spelling the primitive uses.
+* :func:`is_literal` — Literal operands (no buffer, no liveness).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+__all__ = ["axes_of", "is_literal", "sub_jaxprs", "align_right"]
+
+
+def axes_of(eqn) -> Tuple[str, ...]:
+    """Axis names a collective equation operates over (``axes`` /
+    ``axis_name`` / ``axis``, scalar or tuple)."""
+    for key in ("axes", "axis_name", "axis"):
+        v = eqn.params.get(key)
+        if v is None:
+            continue
+        if isinstance(v, (tuple, list)):
+            return tuple(a for a in v if isinstance(a, str))
+        if isinstance(v, str):
+            return (v,)
+    return ()
+
+
+def is_literal(v) -> bool:
+    import jax.core as _core  # Literal lives here across 0.4.x
+
+    return isinstance(v, getattr(_core, "Literal", ()))
+
+
+def sub_jaxprs(eqn):
+    """(key, raw Jaxpr) for every sub-jaxpr riding the equation params —
+    ClosedJaxpr unwrapped, tuple-valued params (cond branches) indexed."""
+    for key, val in eqn.params.items():
+        vals = val if isinstance(val, (list, tuple)) else (val,)
+        for i, v in enumerate(vals):
+            if hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+                yield f"{key}[{i}]", v.jaxpr
+            elif hasattr(v, "eqns"):
+                yield f"{key}[{i}]", v
+
+
+def align_right(outer: Sequence, inner_n: int) -> List:
+    """Map per-operand values onto ``inner_n`` sub-jaxpr invars the way
+    jax binds them (see module doc): right-aligned, padded with None."""
+    outer = list(outer)
+    if len(outer) >= inner_n:
+        return outer[len(outer) - inner_n:]
+    return [None] * (inner_n - len(outer)) + outer

@@ -31,8 +31,13 @@ Three checks:
 
 Entry points are :class:`EntryPoint` records; :func:`default_entry_points`
 builds the repo's representative set (train step, DDP bucket flush, ZeRO
-scatter flush, decomposed TP matmul, serving paged decode) sized to
-trace in well under a minute on CPU.
+scatter flush, decomposed TP matmul, serving paged decode, ragged
+speculative verify, the unified serving step, and the pipeline-parallel
+1F1B + interleaved train steps on a pp=2 stage ring) sized to trace in
+well under a minute on CPU. The same traced jaxprs feed the memory
+estimator (analysis/memory.py) and the SPMD checker (analysis/spmd.py)
+— :func:`trace_entry` is the share point, so each entry traces once per
+run however many layers consume it.
 """
 
 from __future__ import annotations
@@ -40,11 +45,13 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from apex_tpu.analysis._jaxpr import axes_of as _axes_of
+from apex_tpu.analysis._jaxpr import sub_jaxprs as _keyed_sub_jaxprs
 from apex_tpu.analysis.findings import Finding
 
 __all__ = ["EntryPoint", "audit_entry_point", "audit_entry_points",
            "audit_donation", "audit_signature_drift", "audit_collectives",
-           "default_entry_points"]
+           "default_entry_points", "trace_entry"]
 
 _COLLECTIVES = {"psum", "ppermute", "pbroadcast", "all_gather",
                 "all_to_all", "reduce_scatter", "psum_scatter", "pmax",
@@ -56,17 +63,31 @@ class EntryPoint:
     """One auditable program: ``fn(*args())`` must trace under
     ``jax.make_jaxpr``. ``args_variant`` (optional) is the "step N"
     argument builder for the drift check; ``axis_sizes`` the mesh axes
-    the program may legally name."""
+    the program may legally name; ``specs`` (optional) a PartitionSpec
+    tree for the arguments (prefix trees welcome) — the memory
+    estimator divides the argument avals by their shard factors so its
+    peak is a per-device number."""
 
     name: str
     fn: Callable
     args: Callable[[], tuple]
     args_variant: Optional[Callable[[], tuple]] = None
     axis_sizes: Dict[str, int] = field(default_factory=dict)
+    specs: Optional[tuple] = None
 
     @property
     def tag(self) -> str:
         return f"<audit:{self.name}>"
+
+
+def trace_entry(ep: EntryPoint):
+    """Trace one entry point once: (ClosedJaxpr, the args it was traced
+    with). The CLI calls this and hands the jaxpr to every enabled
+    layer (auditors / memory / spmd) so an entry never re-traces."""
+    import jax
+
+    args0 = ep.args()
+    return jax.make_jaxpr(ep.fn)(*args0), args0
 
 
 # ---------------------------------------------------------------------------
@@ -147,13 +168,8 @@ def audit_signature_drift(fn, args0: tuple, args1: tuple, tag: str,
 # ---------------------------------------------------------------------------
 
 def _sub_jaxprs(eqn):
-    for key, val in eqn.params.items():
-        vals = val if isinstance(val, (list, tuple)) else (val,)
-        for v in vals:
-            if hasattr(v, "jaxpr"):        # ClosedJaxpr
-                yield v.jaxpr
-            elif hasattr(v, "eqns"):       # raw Jaxpr
-                yield v
+    for _key, sub in _keyed_sub_jaxprs(eqn):
+        yield sub
 
 
 def _walk_eqns(jaxpr, axis_sizes: Dict[str, int], out: list):
@@ -174,18 +190,6 @@ def _walk_eqns(jaxpr, axis_sizes: Dict[str, int], out: list):
                 pass
         for sub in _sub_jaxprs(eqn):
             _walk_eqns(sub, scope, out)
-
-
-def _axes_of(eqn) -> Tuple[str, ...]:
-    for key in ("axes", "axis_name", "axis"):
-        v = eqn.params.get(key)
-        if v is None:
-            continue
-        if isinstance(v, (tuple, list)):
-            return tuple(a for a in v if isinstance(a, str))
-        if isinstance(v, str):
-            return (v,)
-    return ()
 
 
 def audit_collectives(closed_jaxpr, axis_sizes: Dict[str, int],
@@ -227,18 +231,20 @@ def audit_collectives(closed_jaxpr, axis_sizes: Dict[str, int],
 # entry-point driver
 # ---------------------------------------------------------------------------
 
-def audit_entry_point(ep: EntryPoint) -> List[Finding]:
-    import jax
-
+def audit_entry_point(ep: EntryPoint, closed=None, args0=None
+                      ) -> List[Finding]:
+    """``closed``/``args0`` (optional) are a pre-traced jaxpr and the
+    args it was traced with — pass :func:`trace_entry`'s result to skip
+    the re-trace."""
     findings: List[Finding] = []
-    try:
-        args0 = ep.args()
-        closed = jax.make_jaxpr(ep.fn)(*args0)
-    except Exception as e:  # noqa: BLE001 — a broken entry point is data
-        findings.append(Finding(
-            "APX202", ep.tag, 0,
-            f"entry point failed to trace: {type(e).__name__}: {e}"))
-        return findings
+    if closed is None:
+        try:
+            closed, args0 = trace_entry(ep)
+        except Exception as e:  # noqa: BLE001 — a broken entry point is data
+            findings.append(Finding(
+                "APX202", ep.tag, 0,
+                f"entry point failed to trace: {type(e).__name__}: {e}"))
+            return findings
     findings.extend(audit_donation(closed, ep.tag))
     findings.extend(audit_collectives(closed, ep.axis_sizes, ep.tag))
     if ep.args_variant is not None:
@@ -400,5 +406,105 @@ def default_entry_points() -> List[EntryPoint]:
     eps.append(EntryPoint(
         name="serving_ragged_verify", fn=jax.jit(verify),
         args=_verify_args, args_variant=_verify_args))
+
+    # -- 7. the unified serving step: cow_append + extend_slots +
+    #       per-layer KV append + ragged multi-query attention +
+    #       vocab-parallel greedy, donated cache — the ONE compiled
+    #       program the engine runs (prefill chunks, decodes and spec
+    #       verify windows are all run metadata of this step)
+    from apex_tpu.serving import kv_cache as kc
+    from apex_tpu.serving.engine import _step_body
+
+    sv_cfg = TransformerConfig(vocab_size=64, seq_len=32, hidden=32,
+                               layers=1, heads=2, causal=True,
+                               dtype=jnp.float32)
+    sv_params = transformer_init(jax.random.PRNGKey(1), sv_cfg)
+    sv_mesh = cpu_mesh({"model": 1})
+    sv_specs = (param_specs(sv_cfg), kc.cache_pspecs("model"),
+                P(), P(), P())
+    sv_step = jax.jit(
+        smap(lambda p, c, t, qs, ql: _step_body(
+            p, c, t, qs, ql, cfg=sv_cfg, scfg={"tp": 1}),
+            sv_mesh, sv_specs, (kc.cache_pspecs("model"), P())),
+        donate_argnums=(1,))
+
+    def _sv_args(tok_dtype=np.int32):
+        # one 3-token prompt chunk + one decode row over a tiny pool
+        cache = kc.paged_kv_cache(
+            layers=sv_cfg.layers, num_blocks=8, block_size=4,
+            n_kv_heads=sv_cfg.heads,
+            head_dim=sv_cfg.hidden // sv_cfg.heads,
+            max_slots=2, max_blocks_per_seq=8, dtype=jnp.float32)
+        tokens = np.zeros((4,), tok_dtype)
+        qs = np.array([0, 3], np.int32)
+        ql = np.array([3, 1], np.int32)
+        return (sv_params, cache, tokens, qs, ql)
+
+    eps.append(EntryPoint(
+        name="serving_unified_step", fn=sv_step, args=_sv_args,
+        args_variant=_sv_args, axis_sizes={"model": 1}, specs=sv_specs))
+
+    # -- 8/9. pipeline-parallel train steps (1F1B + interleaved) on the
+    #         circulating stage ring — pp=2 whenever the process has two
+    #         host devices (tier-1 / battery9 / the graft leg do), pp=1
+    #         as the single-device degenerate so the CLI still audits
+    #         the schedule's structure anywhere
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_with_interleaving,
+        forward_backward_pipelining_without_interleaving,
+    )
+
+    try:
+        _cdevs = jax.devices("cpu")
+    except Exception:  # no host platform registered: use what exists
+        _cdevs = jax.devices()
+    pp = 2 if len(_cdevs) >= 2 else 1
+    pp_mesh = Mesh(np.array(_cdevs[:pp]), ("stage",))
+    HID, MBS, HEAD = 8, 2, 4
+
+    def _pp_stage(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"]) + x
+
+    def _pp_loss(lp, y, t):
+        return jnp.mean((y @ lp["head"] - t) ** 2)
+
+    def _pp_fn(schedule, vp):
+        def body(chunks, lp, xs, ys):
+            local = jax.tree.map(lambda a: a[0], chunks)  # [1,V,..]->[V,..]
+            if vp == 1:
+                local = jax.tree.map(lambda a: a[0], local)
+            res = schedule(_pp_stage, _pp_loss, local, lp, xs, ys,
+                           axis="stage", checkpoint_activations=True)
+            g = res.stage_grads
+            if vp == 1:
+                g = jax.tree.map(lambda a: a[None], g)
+            return (res.losses, jax.tree.map(lambda a: a[None], g),
+                    res.loss_grads)
+
+        return jax.jit(shard_map(
+            body, mesh=pp_mesh,
+            in_specs=(P("stage"), P(), P(), P()),
+            out_specs=(P(), P("stage"), P()), check_vma=False))
+
+    def _pp_args_builder(vp):
+        def build(x_dtype=np.float32):
+            chunks = {"w": np.zeros((pp, vp, HID, HID), np.float32),
+                      "b": np.zeros((pp, vp, HID), np.float32)}
+            lp = {"head": np.zeros((HID, HEAD), np.float32)}
+            xs = np.zeros((pp, MBS, HID), x_dtype)   # M = pp microbatches
+            ys = np.zeros((pp, MBS, HEAD), np.float32)
+            return (chunks, lp, xs, ys)
+
+        return build
+
+    for pname, sched, vp in (
+            ("pp_1f1b_train_step",
+             forward_backward_pipelining_without_interleaving, 1),
+            ("pp_interleaved_train_step",
+             forward_backward_pipelining_with_interleaving, 2)):
+        eps.append(EntryPoint(
+            name=pname, fn=_pp_fn(sched, vp), args=_pp_args_builder(vp),
+            args_variant=_pp_args_builder(vp), axis_sizes={"stage": pp},
+            specs=(P("stage"), P(), P(), P())))
 
     return eps

@@ -1,12 +1,15 @@
-"""``python -m apex_tpu.analysis`` — run the three layers over a target.
+"""``python -m apex_tpu.analysis`` — run the five layers over a target.
 
 Usage::
 
     python -m apex_tpu.analysis [PATHS...]        # default: the installed
                                                   # apex_tpu package
         --json                  machine-readable report on stdout
-        --no-lint / --no-audit / --no-sanitize
-                                skip a layer (default: all three run)
+        --no-lint / --no-audit / --no-sanitize / --no-memory / --no-spmd
+                                skip a layer (default: all five run)
+        --memory-budget-gb G    per-device HBM budget for APX401 (also
+                                via APEX_TPU_ANALYSIS_HBM_GB; unset =
+                                info-level peak inventory only)
         --full-sweep            exhaustive tunable-space sanitize (the
                                 `slow` CI lane; default is a seeded
                                 subsample per family)
@@ -19,8 +22,15 @@ Usage::
         --list-rules            print the rule catalog and exit
 
 Exit codes are per-rule-layer bits: 1 = lint findings (APX1xx), 2 =
-auditor findings (APX2xx), 4 = sanitizer findings (APX3xx), OR-ed; 0 =
-clean. 64 = internal error. Per-rule counts ride the JSON report.
+auditor findings (APX2xx), 4 = sanitizer findings (APX3xx), 8 = memory
+findings (APX4xx), 16 = spmd findings (APX5xx), OR-ed; 0 = clean. 64 =
+internal error. Per-rule counts, the per-entry-point peak-HBM table
+(``stats.memory``) and the collective-sequence verdicts (``stats.spmd``)
+ride the JSON report.
+
+The auditor, memory and spmd layers share one ``make_jaxpr`` trace per
+registered entry point (``auditors.trace_entry``), so enabling all
+three costs one trace pass, not three.
 """
 
 from __future__ import annotations
@@ -36,7 +46,7 @@ from apex_tpu.analysis.findings import (
     Finding,
     summarize,
 )
-from apex_tpu.utils.envvars import env_flag
+from apex_tpu.utils.envvars import env_flag, env_float
 
 
 def _default_target() -> List[str]:
@@ -46,14 +56,17 @@ def _default_target() -> List[str]:
 
 
 def run(paths: Optional[List[str]] = None, *, lint: bool = True,
-        audit: bool = True, sanitize: bool = True, full_sweep: bool = False,
-        seed: int = 0, sample: int = 24, strict: Optional[bool] = None
-        ) -> dict:
+        audit: bool = True, sanitize: bool = True, memory: bool = True,
+        spmd: bool = True, full_sweep: bool = False, seed: int = 0,
+        sample: int = 24, strict: Optional[bool] = None,
+        memory_budget_gb: Optional[float] = None) -> dict:
     """Programmatic entry (the tier-1 self-run test and the graft leg
     call this): returns the full report dict incl. findings + exit
     code."""
     if strict is None:
         strict = bool(env_flag("APEX_TPU_ANALYSIS_STRICT", default=False))
+    if memory_budget_gb is None:
+        memory_budget_gb = env_float("APEX_TPU_ANALYSIS_HBM_GB")
     findings: List[Finding] = []
     stats: dict = {}
     root = None
@@ -67,13 +80,59 @@ def run(paths: Optional[List[str]] = None, *, lint: bool = True,
             root = os.path.dirname(root)
         findings.extend(lint_paths(targets, root))
         stats["lint_files"] = len(iter_py_files(targets))
-    if audit:
-        from apex_tpu.analysis.auditors import (audit_entry_points,
-                                                default_entry_points)
+    if audit or memory or spmd:
+        from apex_tpu.analysis.auditors import (audit_entry_point,
+                                                default_entry_points,
+                                                trace_entry)
 
         eps = default_entry_points()
-        findings.extend(audit_entry_points(eps))
-        stats["audited_entry_points"] = len(eps)
+        stats["entry_points"] = len(eps)
+        if audit:
+            # the APX2xx layer actually ran — --no-audit must not claim
+            # donation/drift/collective coverage that did not happen
+            stats["audited_entry_points"] = len(eps)
+        mem_rows: List[dict] = []
+        spmd_rows: List[dict] = []
+        budget_bytes = None
+        if memory_budget_gb is not None:
+            from apex_tpu.analysis.memory import GiB
+
+            budget_bytes = float(memory_budget_gb) * GiB
+        for ep in eps:
+            try:
+                closed, args0 = trace_entry(ep)
+            except Exception as e:  # noqa: BLE001 — broken entry = data
+                findings.append(Finding(
+                    "APX202", ep.tag, 0,
+                    f"entry point failed to trace: "
+                    f"{type(e).__name__}: {e}"))
+                continue
+            if audit:
+                findings.extend(
+                    audit_entry_point(ep, closed=closed, args0=args0))
+            if memory:
+                from apex_tpu.analysis.memory import (audit_memory,
+                                                      leaf_factors)
+
+                factors = None
+                if ep.specs is not None:
+                    factors = leaf_factors(args0, ep.specs, ep.axis_sizes)
+                mfind, mrow = audit_memory(
+                    closed, ep.tag, factors=factors,
+                    budget_bytes=budget_bytes)
+                findings.extend(mfind)
+                mem_rows.append(mrow)
+            if spmd:
+                from apex_tpu.analysis.spmd import audit_spmd
+
+                sfind, srow = audit_spmd(closed, ep.axis_sizes, ep.tag)
+                findings.extend(sfind)
+                spmd_rows.append(srow)
+        if memory:
+            stats["memory"] = mem_rows
+            stats["memory_budget_gb"] = memory_budget_gb
+        if spmd:
+            stats["spmd"] = spmd_rows
     if sanitize:
         from apex_tpu.analysis.sanitizer import sanitize_families
 
@@ -92,7 +151,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m apex_tpu.analysis",
         description="apex_tpu static analysis: trace-hygiene lint + "
-                    "jaxpr auditors + Pallas kernel sanitizer")
+                    "jaxpr auditors + Pallas kernel sanitizer + "
+                    "peak-HBM estimator + SPMD deadlock checker")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: the apex_tpu "
                          "package)")
@@ -100,6 +160,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--no-lint", action="store_false", dest="lint")
     ap.add_argument("--no-audit", action="store_false", dest="audit")
     ap.add_argument("--no-sanitize", action="store_false", dest="sanitize")
+    ap.add_argument("--no-memory", action="store_false", dest="memory")
+    ap.add_argument("--no-spmd", action="store_false", dest="spmd")
+    ap.add_argument("--memory-budget-gb", type=float, default=None,
+                    help="per-device HBM budget for APX401 (default: "
+                         "APEX_TPU_ANALYSIS_HBM_GB, else inventory only)")
     ap.add_argument("--full-sweep", action="store_true")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--sample", type=int, default=24)
@@ -116,9 +181,11 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     try:
         report = run(args.paths or None, lint=args.lint, audit=args.audit,
-                     sanitize=args.sanitize, full_sweep=args.full_sweep,
+                     sanitize=args.sanitize, memory=args.memory,
+                     spmd=args.spmd, full_sweep=args.full_sweep,
                      seed=args.seed, sample=args.sample,
-                     strict=args.strict)
+                     strict=args.strict,
+                     memory_budget_gb=args.memory_budget_gb)
     except Exception as e:  # noqa: BLE001 — CLI boundary
         print(f"apex_tpu.analysis: internal error: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
@@ -138,6 +205,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             continue
         print(f.format())
         shown += 1
+    for row in report["stats"].get("memory", ()):
+        over = " OVER BUDGET" if row.get("over_budget") else ""
+        print(f"apex_tpu.analysis: memory {row['entry']}: peak "
+              f"{row['peak_gib']:.4f} GiB/device at {row['peak_site']}"
+              f"{over}")
+    for row in report["stats"].get("spmd", ()):
+        print(f"apex_tpu.analysis: spmd {row['entry']}: "
+              f"{row['collectives']} collective(s), {row['paths']} "
+              f"path(s), {row['loop_phases']} loop phase(s) — "
+              f"{'ok' if row['ok'] else 'HAZARD'}")
     info = sum(1 for f in findings
                if f.severity == "info" and not f.suppressed)
     print(f"apex_tpu.analysis: {report['errors']} finding(s), "

@@ -17,8 +17,9 @@ Severities:
   cost model itself would reject); never fails.
 
 Rule IDs are stable API: APX1xx = trace-hygiene lint, APX2xx = jaxpr
-auditors, APX3xx = kernel sanitizer. The catalog is the single source
-for ``--list-rules`` and docs/analysis.md.
+auditors, APX3xx = kernel sanitizer, APX4xx = peak-HBM/liveness
+estimator, APX5xx = SPMD collective-consistency checker. The catalog is
+the single source for ``--list-rules`` and docs/analysis.md.
 """
 
 from __future__ import annotations
@@ -71,6 +72,13 @@ RULES: Dict[str, Rule] = {
              "calling the wrapped callable) lacks functools.wraps: the "
              "wrapped function loses its name/docstring/signature (the "
              "PR-5 profiling.annotate bug class)."),
+        Rule("APX106", "late-binding-index-map", ERROR,
+             "a pl.BlockSpec / index-map lambda defined inside a loop "
+             "captures the loop variable by reference: python closures "
+             "late-bind, so every index map built by the loop sees the "
+             "LAST iteration's value when Pallas finally calls it — "
+             "bind it as a default (lambda i, k=k: ...) or build the "
+             "map in a factory function."),
         Rule("APX105", "traced-truthiness", ERROR,
              "Python bool() of a jnp expression (if/while/assert/and/or "
              "directly on a jnp.* call or comparison) inside a jitted "
@@ -128,18 +136,63 @@ RULES: Dict[str, Rule] = {
              "check or projected over the VMEM budget — inventory of "
              "the space the autotuner must not sweep on this device; "
              "never fails the run."),
+        # ---- APX4xx: peak-HBM / liveness estimator -------------------
+        Rule("APX401", "hbm-over-budget", ERROR,
+             "the entry point's projected per-device peak HBM (jaxpr "
+             "liveness walk: donation-aware, sharding-aware via the "
+             "entry's PartitionSpecs) exceeds the per-device budget "
+             "(APEX_TPU_ANALYSIS_HBM_GB / --memory-budget-gb). With no "
+             "budget set (or under it) the same finding is emitted at "
+             "info severity — the peak inventory the auto-parallelism "
+             "planner scores configs with."),
+        Rule("APX402", "donation-never-frees", ERROR,
+             "a buffer donated into a jitted call is still referenced "
+             "afterwards (a later equation, or it escapes as an "
+             "output), so the donation never frees it: the estimator "
+             "must keep BOTH the donated operand and the callee's "
+             "outputs resident — the memory-side complement of the "
+             "APX201 correctness hazard."),
+        # ---- APX5xx: SPMD collective-consistency checker -------------
+        Rule("APX501", "branch-divergent-collectives", ERROR,
+             "a lax.cond whose predicate can depend on axis_index "
+             "selects branches with different collective sequences "
+             "over an axis the predicate varies along: replicas on "
+             "that axis take different branches and issue mismatched "
+             "collectives — the classic SPMD hang. Divergence over a "
+             "DISJOINT axis (a stage-varying predicate around "
+             "model-axis collectives shared by all peers of a stage) "
+             "is safe and not flagged."),
+        Rule("APX502", "ppermute-pairing", ERROR,
+             "a ppermute inside a steady-state loop body (scan/while "
+             "pipeline schedule) is not a total bijection of the axis: "
+             "some rank never receives (reads zeros every iteration) "
+             "or never sends (its value is dropped) — mismatched "
+             "send/recv pairing across the cyclic schedule; the "
+             "circulating-ring engine requires total rotations."),
+        Rule("APX503", "pipeline-phase-inconsistency", ERROR,
+             "the loop phases of a pipeline schedule rotate the stage "
+             "ring with incompatible permutations: every in-loop "
+             "ppermute over an axis must be the schedule's base "
+             "rotation or its inverse (forward wave / transposed "
+             "backward wave); a phase permuting a different topology "
+             "hands activations or grads to the wrong stage."),
     )
 }
 
 
 def layer_bit(rule_id: str) -> int:
     """Exit-code bit of a rule: lint (APX1xx) -> 1, auditors (APX2xx) ->
-    2, sanitizer (APX3xx) -> 4. The CLI exit code is the OR of the bits
-    of every rule with unsuppressed error-severity findings."""
+    2, sanitizer (APX3xx) -> 4, memory estimator (APX4xx) -> 8, spmd
+    checker (APX5xx) -> 16. The CLI exit code is the OR of the bits of
+    every rule with unsuppressed error-severity findings."""
     if rule_id.startswith("APX1"):
         return 1
     if rule_id.startswith("APX2"):
         return 2
+    if rule_id.startswith("APX4"):
+        return 8
+    if rule_id.startswith("APX5"):
+        return 16
     return 4
 
 

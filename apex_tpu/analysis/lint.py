@@ -14,6 +14,11 @@ hand-fixed:
 * APX104 — decorators whose wrapper closure lacks ``functools.wraps``
   (the PR-5 ``profiling.annotate`` fix).
 * APX105 — Python truthiness on jnp expressions inside traced code.
+* APX106 — ``pl.BlockSpec`` / ``index_map=`` lambdas defined inside a
+  loop (or comprehension) that capture the loop variable by reference:
+  python closures late-bind, so every index map the loop builds reads
+  the LAST iteration's value when Pallas finally calls it. Bind it as
+  a default (``lambda i, k=k: ...``) or build the map in a factory.
 
 "Jitted" is decided statically: a function is **hot** when it is
 decorated with ``jax.jit``/``pjit`` (bare or via ``functools.partial``),
@@ -191,6 +196,9 @@ class _Linter(ast.NodeVisitor):
         self.hot_names = _collect_hot_names(self.tree)
         self._fn_stack: List[ast.AST] = []
         self._hot_depth = 0
+        # loop-target names currently in scope (for/comprehension
+        # frames) — what an APX106 late-binding lambda can capture
+        self._loop_vars: List[Set[str]] = []
         # per-function-frame names assigned directly from an env read
         # ("env = os.environ.get(...)") — the aliases APX102 follows
         self._env_aliases: List[Set[str]] = []
@@ -305,9 +313,37 @@ class _Linter(ast.NodeVisitor):
             self._env_aliases[-1].add(node.target.id)
         self.generic_visit(node)
 
+    # -- loop tracking (APX106) ---------------------------------------
+    @staticmethod
+    def _target_names(target: ast.AST) -> Set[str]:
+        return {n.id for n in ast.walk(target)
+                if isinstance(n, ast.Name)}
+
+    def _visit_loop(self, node) -> None:
+        self._loop_vars.append(self._target_names(node.target))
+        self.generic_visit(node)
+        self._loop_vars.pop()
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+
+    def _visit_comp(self, node) -> None:
+        names: Set[str] = set()
+        for gen in node.generators:
+            names |= self._target_names(gen.target)
+        self._loop_vars.append(names)
+        self.generic_visit(node)
+        self._loop_vars.pop()
+
+    visit_ListComp = _visit_comp
+    visit_SetComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+    visit_DictComp = _visit_comp
+
     # -- expression-level rules ---------------------------------------
     def visit_Call(self, node: ast.Call) -> None:
         self._check_raw_env_parse(node)
+        self._check_late_binding(node)
         if self._in_hot:
             self._check_host_sync(node)
         self.generic_visit(node)
@@ -367,6 +403,43 @@ class _Linter(ast.NodeVisitor):
                 "flag parse by string comparison over an environment "
                 "read — use apex_tpu.utils.envvars.env_flag so a typo'd "
                 "gate value raises instead of silently meaning 'off'")
+
+    # APX106: BlockSpec / index-map lambdas late-binding a loop variable
+    def _check_late_binding(self, node: ast.Call) -> None:
+        if not self._loop_vars:
+            return
+        name = _dotted(node.func)
+        lambdas: List[ast.Lambda] = []
+        if name.endswith("BlockSpec"):
+            lambdas += [a for a in node.args if isinstance(a, ast.Lambda)]
+            lambdas += [kw.value for kw in node.keywords
+                        if isinstance(kw.value, ast.Lambda)]
+        else:
+            lambdas += [kw.value for kw in node.keywords
+                        if kw.arg == "index_map"
+                        and isinstance(kw.value, ast.Lambda)]
+        if not lambdas:
+            return
+        loop_names = set().union(*self._loop_vars)
+        for lam in lambdas:
+            # parameters (incl. default-bound `k=k`) rebind the name —
+            # that is exactly the sanctioned fix, so they never fire
+            bound = {a.arg for a in lam.args.args + lam.args.posonlyargs
+                     + lam.args.kwonlyargs}
+            free = {n.id for n in ast.walk(lam.body)
+                    if isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)} - bound
+            captured = sorted(free & loop_names)
+            if captured:
+                self._add(
+                    "APX106", lam,
+                    f"index-map lambda captures loop "
+                    f"variable{'s' if len(captured) > 1 else ''} "
+                    f"{', '.join(captured)} by reference — closures "
+                    f"late-bind, so every map built by this loop sees "
+                    f"the last iteration's value; bind it as a default "
+                    f"({', '.join(f'{c}={c}' for c in captured)}) or "
+                    f"build the map in a factory function")
 
     # APX103: host syncs inside hot functions
     def _check_host_sync(self, node: ast.Call) -> None:

@@ -1,0 +1,513 @@
+"""Static peak-HBM / liveness estimator (rules APX401-APX402).
+
+Answers the first of the two questions that actually kill multi-chip
+runs — *will this program fit in HBM* — without running (or even
+compiling) anything: a liveness walk over ``jax.make_jaxpr`` output
+computes per-equation live-set bytes and reports the projected
+per-device peak with the top-K resident tensors and their def/use
+sites.
+
+The model (deliberately coarse — like ``tuning/cost_model.py``, it only
+has to *order* configurations correctly, not predict megabytes):
+
+* every value is ``prod(shape) * dtype.itemsize`` bytes, divided by its
+  **shard factor** — the number of ways the mesh splits it;
+* a jaxpr's inputs are resident from entry; non-donated inputs stay
+  resident to the end (the caller holds the buffer), donated inputs die
+  at their last real reference (donation frees them — that credit is
+  exactly what APX402 revokes when the donated value escapes);
+* an equation's outputs materialize while it runs and die after their
+  last use; operands are still resident during the equation;
+* equations with sub-jaxprs (``pjit`` / ``scan`` / ``cond`` / ``while``
+  / ``shard_map`` / remat) contribute their inner peak *beyond* the
+  operands already counted outside — computed recursively, so a wave of
+  rematerialized pipeline ticks costs what the wave holds, not what the
+  whole schedule holds.
+
+Sharding awareness has two sources that compose: the entry point's
+PartitionSpecs divide the top-level argument avals (``spec_factor``),
+and descending into a ``shard_map`` equation switches to the body's
+**per-shard avals** (factor 1 by construction). Factors propagate
+forward through equations — ``shard_map`` outputs take their
+``out_names`` factor, sub-jaxpr outputs return their inner factors, and
+a simple equation whose output matches an operand's shape inherits that
+operand's factor (the SGD update ``w - lr*g`` of sharded params stays
+sharded). Everything is therefore *per-device* bytes.
+
+Public API: :func:`estimate_peak_hbm` — re-exported by
+``tuning/cost_model.py`` so the whole-run auto-parallelism planner
+(ROADMAP open item 4, AMP-style search) can score candidate
+(dp x tp x pp x ZeRO) configurations without running them.
+:func:`audit_memory` is the CLI layer: APX401 when the peak exceeds the
+per-device budget (``APEX_TPU_ANALYSIS_HBM_GB`` / ``--memory-budget-gb``;
+info-severity inventory otherwise), APX402 when a declared donation
+never frees its buffer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.analysis._jaxpr import (align_right, is_literal,
+                                      sub_jaxprs)
+from apex_tpu.analysis.findings import Finding
+
+__all__ = ["estimate_peak_hbm", "audit_memory", "MemoryEstimate",
+           "spec_factor", "leaf_factors", "GiB"]
+
+GiB = float(2 ** 30)
+
+
+# ---------------------------------------------------------------------------
+# shard factors: PartitionSpecs -> ways the mesh splits a value
+# ---------------------------------------------------------------------------
+
+def spec_factor(spec, axis_sizes: Dict[str, int]) -> int:
+    """Number of shards a PartitionSpec splits an array into on the
+    given mesh: the product of the extents of every mesh axis it names
+    (``None`` entries replicate). ``spec=None`` -> 1."""
+    if spec is None:
+        return 1
+    factor = 1
+    for entry in spec:
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for ax in axes:
+            factor *= int(axis_sizes.get(ax, 1))
+    return factor
+
+
+def leaf_factors(args, specs, axis_sizes: Dict[str, int]) -> List[int]:
+    """Per-flat-leaf shard factors for ``args``, in ``jax.tree.leaves``
+    order (= ``make_jaxpr`` invar order). ``specs`` may be a PREFIX tree
+    of args' structure — a single PartitionSpec covering a whole subtree,
+    the shard_map in_specs convention."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    out: List[int] = []
+
+    def is_spec(s):
+        return s is None or isinstance(s, PartitionSpec)
+
+    def rec(a, s):
+        if is_spec(s):
+            out.extend([spec_factor(s, axis_sizes)]
+                       * len(jax.tree.leaves(a)))
+            return
+        if isinstance(a, dict):
+            for k in sorted(a):
+                rec(a[k], s[k])
+        elif isinstance(a, (list, tuple)):
+            if len(a) != len(s):
+                raise ValueError(
+                    f"specs tree does not match args: {len(s)} specs "
+                    f"for {len(a)} children")
+            for ai, si in zip(a, s):
+                rec(ai, si)
+        else:
+            raise ValueError(
+                f"specs tree does not match args at a {type(a).__name__} "
+                f"leaf (got {type(s).__name__}, expected a PartitionSpec)")
+
+    rec(args, specs)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the liveness walk
+# ---------------------------------------------------------------------------
+
+def _aval_bytes(aval, factor: int = 1) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return -(-(n * dtype.itemsize) // max(1, int(factor)))
+
+
+_is_literal = is_literal
+_sub_jaxprs_of = sub_jaxprs
+_align_right = align_right
+
+
+def _is_dropvar(v) -> bool:
+    return type(v).__name__ == "DropVar"
+
+
+def _mesh_axis_sizes(mesh) -> Dict[str, int]:
+    try:
+        return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+    except Exception:
+        return {}
+
+
+def _shard_map_out_factors(eqn) -> Optional[List[int]]:
+    """Per-output shard factors of a shard_map equation, read from its
+    ``out_names`` ({dim: (axis, ...)} per output) and mesh."""
+    mesh = eqn.params.get("mesh")
+    out_names = eqn.params.get("out_names")
+    if mesh is None or out_names is None:
+        return None
+    sizes = _mesh_axis_sizes(mesh)
+    factors = []
+    for names in out_names:
+        f = 1
+        try:
+            for axes in dict(names).values():
+                for ax in (axes if isinstance(axes, tuple) else (axes,)):
+                    f *= sizes.get(str(ax), 1)
+        except Exception:
+            f = 1
+        factors.append(f)
+    return factors
+
+
+@dataclass
+class _Resident:
+    bytes: int
+    shape: tuple
+    dtype: str
+    defined: str
+    last_use: str
+
+    def to_json(self) -> dict:
+        return {"bytes": int(self.bytes), "shape": list(self.shape),
+                "dtype": self.dtype, "defined": self.defined,
+                "last_use": self.last_use}
+
+
+@dataclass
+class _Hazard:
+    site: str          # "path:eqn_i -> callee"
+    how: str           # "consumed by a later equation" / "escapes ..."
+    bytes: int
+
+
+@dataclass
+class MemoryEstimate:
+    """What :func:`estimate_peak_hbm` returns: projected per-device peak
+    bytes, where it happens, the top-K resident tensors there (def/use
+    sites as equation indices), and any donation hazards found on the
+    way (APX402 material)."""
+
+    peak_bytes: int
+    peak_site: str
+    residents: List[_Resident]
+    n_eqns: int
+    hazards: List[_Hazard] = field(default_factory=list)
+
+    @property
+    def peak_gib(self) -> float:
+        return self.peak_bytes / GiB
+
+    def to_json(self) -> dict:
+        return {
+            "peak_bytes": int(self.peak_bytes),
+            "peak_gib": round(self.peak_gib, 4),
+            "peak_site": self.peak_site,
+            "n_eqns": self.n_eqns,
+            "residents": [r.to_json() for r in self.residents],
+            "donation_hazards": len(self.hazards),
+        }
+
+
+class _Analyzer:
+    def __init__(self, top_k: int = 8):
+        self.top_k = top_k
+        self.hazards: List[_Hazard] = []
+        self.n_eqns = 0
+
+    def analyze(self, jaxpr, in_factors: Optional[List[int]],
+                donated: Optional[Sequence[bool]], path: str
+                ) -> Tuple[int, List[int], str, List[_Resident]]:
+        """Liveness walk of one (sub-)jaxpr. Returns (peak_bytes,
+        out_factors, peak_site, residents_at_peak). ``in_factors`` /
+        ``donated`` align with ``jaxpr.invars``."""
+        eqns = jaxpr.eqns
+        self.n_eqns += len(eqns)
+        invars = [v for v in jaxpr.invars]
+        if in_factors is None:
+            in_factors = [1] * len(invars)
+        if donated is None:
+            donated = [False] * len(invars)
+
+        factors: Dict[Any, int] = {}
+        meta: Dict[Any, str] = {}
+        for j, v in enumerate(invars):
+            factors[v] = in_factors[j] or 1
+            meta[v] = f"arg[{j}]"
+        for v in jaxpr.constvars:
+            factors[v] = 1
+            meta[v] = "const"
+
+        # last real reference of each var (equation index; len(eqns) =
+        # "escapes as an output")
+        end = len(eqns)
+        last_ref: Dict[Any, int] = {}
+        for i, eqn in enumerate(eqns):
+            for v in eqn.invars:
+                if not _is_literal(v):
+                    last_ref[v] = i
+        outset = {v for v in jaxpr.outvars if not _is_literal(v)}
+        for v in outset:
+            last_ref[v] = end
+
+        # vars donated into an inner pjit die at their last REAL
+        # reference (the donation frees them); everything else the
+        # caller handed in stays resident to the end
+        donated_inner: Dict[Any, int] = {}
+        for i, eqn in enumerate(eqns):
+            dflags = eqn.params.get("donated_invars")
+            if not dflags or not any(dflags):
+                continue
+            for dflag, v in zip(dflags,
+                                _align_right(eqn.invars, len(dflags))):
+                if dflag and v is not None and not _is_literal(v):
+                    donated_inner.setdefault(v, i)
+
+        death: Dict[Any, int] = {}
+        for j, v in enumerate(invars):
+            if v in outset:
+                death[v] = end
+            elif donated[j] or v in donated_inner:
+                death[v] = last_ref.get(v, -1)
+            else:
+                death[v] = end
+        for v in jaxpr.constvars:
+            death[v] = end
+
+        # APX402: donation declared but the value never dies
+        for v, i in donated_inner.items():
+            ref = last_ref.get(v, i)
+            if ref > i:
+                eqn = eqns[i]
+                how = ("escapes as an output" if v in outset
+                       and ref == end else
+                       f"consumed again by eqn {ref} "
+                       f"({eqns[min(ref, end - 1)].primitive.name})")
+                self.hazards.append(_Hazard(
+                    site=(f"{path}:eqn {i} "
+                          f"(pjit {eqn.params.get('name', '?')!r})"),
+                    how=how,
+                    bytes=_aval_bytes(v.aval, factors.get(v, 1))))
+
+        live: Dict[Any, int] = {}
+        for v in invars + list(jaxpr.constvars):
+            if death.get(v, -1) >= 0:
+                live[v] = _aval_bytes(v.aval, factors.get(v, 1))
+
+        def _use_str(v) -> str:
+            r = last_ref.get(v)
+            if r is None:
+                return "unused"
+            if r >= end:
+                return "output"
+            return f"eqn {r} ({eqns[r].primitive.name})"
+
+        def _snapshot(extra_entries) -> List[_Resident]:
+            snap = [
+                _Resident(b, tuple(getattr(v.aval, "shape", ())),
+                          str(getattr(v.aval, "dtype", "?")),
+                          meta.get(v, "?"), _use_str(v))
+                for v, b in live.items()
+            ] + list(extra_entries)
+            snap.sort(key=lambda r: -r.bytes)
+            return snap[:self.top_k]
+
+        peak = sum(live.values())
+        peak_site = f"{path}:entry"
+        residents = _snapshot([])
+
+        for i, eqn in enumerate(eqns):
+            prim = eqn.primitive.name
+            site = f"{path}:eqn {i} ({prim})"
+            out_factors = self._eqn_out_factors(eqn, factors)
+
+            # sub-jaxprs first: their returned output factors must land
+            # in out_factors BEFORE any output bytes are computed, or
+            # the live set would hold e.g. a sharded shard_map result
+            # at its unsharded size for the rest of the walk
+            subs = []
+            for key, sub in _sub_jaxprs_of(eqn):
+                sub_in = _align_right(
+                    [factors.get(v, 1) if not _is_literal(v) else 1
+                     for v in eqn.invars], len(sub.invars))
+                if prim == "shard_map":
+                    # body avals are already per-shard
+                    sub_in = [1] * len(sub.invars)
+                sub_don = None
+                dflags = eqn.params.get("donated_invars")
+                if dflags:
+                    sub_don = _align_right(list(dflags), len(sub.invars))
+                    sub_don = [bool(d) for d in sub_don]
+                sub_peak, sub_out, _, sub_res = self.analyze(
+                    sub, [f or 1 for f in sub_in], sub_don,
+                    f"{site}/{key}")
+                sub_base = sum(
+                    _aval_bytes(v.aval, f or 1)
+                    for v, f in zip(sub.invars, sub_in))
+                subs.append((sub_peak, sub_base, sub_res))
+                if len(sub_out) == len(eqn.outvars) and prim != "shard_map":
+                    out_factors = [max(a, b) for a, b in
+                                   zip(out_factors, sub_out)]
+
+            out_entries = []
+            out_bytes = 0
+            for v, f in zip(eqn.outvars, out_factors):
+                b = _aval_bytes(v.aval, f)
+                out_bytes += b
+                out_entries.append(_Resident(
+                    b, tuple(getattr(v.aval, "shape", ())),
+                    str(getattr(v.aval, "dtype", "?")), site,
+                    "dropped" if _is_dropvar(v) else _use_str(v)))
+
+            # transient of a sub-jaxpr equation beyond what the outer
+            # scope already holds (operands + outputs)
+            inner_extra = 0
+            inner_residents: List[_Resident] = []
+            for sub_peak, sub_base, sub_res in subs:
+                extra = max(0, sub_peak - sub_base - out_bytes)
+                if extra > inner_extra:
+                    inner_extra = extra
+                    inner_residents = sub_res
+
+            during = sum(live.values()) + out_bytes + inner_extra
+            if during > peak:
+                peak = during
+                peak_site = site
+                residents = _snapshot(out_entries + inner_residents)
+
+            # retire values dead after this equation, then land outputs
+            for v in list(live):
+                if death.get(v, end) <= i:
+                    del live[v]
+            for v, f, ent in zip(eqn.outvars, out_factors, out_entries):
+                if _is_dropvar(v):
+                    continue
+                factors[v] = f
+                meta[v] = site
+                death[v] = end if v in outset else last_ref.get(v, i)
+                if death[v] > i:
+                    live[v] = ent.bytes
+
+        return peak, [factors.get(v, 1) if not _is_literal(v) else 1
+                      for v in jaxpr.outvars], peak_site, residents
+
+    def _eqn_out_factors(self, eqn, factors: Dict[Any, int]) -> List[int]:
+        sm = _shard_map_out_factors(eqn) \
+            if eqn.primitive.name == "shard_map" else None
+        if sm is not None and len(sm) == len(eqn.outvars):
+            return sm
+        out = []
+        for v in eqn.outvars:
+            shape = getattr(v.aval, "shape", None)
+            f = 1
+            for iv in eqn.invars:
+                if _is_literal(iv):
+                    continue
+                if getattr(iv.aval, "shape", ()) == shape:
+                    f = max(f, factors.get(iv, 1))
+            out.append(f)
+        return out
+
+
+def _estimate(closed_jaxpr, factors: Optional[List[int]] = None,
+              donated: Optional[Sequence[bool]] = None,
+              top_k: int = 8, label: str = "jaxpr") -> MemoryEstimate:
+    an = _Analyzer(top_k=top_k)
+    peak, _, site, residents = an.analyze(
+        closed_jaxpr.jaxpr, factors, donated, label)
+    return MemoryEstimate(peak_bytes=peak, peak_site=site,
+                          residents=residents, n_eqns=an.n_eqns,
+                          hazards=an.hazards)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def estimate_peak_hbm(fn, args: tuple, mesh=None, specs=None, *,
+                      donate_argnums: Sequence[int] = (),
+                      top_k: int = 8) -> MemoryEstimate:
+    """Project the per-device peak-HBM of ``fn(*args)`` statically.
+
+    ``mesh`` is a ``jax.sharding.Mesh`` or a ``{axis: size}`` dict;
+    ``specs`` a tree of PartitionSpecs for ``args`` (prefix trees in the
+    shard_map in_specs convention are fine) — together they divide each
+    argument's bytes by its shard count, which is what makes the
+    estimate a *per-device* number the planner can compare across
+    (dp x tp x pp x ZeRO) candidates. ``donate_argnums`` marks arguments
+    whose buffers the caller releases (they die at their last use
+    instead of surviving to program end). Trace-only: no compile, no
+    devices beyond what ``make_jaxpr`` itself needs."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    axis_sizes: Dict[str, int] = {}
+    if mesh is not None:
+        axis_sizes = mesh if isinstance(mesh, dict) \
+            else _mesh_axis_sizes(mesh)
+    factors = None
+    if specs is not None:
+        factors = leaf_factors(args, specs, axis_sizes)
+        if len(factors) != len(closed.jaxpr.invars):
+            raise ValueError(
+                f"specs flatten to {len(factors)} leaves but the traced "
+                f"program has {len(closed.jaxpr.invars)} inputs")
+    donated = None
+    if donate_argnums:
+        donate_argnums = set(
+            int(d) for d in (donate_argnums if isinstance(
+                donate_argnums, (tuple, list, set)) else (donate_argnums,)))
+        # expand per-argument donation over each argument's flat leaves
+        donated = []
+        for j, a in enumerate(args):
+            n = len(jax.tree.leaves(a))
+            donated.extend([j in donate_argnums] * n)
+        if len(donated) != len(closed.jaxpr.invars):
+            donated = None  # static/capture mismatch: fall back
+    return _estimate(closed, factors, donated, top_k=top_k)
+
+
+def audit_memory(closed_jaxpr, tag: str, *,
+                 factors: Optional[List[int]] = None,
+                 budget_bytes: Optional[float] = None,
+                 top_k: int = 5) -> Tuple[List[Finding], dict]:
+    """The CLI layer over one traced entry point: APX402 per donation
+    hazard, APX401 error when over ``budget_bytes`` (info inventory
+    otherwise). Returns (findings, summary-for-the-report)."""
+    est = _estimate(closed_jaxpr, factors, top_k=top_k, label=tag)
+    findings: List[Finding] = []
+    for hz in est.hazards:
+        findings.append(Finding(
+            "APX402", tag, 0,
+            f"donated buffer ({hz.bytes} bytes) never dies — donation "
+            f"at {hz.site} but the value {hz.how}; the estimator must "
+            f"keep both it and the callee's outputs resident"))
+    top = ", ".join(
+        f"{r.bytes / GiB:.4f} GiB {r.dtype}{list(r.shape)} "
+        f"(def {r.defined}, use {r.last_use})"
+        for r in est.residents[:3])
+    if budget_bytes is not None and est.peak_bytes > budget_bytes:
+        findings.append(Finding(
+            "APX401", tag, 0,
+            f"projected per-device peak HBM {est.peak_gib:.4f} GiB "
+            f"exceeds the {budget_bytes / GiB:.2f} GiB budget at "
+            f"{est.peak_site}; top residents: {top}"))
+    else:
+        findings.append(Finding(
+            "APX401", tag, 0,
+            f"projected per-device peak HBM {est.peak_gib:.4f} GiB at "
+            f"{est.peak_site}; top residents: {top}",
+            severity="info"))
+    summary = est.to_json()
+    summary["entry"] = tag
+    summary["over_budget"] = bool(
+        budget_bytes is not None and est.peak_bytes > budget_bytes)
+    return findings, summary

@@ -1,0 +1,346 @@
+"""SPMD collective-consistency / deadlock checker (rules APX501-APX503).
+
+Answers the second question that kills multi-chip runs — *can this
+program hang* — statically: extract the ordered collective sequence
+(psum / all_gather / psum_scatter / ppermute / all_to_all, with axes and
+operand shapes) from a jaxpr **per control-flow path**, descending into
+``cond`` / ``while`` / ``scan`` / inner ``pjit`` / ``shard_map`` /
+remat, then check the three hazards SPMD lowering cannot:
+
+* **APX501 branch-divergent collectives** — a ``lax.cond`` whose
+  predicate is tainted by ``axis_index`` selects branches with different
+  collective sequences over an axis the predicate varies along: replicas
+  of that axis take different branches and issue mismatched collectives
+  — the classic SPMD hang. Taint is tracked per axis name, so the
+  pipeline engine's stage-varying loss cond around *model-axis*
+  collectives (every tp peer of a stage shares the predicate) stays
+  legal.
+
+* **APX502 ppermute pairing** — a ``ppermute`` inside a loop body (the
+  steady state of a schedule) must be a **total bijection** of its axis:
+  a rank that never receives reads zeros every iteration, a rank that
+  never sends has its value dropped — mismatched send/recv pairing
+  across the cyclic schedule. (Replica-consistency — unique src/dst, in
+  range — is APX203; this is the scheduling-level complement.)
+
+* **APX503 pipeline-phase inconsistency** — the loop phases of one
+  schedule (each innermost loop body containing ppermutes, per axis)
+  must rotate the ring compatibly: every perm must be the schedule's
+  base rotation or its inverse (forward wave / transposed backward wave
+  / remat recompute). A phase permuting a different topology hands
+  activations or grads to the wrong stage — the forward/backward
+  permutes no longer compose to the identity across the schedule.
+
+Like the auditors, everything here is ``make_jaxpr`` output only: no
+compiles, no devices, deterministic across backends.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, FrozenSet, List, Optional, Tuple
+
+from apex_tpu.analysis._jaxpr import align_right, axes_of, is_literal, \
+    sub_jaxprs
+from apex_tpu.analysis.findings import Finding
+
+__all__ = ["CollectiveOp", "collective_paths", "audit_spmd"]
+
+_axes_of = axes_of
+_is_literal = is_literal
+_sub_jaxprs_of = sub_jaxprs
+_align_right = align_right
+
+_COLLECTIVES = {"psum", "ppermute", "pbroadcast", "all_gather",
+                "all_to_all", "reduce_scatter", "psum_scatter",
+                "pmax", "pmin"}
+
+# fork guard: a cond-heavy program multiplies paths; past this we keep
+# the first MAX_PATHS and mark the verdict truncated (still sound for
+# APX501-503, which fire during the walk, not on the path product)
+MAX_PATHS = 64
+
+
+@dataclass(frozen=True)
+class CollectiveOp:
+    """One collective in program order: primitive, axes, operand shape/
+    dtype, the ppermute perm (if any), how many loop bodies deep it
+    sits, and its site string."""
+
+    prim: str
+    axes: Tuple[str, ...]
+    shape: Tuple[int, ...]
+    dtype: str
+    perm: Optional[Tuple[Tuple[int, int], ...]]
+    loop_depth: int
+    site: str
+
+    @property
+    def sig(self) -> Tuple[str, Tuple[str, ...]]:
+        return (self.prim, self.axes)
+
+    def to_json(self) -> dict:
+        return {"prim": self.prim, "axes": list(self.axes),
+                "shape": list(self.shape), "dtype": self.dtype,
+                "loop_depth": self.loop_depth, "site": self.site}
+
+
+def _perm_map(perm) -> Dict[int, int]:
+    return {int(s): int(d) for s, d in perm}
+
+
+def _is_total_bijection(perm, n: int) -> bool:
+    m = _perm_map(perm)
+    return (len(m) == n and set(m) == set(range(n))
+            and set(m.values()) == set(range(n)))
+
+
+def _same_or_inverse(a, b) -> bool:
+    ma, mb = _perm_map(a), _perm_map(b)
+    if ma == mb:
+        return True
+    return {(d, s) for s, d in ma.items()} == set(mb.items())
+
+
+_Taint = FrozenSet[str]
+_NO_TAINT: _Taint = frozenset()
+
+
+class _Walker:
+    """One pass over the jaxpr tree: collects collectives per path,
+    propagates axis_index taint, records loop-body ppermute phases, and
+    emits APX501/APX502 findings as it goes (APX503 is a post-pass over
+    the phases)."""
+
+    def __init__(self, axis_sizes: Dict[str, int], tag: str):
+        self.axis_sizes = dict(axis_sizes)
+        self.tag = tag
+        self.findings: List[Finding] = []
+        self.paths: List[List[CollectiveOp]] = [[]]
+        self.truncated = False
+        # (axis, perm, site) per in-loop ppermute, grouped per loop body
+        self.phases: List[Tuple[str, List[Tuple[tuple, str]]]] = []
+        self._frame_stack: List[Dict[str, List[Tuple[tuple, str]]]] = []
+        self.n_collectives = 0
+
+    # -- path bookkeeping ------------------------------------------------
+    def _emit(self, op: CollectiveOp) -> None:
+        self.n_collectives += 1
+        for p in self.paths:
+            p.append(op)
+        if op.prim == "ppermute" and op.perm is not None \
+                and op.loop_depth > 0:
+            axis = op.axes[0] if op.axes else "?"
+            if self._frame_stack:
+                self._frame_stack[-1].setdefault(axis, []).append(
+                    (op.perm, op.site))
+            n = self.axis_sizes.get(axis)
+            if n and n > 0 and not _is_total_bijection(op.perm, n):
+                m = _perm_map(op.perm)
+                silent_rx = sorted(set(range(n)) - set(m.values()))
+                silent_tx = sorted(set(range(n)) - set(m))
+                self.findings.append(Finding(
+                    "APX502", self.tag, 0,
+                    f"ppermute {list(op.perm)} at {op.site} sits inside "
+                    f"a loop body but is not a total bijection of axis "
+                    f"{axis!r} (size {n}): "
+                    + (f"ranks {silent_rx} never receive (zeros every "
+                       f"iteration)" if silent_rx else "")
+                    + (" and " if silent_rx and silent_tx else "")
+                    + (f"ranks {silent_tx} never send (their value is "
+                       f"dropped)" if silent_tx else "")
+                    + " — mismatched send/recv pairing across the "
+                      "schedule"))
+
+    def _fork(self, branch_walks: List["_Walker"]) -> None:
+        """Cross-product this walker's paths with each branch's paths."""
+        new_paths: List[List[CollectiveOp]] = []
+        for base in self.paths:
+            for bw in branch_walks:
+                for suffix in bw.paths:
+                    new_paths.append(base + suffix)
+                    if len(new_paths) >= MAX_PATHS:
+                        break
+                if len(new_paths) >= MAX_PATHS:
+                    break
+            if len(new_paths) >= MAX_PATHS:
+                self.truncated = True
+                break
+        self.paths = new_paths or [[]]
+
+    def _branch_walker(self) -> "_Walker":
+        w = _Walker(self.axis_sizes, self.tag)
+        w._frame_stack = self._frame_stack      # shared phase frames
+        return w
+
+    # -- the walk --------------------------------------------------------
+    def walk(self, jaxpr, in_taints: Optional[List[_Taint]],
+             loop_depth: int, site_prefix: str) -> List[_Taint]:
+        taint: Dict[Any, _Taint] = {}
+        if in_taints is not None:
+            for v, t in zip(jaxpr.invars, in_taints):
+                if t:
+                    taint[v] = t
+
+        def t_of(v) -> _Taint:
+            if _is_literal(v):
+                return _NO_TAINT
+            return taint.get(v, _NO_TAINT)
+
+        for i, eqn in enumerate(jaxpr.eqns):
+            prim = eqn.primitive.name
+            site = f"{site_prefix}:eqn {i} ({prim})"
+            in_taint = _NO_TAINT
+            for v in eqn.invars:
+                in_taint = in_taint | t_of(v)
+
+            if prim == "axis_index":
+                ax = eqn.params.get("axis_name")
+                axes = tuple(ax) if isinstance(ax, (tuple, list)) \
+                    else (str(ax),)
+                out_t: List[_Taint] = [frozenset(str(a) for a in axes)
+                                       | in_taint]
+            elif prim == "cond":
+                out_t = self._walk_cond(eqn, t_of, in_taint, loop_depth,
+                                        site)
+            else:
+                if prim in _COLLECTIVES or prim.startswith(
+                        ("psum", "ppermute", "all_gather", "all_to_all",
+                         "reduce_scatter")):
+                    op0 = eqn.invars[0]
+                    perm = eqn.params.get("perm")
+                    self._emit(CollectiveOp(
+                        prim=prim, axes=_axes_of(eqn),
+                        shape=tuple(getattr(op0.aval, "shape", ())),
+                        dtype=str(getattr(op0.aval, "dtype", "?")),
+                        perm=tuple((int(s), int(d)) for s, d in perm)
+                        if perm else None,
+                        loop_depth=loop_depth, site=site))
+                is_loop = prim in ("scan", "while")
+                for key, sub in _sub_jaxprs_of(eqn):
+                    sub_taints = _align_right(
+                        [t_of(v) for v in eqn.invars], len(sub.invars))
+                    sub_taints = [t or _NO_TAINT for t in sub_taints]
+                    if is_loop:
+                        self._frame_stack.append({})
+                    sub_out = self.walk(
+                        sub, sub_taints, loop_depth + (1 if is_loop else 0),
+                        f"{site}/{key}")
+                    if is_loop:
+                        frame = self._frame_stack.pop()
+                        for axis, perms in frame.items():
+                            self.phases.append((axis, perms))
+                    if len(sub_out) == len(eqn.outvars):
+                        for v, t in zip(eqn.outvars, sub_out):
+                            if t:
+                                taint[v] = taint.get(v, _NO_TAINT) | t
+                out_t = [in_taint] * len(eqn.outvars)
+
+            for v, t in zip(eqn.outvars, out_t):
+                if t:
+                    taint[v] = taint.get(v, _NO_TAINT) | t
+
+        return [t_of(v) for v in jaxpr.outvars]
+
+    def _walk_cond(self, eqn, t_of, in_taint: _Taint, loop_depth: int,
+                   site: str) -> List[_Taint]:
+        pred_taint = t_of(eqn.invars[0])
+        branches = eqn.params.get("branches") or ()
+        walks: List[_Walker] = []
+        out_t = [in_taint | pred_taint] * len(eqn.outvars)
+        for bi, br in enumerate(branches):
+            sub = br.jaxpr if hasattr(br, "jaxpr") else br
+            bw = self._branch_walker()
+            sub_taints = _align_right(
+                [t_of(v) for v in eqn.invars[1:]], len(sub.invars))
+            br_out = bw.walk(sub, [t or _NO_TAINT for t in sub_taints],
+                             loop_depth, f"{site}/branch{bi}")
+            if len(br_out) == len(out_t):
+                out_t = [a | b for a, b in zip(out_t, br_out)]
+            walks.append(bw)
+            self.findings.extend(bw.findings)
+            self.phases.extend(bw.phases)   # loops nested in the branch
+            self.truncated = self.truncated or bw.truncated
+            self.n_collectives += bw.n_collectives
+
+        # APX501: different collective sequences across branches, over
+        # an axis the predicate varies along
+        sigs = [tuple(op.sig for op in (bw.paths[0] if bw.paths else ()))
+                for bw in walks]
+        if pred_taint and len(set(sigs)) > 1:
+            branch_axes = {ax for bw in walks for p in bw.paths
+                           for op in p for ax in op.axes}
+            hot = sorted(pred_taint & branch_axes)
+            if hot:
+                desc = "; ".join(
+                    f"branch{bi}: " + (" -> ".join(
+                        f"{p}[{','.join(a)}]" for p, a in sig) or "(none)")
+                    for bi, sig in enumerate(sigs))
+                self.findings.append(Finding(
+                    "APX501", self.tag, 0,
+                    f"cond at {site} has a predicate that can depend on "
+                    f"axis_index over {hot} and branches with different "
+                    f"collective sequences over {'that axis' if len(hot) == 1 else 'those axes'} "
+                    f"({desc}) — replicas diverge and the mismatched "
+                    f"collectives hang on hardware"))
+
+        self._fork(walks)
+        return out_t
+
+
+def _check_phases(walker: _Walker) -> None:
+    """APX503: all in-loop ppermute perms of one axis must share a base
+    rotation (each equal to it or its inverse). Partial permutations are
+    excluded from the comparison — totality against the REAL axis size
+    is APX502's check, and comparing a partial map against the base
+    rotation would only duplicate that finding."""
+    by_axis: Dict[str, List[Tuple[tuple, str]]] = {}
+    for axis, perms in walker.phases:
+        by_axis.setdefault(axis, []).extend(perms)
+    for axis, perms in by_axis.items():
+        n = walker.axis_sizes.get(axis)
+        if not n:
+            continue   # unbound axis: APX203's finding, nothing to pair
+        total = [(p, s) for p, s in perms if _is_total_bijection(p, n)]
+        if len(total) < 2:
+            continue
+        base, base_site = total[0]
+        for p, s in total[1:]:
+            if not _same_or_inverse(base, p):
+                walker.findings.append(Finding(
+                    "APX503", walker.tag, 0,
+                    f"pipeline phases over axis {axis!r} rotate with "
+                    f"incompatible permutations: {list(base)} at "
+                    f"{base_site} vs {list(p)} at {s} (neither equal "
+                    f"nor inverse) — the forward/backward permutes do "
+                    f"not compose back to the identity across the "
+                    f"schedule, so activations/grads land on the wrong "
+                    f"stage"))
+
+
+def collective_paths(closed_jaxpr, axis_sizes: Dict[str, int],
+                     tag: str = "<jaxpr>"
+                     ) -> Tuple[List[List[CollectiveOp]], _Walker]:
+    """Ordered collective sequence per control-flow path (capped at
+    ``MAX_PATHS``), plus the walker carrying findings/phases/stats."""
+    w = _Walker(axis_sizes, tag)
+    w.walk(closed_jaxpr.jaxpr, None, 0, tag)
+    _check_phases(w)
+    return w.paths, w
+
+
+def audit_spmd(closed_jaxpr, axis_sizes: Dict[str, int], tag: str
+               ) -> Tuple[List[Finding], dict]:
+    """The CLI layer over one traced entry point: APX501/502/503
+    findings plus the per-entry verdict summary."""
+    paths, w = collective_paths(closed_jaxpr, axis_sizes, tag)
+    summary = {
+        "entry": tag,
+        "paths": len(paths),
+        "collectives": w.n_collectives,
+        "loop_phases": len(w.phases),
+        "truncated": w.truncated,
+        "sequence": [op.to_json() for op in paths[0][:32]] if paths else [],
+        "ok": not any(f.severity == "error" for f in w.findings),
+    }
+    return w.findings, summary

@@ -398,6 +398,25 @@ def softmax_row_chunk_default() -> int:
     return 0
 
 
+# ------------------------------------------------------------------
+# whole-program memory model (the planner's input)
+# ------------------------------------------------------------------
+
+# The static peak-HBM estimator lives with the other jaxpr walkers in
+# apex_tpu.analysis.memory but is re-exported here because it is a COST
+# MODEL: the whole-run auto-parallelism planner (ROADMAP open item 4)
+# scores candidate (dp x tp x pp x ZeRO) configurations by calling
+# estimate_peak_hbm(step_fn, args, mesh, specs) per candidate — a
+# trace-only, per-device projection — and rejecting the ones whose peak
+# exceeds device_spec()'s HBM before any timing happens. Import is lazy
+# at module level only in the sense that analysis.memory itself imports
+# jax lazily, so this module stays importable without the kernel layer.
+from apex_tpu.analysis.memory import (  # noqa: E402,F401
+    MemoryEstimate,
+    estimate_peak_hbm,
+)
+
+
 def iter_flash_ladder() -> Iterable[dict]:
     """The benched shape-class ladder (BASELINE.md rungs) — shared by the
     projection table generator and the autotune default sweep."""

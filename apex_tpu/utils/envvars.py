@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["env_int", "env_flag", "env_str"]
+__all__ = ["env_int", "env_flag", "env_str", "env_float"]
 
 
 def env_int(var: str, *, quantum: int = 1, default=None,
@@ -51,6 +51,22 @@ def env_int(var: str, *, quantum: int = 1, default=None,
         zero = " (or 0)" if allow_zero else ""
         raise ValueError(
             f"{var}={v} must be a positive multiple of {quantum}{zero}")
+    return v
+
+
+def env_float(var: str, *, default=None):
+    """Float env knob (budgets like APEX_TPU_ANALYSIS_HBM_GB, which may
+    legitimately be fractional): ``default`` when unset/empty, else a
+    validated positive float. Malformed values raise naming ``var``."""
+    raw = os.environ.get(var)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(f"{var}={raw!r} must be a number") from None
+    if v <= 0:
+        raise ValueError(f"{var}={v} must be positive")
     return v
 
 

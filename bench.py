@@ -825,11 +825,14 @@ def _obs_compile_rung(on_cpu: bool, timeout_s: float) -> dict:
 
 def _analysis_compile_rung() -> dict:
     """The static-analysis self-check as a gate rung: the full self-run
-    (AST lint + jaxpr auditors) plus the seeded kernel-sanitizer sweep
-    over every registered tunable family. Zero unsuppressed findings is
-    the verdict — the same pin tests/L0/test_analysis.py holds, surfaced
-    in the compile gate so a lint regression names itself next to the
-    kernel dry-compiles."""
+    (AST lint + jaxpr auditors + peak-HBM estimator + SPMD deadlock
+    checker) plus the seeded kernel-sanitizer sweep over every
+    registered tunable family. Zero unsuppressed findings is the
+    verdict — the same pin tests/L0/test_analysis.py holds, surfaced in
+    the compile gate so a lint regression names itself next to the
+    kernel dry-compiles — and the per-entry-point peak-HBM table plus
+    the collective-sequence verdicts print alongside, so every gate run
+    leaves a memory/deadlock inventory in the log."""
     import time as _time
 
     rung = {"rung": "analysis", "batch": None, "remat": "analysis"}
@@ -841,15 +844,32 @@ def _analysis_compile_rung() -> dict:
         dt = _time.perf_counter() - t0
         families = [s["family"] for s in
                     report["stats"].get("sanitize", [])]
+        mem_rows = report["stats"].get("memory", [])
+        spmd_rows = {r["entry"]: r for r in
+                     report["stats"].get("spmd", [])}
+        for row in mem_rows:
+            s = spmd_rows.get(row["entry"], {})
+            print(f"bench: analysis {row['entry']}: peak "
+                  f"{row['peak_gib']:.4f} GiB/device, "
+                  f"{s.get('collectives', 0)} collective(s) over "
+                  f"{s.get('paths', 1)} path(s) "
+                  f"[{'ok' if s.get('ok', True) else 'HAZARD'}]",
+                  file=sys.stderr, flush=True)
         ok = report["exit_code"] == 0
         if ok:
             print(f"bench: compile-only rung analysis: OK ({dt:.1f}s — "
                   f"{report['stats'].get('lint_files', 0)} files linted, "
                   f"{report['stats'].get('audited_entry_points', 0)} "
                   f"entry points audited, {len(families)} families "
-                  f"sanitized)", file=sys.stderr, flush=True)
+                  f"sanitized, {len(mem_rows)} peak-HBM estimates, "
+                  f"{len(spmd_rows)} spmd verdicts)",
+                  file=sys.stderr, flush=True)
             rung.update(ok=True, compile_s=round(dt, 1),
-                        errors=0, families=families)
+                        errors=0, families=families,
+                        peak_hbm={r["entry"]: r["peak_gib"]
+                                  for r in mem_rows},
+                        spmd_ok={e: r["ok"]
+                                 for e, r in spmd_rows.items()})
         else:
             worst = [f.format() for f in report["findings"]
                      if not f.suppressed and f.severity == "error"][:3]

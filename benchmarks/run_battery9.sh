@@ -113,12 +113,20 @@ run obs_smoke     1800 'telemetry leg: OK' env \
                        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
                        python -c 'import __graft_entry__ as g; g.dryrun_telemetry(8)'
 # 4f — static-analysis self-check (analysis PR): the full self-run
-#      (trace-hygiene lint + jaxpr auditors) plus the SEEDED kernel-
-#      sanitizer sweep over all registered tunable families; exit 0 =
-#      zero unsuppressed findings (the tier-1 self-hosting pin run
-#      standalone). The same check also rides the overlap_gate
-#      compile-only item above as its own "analysis" rung.
-run analysis_selfcheck 1800 'exit 0$' python -m apex_tpu.analysis
+#      (trace-hygiene lint + jaxpr auditors + peak-HBM estimator +
+#      SPMD deadlock checker) plus the SEEDED kernel-sanitizer sweep
+#      over all registered tunable families; exit 0 = zero unsuppressed
+#      findings across ALL five exit bits (lint=1, audit=2, sanitize=4,
+#      memory=8, spmd=16 — the tier-1 self-hosting pin run standalone).
+#      XLA_FLAGS gives the process the host devices the pp=2 pipeline
+#      entry points need (single-device hosts would degrade them to the
+#      pp=1 degenerate), and the explicit 16 GiB budget arms APX401 as
+#      a real gate instead of info inventory. The same check also rides
+#      the overlap_gate compile-only item above as its own "analysis"
+#      rung (which prints the per-entry peak-HBM/spmd table).
+run analysis_selfcheck 1800 'exit 0$' env \
+                       XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+                       python -m apex_tpu.analysis --memory-budget-gb 16
 # 5 — the WHOLE tpu tier in one invocation (19/19 + 5/5 goal)
 run tpu_full      3600 ' passed' env APEX_TPU_HW=1 python -m pytest tests/tpu -v
 # 6 — warm the driver's exact path last

@@ -1,5 +1,6 @@
 """apex_tpu.analysis: lint-rule corpus, jaxpr auditors, kernel
-sanitizer, and the self-hosting pin.
+sanitizer, peak-HBM estimator, SPMD deadlock checker, and the
+self-hosting pin.
 
 Layout mirrors the subsystem:
 
@@ -12,9 +13,19 @@ Layout mirrors the subsystem:
 * sanitizer checks: the registered families validate over a seeded
   subsample (full sweep is ``slow``-marked), and a deliberately broken
   BlockSpec fixture is rejected,
+* memory-estimator checks: liveness arithmetic on known chains, the
+  donated-but-escaping APX402 fixture, the over-budget APX401 fixture,
+  and the TP-scaling parity pin (sharded bert step ~ replicated /
+  axis_size),
+* spmd-checker checks: the known-bad jaxpr corpus — branch-divergent
+  collective under an axis_index cond (APX501), non-bijective pipeline
+  ppermute chain (APX502), incompatible phase rotations (APX503) —
+  each pinned to exactly its rule, with the safe twins silent,
 * the self-run pin: ``apex_tpu.analysis.run`` over the installed
   package reports ZERO unsuppressed findings — the suite lints every
-  future PR.
+  future PR. Entry-point expectations are derived from
+  ``default_entry_points()`` itself, so adding an entry point does not
+  touch unrelated assertions.
 """
 
 import os
@@ -339,6 +350,70 @@ def test_apx105_silent_on_lax_cond_and_host_code():
 
 
 # ---------------------------------------------------------------------------
+# APX106 — late-binding index-map closures
+# ---------------------------------------------------------------------------
+
+def test_apx106_fires_on_loop_captured_blockspec_lambda():
+    findings = _lint("""
+        from jax.experimental import pallas as pl
+        def build(n, bm):
+            specs = []
+            for k in range(n):
+                specs.append(pl.BlockSpec((bm, bm), lambda i: (i, k)))
+            return specs
+    """)
+    assert "APX106" in _rules(findings)
+
+
+def test_apx106_fires_on_index_map_kwarg_and_comprehension():
+    findings = _lint("""
+        from jax.experimental import pallas as pl
+        def build(n, bm):
+            return [pl.BlockSpec((bm,), index_map=lambda i: (i + k,))
+                    for k in range(n)]
+    """)
+    assert "APX106" in _rules(findings)
+
+
+def test_apx106_silent_on_default_bound_lambda():
+    """The sanctioned fix — lambda i, k=k: ... — rebinds the name."""
+    findings = _lint("""
+        from jax.experimental import pallas as pl
+        def build(n, bm):
+            specs = []
+            for k in range(n):
+                specs.append(pl.BlockSpec((bm, bm),
+                                          lambda i, k=k: (i, k)))
+            return specs
+    """)
+    assert "APX106" not in _rules(findings)
+
+
+def test_apx106_silent_outside_loops_and_on_non_loop_names():
+    findings = _lint("""
+        from jax.experimental import pallas as pl
+        def build(bm, heads):
+            spec = pl.BlockSpec((bm, bm), lambda i: (i, heads))
+            maps = []
+            for k in range(4):
+                maps.append(pl.BlockSpec((bm,), lambda i: (i,)))
+            return spec, maps
+    """)
+    assert "APX106" not in _rules(findings)
+
+
+def test_apx106_pragma_suppresses():
+    findings = _lint("""
+        from jax.experimental import pallas as pl
+        def build(n, bm):
+            return [pl.BlockSpec((bm,), lambda i: (i, k))  # apexlint: disable=APX106
+                    for k in range(n)]
+    """)
+    assert "APX106" not in _rules(findings)
+    assert "APX106" in _rules(findings, include_suppressed=True)
+
+
+# ---------------------------------------------------------------------------
 # findings / pragma plumbing
 # ---------------------------------------------------------------------------
 
@@ -354,21 +429,32 @@ def test_layer_bits_and_exit_code():
     assert layer_bit("APX101") == 1
     assert layer_bit("APX203") == 2
     assert layer_bit("APX304") == 4
+    assert layer_bit("APX401") == 8
+    assert layer_bit("APX502") == 16
     findings = [Finding("APX101", "a.py", 1, "m"),
                 Finding("APX301", "b.py", 1, "m"),
                 Finding("APX305", "c.py", 1, "m")]  # info: never fails
     rep = summarize(findings)
     assert rep["exit_code"] == 5
     assert rep["errors"] == 2
+    rep = summarize([Finding("APX402", "<e>", 0, "m"),
+                     Finding("APX501", "<e>", 0, "m")])
+    assert rep["exit_code"] == 8 | 16
+    # the APX401 inventory form (under budget / no budget) never fails
+    rep = summarize([Finding("APX401", "<e>", 0, "m", severity="info")])
+    assert rep["exit_code"] == 0
 
 
 def test_rule_catalog_is_stable():
     assert set(RULES) == {
-        "APX101", "APX102", "APX103", "APX104", "APX105",
+        "APX101", "APX102", "APX103", "APX104", "APX105", "APX106",
         "APX201", "APX202", "APX203",
         "APX301", "APX302", "APX303", "APX304", "APX305",
+        "APX401", "APX402",
+        "APX501", "APX502", "APX503",
     }
     assert RULES["APX305"].severity == "info"
+    assert RULES["APX401"].severity == "error"  # info form is per-finding
 
 
 # ---------------------------------------------------------------------------
@@ -552,17 +638,42 @@ def test_apx203_silent_on_valid_ring():
     assert audit_collectives(closed, {"ring": n}, "<t>") == []
 
 
+# The subsystems the auditor registry must always cover. Derived-name
+# checks (⊆, not ==) so ADDING an entry point never touches this test —
+# the de-brittling the old hardcoded count pin (5→6 every PR) needed.
+_REQUIRED_ENTRY_POINTS = {
+    "train_step", "ddp_bucket_flush", "zero_scatter_flush",
+    "overlap_tp_matmul", "serving_paged_decode", "serving_ragged_verify",
+    "serving_unified_step", "pp_1f1b_train_step",
+    "pp_interleaved_train_step",
+}
+
+
 def test_default_entry_points_audit_clean():
     """The repo's own representative programs (train step, DDP/ZeRO
     flushes, decomposed TP matmul, paged decode, ragged speculative
-    verify) pass all three audits."""
+    verify, unified serving step, pipeline 1F1B + interleaved) pass all
+    three audits."""
     from apex_tpu.analysis.auditors import (audit_entry_points,
                                             default_entry_points)
 
     eps = default_entry_points()
-    assert len(eps) == 6
+    names = {ep.name for ep in eps}
+    assert _REQUIRED_ENTRY_POINTS <= names, (
+        f"missing entry points: {_REQUIRED_ENTRY_POINTS - names}")
+    assert len(names) == len(eps), "entry-point names must be unique"
     findings = audit_entry_points(eps)
     assert [f.format() for f in findings] == []
+
+
+def test_pipeline_entry_points_ride_a_pp2_mesh():
+    """On the hermetic 8-device CPU mesh the pipeline entries audit the
+    REAL 2-stage ring (pp=1 is only the single-device degenerate)."""
+    from apex_tpu.analysis.auditors import default_entry_points
+
+    by_name = {ep.name: ep for ep in default_entry_points()}
+    for name in ("pp_1f1b_train_step", "pp_interleaved_train_step"):
+        assert by_name[name].axis_sizes == {"stage": 2}
 
 
 # ---------------------------------------------------------------------------
@@ -693,6 +804,311 @@ def test_swept_vmem_busts_become_info_not_errors():
 
 
 # ---------------------------------------------------------------------------
+# memory estimator (APX401 / APX402)
+# ---------------------------------------------------------------------------
+
+def _f32(n):
+    return np.ones((n,), np.float32)
+
+
+def test_memory_liveness_arithmetic_on_known_chain():
+    """x -> y -> z with the input held to program end: peak = 3 arrays
+    while eqn 1 runs; donating x releases it after its last use."""
+    from apex_tpu.analysis.memory import estimate_peak_hbm
+
+    def chain(x):
+        y = x * 2.0
+        return y + 1.0
+
+    est = estimate_peak_hbm(chain, (_f32(1024),))
+    assert est.peak_bytes == 3 * 4096
+    est_d = estimate_peak_hbm(chain, (_f32(1024),), donate_argnums=(0,))
+    assert est_d.peak_bytes == 2 * 4096
+
+
+def test_memory_residents_carry_def_use_sites():
+    from apex_tpu.analysis.memory import estimate_peak_hbm
+
+    est = estimate_peak_hbm(lambda x: (x * 2.0) + 1.0, (_f32(256),))
+    top = est.residents[0]
+    assert top.bytes == 1024
+    assert top.defined.startswith(("arg[", "jaxpr:eqn"))
+    assert top.last_use in ("output",) or top.last_use.startswith("eqn")
+
+
+def test_apx402_fires_on_donated_but_escaping_buffer():
+    """The known-bad fixture: a value donated into a jitted step is
+    returned by the harness — the donation never frees it."""
+    from apex_tpu.analysis.memory import audit_memory
+
+    step = jax.jit(lambda x: x * 2.0, donate_argnums=0)
+
+    def leak(x):
+        y = step(x)
+        return y, x
+
+    closed = jax.make_jaxpr(leak)(_f32(4))
+    findings, _ = audit_memory(closed, "<t>")
+    errors = [f for f in findings if f.severity == "error"]
+    assert _rules(errors) == ["APX402"]
+
+
+def test_apx402_silent_on_correct_donation_protocol():
+    from apex_tpu.analysis.memory import audit_memory
+
+    step = jax.jit(lambda x: x * 2.0, donate_argnums=0)
+
+    def good(x):
+        y = step(x)
+        return y + 1.0
+
+    closed = jax.make_jaxpr(good)(_f32(4))
+    findings, summary = audit_memory(closed, "<t>")
+    assert [f for f in findings if f.severity == "error"] == []
+    # the inventory finding still rides (info), with the peak in it
+    assert _rules(findings, include_suppressed=True) == ["APX401"]
+    assert summary["peak_bytes"] > 0
+
+
+def test_apx401_fires_on_over_budget_toy_model():
+    from apex_tpu.analysis.memory import audit_memory
+
+    def big(x):
+        return (x @ x.T).sum()
+
+    closed = jax.make_jaxpr(big)(np.ones((2048, 2048), np.float32))
+    findings, summary = audit_memory(closed, "<t>",
+                                     budget_bytes=float(1 << 20))
+    errors = [f for f in findings if f.severity == "error"]
+    assert _rules(errors) == ["APX401"]
+    assert summary["over_budget"]
+    # raising the budget turns the same finding into info inventory
+    findings, summary = audit_memory(closed, "<t>",
+                                     budget_bytes=float(1 << 33))
+    assert [f for f in findings if f.severity == "error"] == []
+    assert not summary["over_budget"]
+
+
+def test_estimate_peak_hbm_tp_scaling_parity():
+    """The planner contract: the TP bert step's per-device estimate
+    shrinks ~1/axis_size when the model axis grows 1 -> 2 (the step is
+    parameter-dominated at this shape, so the band is around 1/2)."""
+    from apex_tpu.parallel.mesh import cpu_mesh
+    from apex_tpu.testing import (TransformerConfig, bert_loss,
+                                  param_specs, smap, transformer_init)
+    from apex_tpu.tuning.cost_model import estimate_peak_hbm
+    from jax.sharding import PartitionSpec as P
+
+    cfg = TransformerConfig(vocab_size=256, seq_len=16, hidden=128,
+                            layers=2, heads=4, causal=False,
+                            dtype=jnp.float32)
+    params0 = transformer_init(jax.random.PRNGKey(0), cfg)
+
+    def step_for(tp):
+        mesh = cpu_mesh({"model": tp})
+
+        def _loss(p, tokens, labels, mask):
+            return smap(
+                lambda p_, t_, l_, m_: bert_loss(p_, t_, l_, m_, cfg),
+                mesh, (param_specs(cfg), P(), P(), P()), P(),
+            )(p, tokens, labels, mask)
+
+        step = jax.jit(
+            lambda p, t, l, m: jax.tree.map(
+                lambda w, g: w - 1e-3 * g, p,
+                jax.grad(_loss)(p, t, l, m)),
+            donate_argnums=0)
+        return mesh, (lambda p, t, l, m: step(p, t, l, m))
+
+    def args():
+        tokens = np.zeros((2, cfg.seq_len), np.int32)
+        labels = np.zeros((2, cfg.seq_len), np.int32)
+        mask = np.ones((2, cfg.seq_len), bool)
+        return (params0, tokens, labels, mask)
+
+    peaks = {}
+    for tp in (1, 2):
+        mesh, fn = step_for(tp)
+        est = estimate_peak_hbm(fn, args(), mesh,
+                                (param_specs(cfg), P(), P(), P()))
+        peaks[tp] = est.peak_bytes
+    ratio = peaks[2] / peaks[1]
+    assert 0.4 < ratio < 0.75, (peaks, ratio)
+
+
+def test_leaf_factors_prefix_specs_and_mismatch():
+    from apex_tpu.analysis.memory import leaf_factors, spec_factor
+    from jax.sharding import PartitionSpec as P
+
+    sizes = {"model": 4, "data": 2}
+    assert spec_factor(P("model", None), sizes) == 4
+    assert spec_factor(P(("model", "data")), sizes) == 8
+    assert spec_factor(None, sizes) == 1
+    args = ({"w": np.zeros((4, 4)), "b": np.zeros((4,))}, np.zeros((2,)))
+    # a single prefix spec covers the whole params subtree
+    fs = leaf_factors(args, (P("model"), P()), sizes)
+    assert fs == [4, 4, 1]
+    with pytest.raises(ValueError, match="specs tree"):
+        leaf_factors(args, (P("model"),), sizes)
+
+
+# ---------------------------------------------------------------------------
+# spmd checker (APX501 / APX502 / APX503)
+# ---------------------------------------------------------------------------
+
+def _spmd(fn, axis_sizes, arg=None):
+    from apex_tpu.analysis.spmd import audit_spmd
+
+    closed = jax.make_jaxpr(
+        fn, axis_env=list(axis_sizes.items()))(
+        np.ones((8,), np.float32) if arg is None else arg)
+    return audit_spmd(closed, axis_sizes, "<t>")
+
+
+def test_apx501_fires_on_axis_index_divergent_collectives():
+    findings, summary = _spmd(
+        lambda x: jax.lax.cond(jax.lax.axis_index("ring") == 0,
+                               lambda v: jax.lax.psum(v, "ring"),
+                               lambda v: v, x),
+        {"ring": 4})
+    assert _rules(findings) == ["APX501"]
+    assert not summary["ok"]
+
+
+def test_apx501_silent_on_disjoint_axis():
+    """The pipeline engine's legality argument: a stage-varying
+    predicate around model-axis collectives is safe — every tp peer of
+    a stage shares the predicate."""
+    findings, _ = _spmd(
+        lambda x: jax.lax.cond(jax.lax.axis_index("stage") == 0,
+                               lambda v: jax.lax.psum(v, "model"),
+                               lambda v: v, x),
+        {"stage": 2, "model": 2})
+    assert findings == []
+
+
+def test_apx501_silent_on_data_dependent_predicate():
+    findings, _ = _spmd(
+        lambda x: jax.lax.cond(x[0] > 0,
+                               lambda v: jax.lax.psum(v, "ring"),
+                               lambda v: v, x),
+        {"ring": 4})
+    assert findings == []
+
+
+def test_apx502_fires_on_non_bijective_pipeline_chain():
+    """The known-bad fixture: a steady-state permute where rank 2 never
+    receives and rank 3 never sends — mispaired send/recv."""
+    def bad(x):
+        def body(c, _):
+            return jax.lax.ppermute(
+                c, "ring", [(0, 1), (1, 0), (2, 3)]), None
+        return jax.lax.scan(body, x, jnp.arange(3))[0]
+
+    findings, _ = _spmd(bad, {"ring": 4})
+    assert _rules(findings) == ["APX502"]
+    assert any("never send" in f.message or "never receive" in f.message
+               for f in findings)
+
+
+def test_apx502_silent_on_total_ring_and_outside_loops():
+    def ring(x):
+        def body(c, _):
+            return jax.lax.ppermute(
+                c, "ring", [(i, (i + 1) % 4) for i in range(4)]), None
+        return jax.lax.scan(body, x, jnp.arange(3))[0]
+
+    assert _spmd(ring, {"ring": 4})[0] == []
+
+    # a one-shot partial shift in straight-line code is NOT a schedule
+    def shift(x):
+        return jax.lax.ppermute(x, "ring", [(0, 1), (1, 2)])
+
+    assert _spmd(shift, {"ring": 4})[0] == []
+
+
+def test_apx503_fires_on_incompatible_phase_rotations():
+    def bad(x):
+        def b1(c, _):
+            return jax.lax.ppermute(
+                c, "ring", [(i, (i + 1) % 4) for i in range(4)]), None
+
+        def b2(c, _):
+            return jax.lax.ppermute(
+                c, "ring", [(i, (i + 2) % 4) for i in range(4)]), None
+
+        y = jax.lax.scan(b1, x, jnp.arange(2))[0]
+        return jax.lax.scan(b2, y, jnp.arange(2))[0]
+
+    findings, _ = _spmd(bad, {"ring": 4})
+    assert _rules(findings) == ["APX503"]
+
+
+def test_apx503_sees_phases_nested_in_cond_branches():
+    """A schedule phase behind a data-dependent cond (e.g. a gated
+    cooldown) still joins the phase-consistency post-pass."""
+    def bad(x):
+        def b1(c, _):
+            return jax.lax.ppermute(
+                c, "ring", [(i, (i + 1) % 4) for i in range(4)]), None
+
+        def b2(c, _):
+            return jax.lax.ppermute(
+                c, "ring", [(i, (i + 2) % 4) for i in range(4)]), None
+
+        y = jax.lax.scan(b1, x, jnp.arange(2))[0]
+        return jax.lax.cond(
+            x[0] > 0,
+            lambda v: jax.lax.scan(b2, v, jnp.arange(2))[0],
+            lambda v: v, y)
+
+    findings, summary = _spmd(bad, {"ring": 4})
+    assert _rules(findings) == ["APX503"]
+    assert summary["loop_phases"] == 2
+
+
+def test_apx503_silent_on_forward_plus_inverse_phases():
+    """Forward wave + transposed backward wave is exactly what autodiff
+    produces — must stay legal."""
+    def ok(x):
+        def b1(c, _):
+            return jax.lax.ppermute(
+                c, "ring", [(i, (i + 1) % 4) for i in range(4)]), None
+
+        def b2(c, _):
+            return jax.lax.ppermute(
+                c, "ring", [(i, (i - 1) % 4) for i in range(4)]), None
+
+        y = jax.lax.scan(b1, x, jnp.arange(2))[0]
+        return jax.lax.scan(b2, y, jnp.arange(2))[0]
+
+    assert _spmd(ok, {"ring": 4})[0] == []
+
+
+def test_pipeline_entry_points_clean_under_memory_and_spmd():
+    """The forcing function: the REAL 1F1B and interleaved schedules
+    (fwd scan + remat'd recompute + transposed backward) pass the
+    ppermute pairing and phase-consistency checks, and the memory walk
+    descends their scan/remat nests without error."""
+    from apex_tpu.analysis.auditors import default_entry_points, trace_entry
+    from apex_tpu.analysis.memory import audit_memory, leaf_factors
+    from apex_tpu.analysis.spmd import audit_spmd
+
+    by_name = {ep.name: ep for ep in default_entry_points()}
+    for name in ("pp_1f1b_train_step", "pp_interleaved_train_step"):
+        ep = by_name[name]
+        closed, args0 = trace_entry(ep)
+        sfind, srow = audit_spmd(closed, ep.axis_sizes, ep.tag)
+        assert [f.format() for f in sfind] == []
+        assert srow["ok"] and srow["loop_phases"] >= 2
+        assert srow["collectives"] > 0
+        factors = leaf_factors(args0, ep.specs, ep.axis_sizes)
+        mfind, mrow = audit_memory(closed, ep.tag, factors=factors)
+        assert [f for f in mfind if f.severity == "error"] == []
+        assert mrow["peak_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
 # CLI + self-hosting pin
 # ---------------------------------------------------------------------------
 
@@ -726,6 +1142,40 @@ def test_cli_list_rules(capsys):
     assert "APX101" in out and "APX304" in out
 
 
+def test_cli_no_memory_no_spmd_flags(tmp_path, capsys):
+    """--no-memory / --no-spmd skip the layers (no stats rows, no
+    entry-point tracing beyond what --no-audit already skips)."""
+    import json
+
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    from apex_tpu.analysis.cli import main
+
+    code = main([str(ok), "--no-audit", "--no-sanitize", "--no-memory",
+                 "--no-spmd", "--json"])
+    rep = json.loads(capsys.readouterr().out)
+    assert code == 0
+    assert "memory" not in rep["stats"]
+    assert "spmd" not in rep["stats"]
+    # --no-audit must not claim APX2xx coverage that did not happen
+    assert "audited_entry_points" not in rep["stats"]
+
+
+def test_env_float_budget_knob(monkeypatch):
+    from apex_tpu.utils.envvars import env_float
+
+    monkeypatch.setenv("APEX_TPU_ANALYSIS_HBM_GB", "1.5")
+    assert env_float("APEX_TPU_ANALYSIS_HBM_GB") == 1.5
+    monkeypatch.setenv("APEX_TPU_ANALYSIS_HBM_GB", "banana")
+    with pytest.raises(ValueError, match="APEX_TPU_ANALYSIS_HBM_GB"):
+        env_float("APEX_TPU_ANALYSIS_HBM_GB")
+    monkeypatch.setenv("APEX_TPU_ANALYSIS_HBM_GB", "-2")
+    with pytest.raises(ValueError, match="APEX_TPU_ANALYSIS_HBM_GB"):
+        env_float("APEX_TPU_ANALYSIS_HBM_GB")
+    monkeypatch.delenv("APEX_TPU_ANALYSIS_HBM_GB")
+    assert env_float("APEX_TPU_ANALYSIS_HBM_GB") is None
+
+
 def test_strict_promotes_warnings(monkeypatch):
     warn = Finding("APX101", "a.py", 1, "m", severity="warn")
     assert summarize([warn])["exit_code"] == 0
@@ -735,7 +1185,12 @@ def test_strict_promotes_warnings(monkeypatch):
 def test_self_run_is_clean():
     """THE self-hosting pin: the analyzer over its own package reports
     zero unsuppressed findings (lint + auditors + seeded sanitizer
-    subsample). Every future PR is linted by this test."""
+    subsample + memory estimator + spmd checker). Every future PR is
+    linted, memory-audited and deadlock-audited by this test. The
+    expected entry-point set derives from default_entry_points() itself
+    — adding an entry point must not touch this assertion."""
+    from apex_tpu.analysis.auditors import default_entry_points
+
     report = run()
     findings = report["findings"]
     unsuppressed = [f.format() for f in findings
@@ -744,4 +1199,15 @@ def test_self_run_is_clean():
     assert report["exit_code"] == 0
     assert report["errors"] == 0
     assert report["stats"]["lint_files"] > 40
-    assert report["stats"]["audited_entry_points"] == 6
+    expected = {ep.tag for ep in default_entry_points()}
+    assert report["stats"]["audited_entry_points"] == len(expected)
+    # every registered entry point got a peak-HBM estimate AND a
+    # collective-sequence verdict (the acceptance pin for the new layers)
+    assert {r["entry"] for r in report["stats"]["memory"]} == expected
+    assert {r["entry"] for r in report["stats"]["spmd"]} == expected
+    assert all(r["peak_bytes"] > 0 for r in report["stats"]["memory"])
+    assert all(r["ok"] for r in report["stats"]["spmd"])
+    # with no budget set the APX401 inventory rides as info, one per entry
+    inv = [f for f in findings if f.rule == "APX401"]
+    assert len(inv) == len(expected)
+    assert all(f.severity == "info" for f in inv)
