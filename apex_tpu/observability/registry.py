@@ -83,7 +83,18 @@ def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
 
 class _Instrument:
     """Shared label-series bookkeeping. Subclasses hold one value (or
-    histogram state) per distinct label set."""
+    histogram state) per distinct label set.
+
+    Read semantics: the accessors (``value``/``count``/``sum``) match
+    every series whose label set CONTAINS the queried labels — the
+    Prometheus aggregation convention. A label-less read therefore
+    aggregates across all series, so instrumentation can gain a
+    dimension (e.g. the serving metrics' ``replica`` label) without
+    breaking existing label-less readers: sums/counts add across the
+    matches, a gauge read resolves only when it is unambiguous. The
+    per-series breakdown is always available via ``series()``/
+    snapshots — aggregation is a READ convenience, storage never
+    collapses."""
 
     kind = "instrument"
 
@@ -94,6 +105,12 @@ class _Instrument:
 
     def _enabled(self) -> bool:
         return self._reg.enabled
+
+    def _matches(self, labels: dict) -> List[object]:
+        """Values of every series whose label set is a superset of
+        ``labels`` (the exact series included)."""
+        want = set(_label_key(labels))
+        return [v for k, v in self._series.items() if want <= set(k)]
 
     def series(self) -> List[dict]:
         out = []
@@ -118,7 +135,9 @@ class Counter(_Instrument):
             self._series[key] = self._series.get(key, 0.0) + float(value)
 
     def value(self, **labels) -> float:
-        return float(self._series.get(_label_key(labels), 0.0))
+        """Sum over every series matching ``labels`` (see _Instrument's
+        read semantics) — a label-less read is the all-series total."""
+        return float(sum(self._matches(labels)))
 
 
 class Gauge(_Instrument):
@@ -133,8 +152,16 @@ class Gauge(_Instrument):
             self._series[_label_key(labels)] = float(value)
 
     def value(self, **labels) -> Optional[float]:
+        """The matching series' value. Gauges don't sum: an exact label
+        match wins; otherwise the read resolves only when exactly ONE
+        series matches (e.g. a label-less read of a single-replica
+        gauge) and is ``None`` when ambiguous — disambiguate with more
+        labels or read ``series()``."""
         v = self._series.get(_label_key(labels))
-        return None if v is None else float(v)
+        if v is not None:
+            return float(v)
+        matches = self._matches(labels)
+        return float(matches[0]) if len(matches) == 1 else None
 
 
 class Histogram(_Instrument):
@@ -169,12 +196,12 @@ class Histogram(_Instrument):
             st["count"] += 1
 
     def count(self, **labels) -> int:
-        st = self._series.get(_label_key(labels))
-        return 0 if st is None else int(st["count"])
+        """Observation count summed over every matching series."""
+        return int(sum(st["count"] for st in self._matches(labels)))
 
     def sum(self, **labels) -> float:
-        st = self._series.get(_label_key(labels))
-        return 0.0 if st is None else float(st["sum"])
+        """Observed-value sum over every matching series."""
+        return float(sum(st["sum"] for st in self._matches(labels)))
 
     def series(self) -> List[dict]:
         out = []

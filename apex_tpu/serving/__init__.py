@@ -24,12 +24,28 @@ Three layers (docs/serving.md):
                    one ``query_len = K + 1`` run, greedy longest-prefix
                    acceptance keeps output bitwise identical to
                    non-speculative decode.
+- ``fleet``      — the service layer over N engine replicas: SLO
+                   classes (latency vs batch), a load-aware Router
+                   (placement over live KV-occupancy / queue-depth /
+                   estimated-work signals), preemption + requeue, and
+                   replica fault tolerance with bitwise-identical
+                   greedy recovery.
 """
 
 from apex_tpu.serving.engine import (  # noqa: F401
     ServingConfig,
     ServingEngine,
+    ServingSession,
     greedy_reference,
+)
+from apex_tpu.serving.fleet import (  # noqa: F401
+    BATCH,
+    LATENCY,
+    FaultPlan,
+    InjectedReplicaFault,
+    Replica,
+    ReplicaSignals,
+    Router,
 )
 from apex_tpu.serving.kv_cache import (  # noqa: F401
     PagedKVCache,
@@ -61,9 +77,11 @@ from apex_tpu.serving.speculative import (  # noqa: F401
 )
 
 __all__ = [
-    "Drafter", "DraftModelDrafter", "NgramDrafter", "PagedKVCache",
-    "PrefixIndex", "Request", "Scheduler", "ServingConfig",
-    "ServingEngine", "StubDrafter", "alloc_decode_blocks", "allocate_slot",
+    "BATCH", "Drafter", "DraftModelDrafter", "FaultPlan",
+    "InjectedReplicaFault", "LATENCY", "NgramDrafter", "PagedKVCache",
+    "PrefixIndex", "Replica", "ReplicaSignals", "Request", "Router",
+    "Scheduler", "ServingConfig", "ServingEngine", "ServingSession",
+    "StubDrafter", "alloc_decode_blocks", "allocate_slot",
     "append_layer", "blocks_needed", "cache_pspecs", "check_invariants",
     "cow_append", "extend_slots", "free_block_count", "free_slot",
     "greedy_reference", "grow_slots", "paged_kv_cache", "release_blocks",

@@ -87,6 +87,7 @@ from apex_tpu.ops.paged_attention import (
     ragged_paged_attention,
 )
 from apex_tpu.serving import kv_cache as kc
+from apex_tpu.serving.fleet import slo as slo_mod
 from apex_tpu.serving.scheduler import Request, Scheduler
 from apex_tpu.testing.commons import smap
 from apex_tpu.testing.standalone_transformer import (
@@ -340,7 +341,8 @@ class ServingEngine:
     other loop state is per-run host python."""
 
     def __init__(self, scfg: ServingConfig, params,
-                 mesh: Optional[Mesh] = None, drafter=None):
+                 mesh: Optional[Mesh] = None, drafter=None,
+                 replica: str = "0"):
         cfg = scfg.model
         _check_supported(cfg)
         if mesh is None:
@@ -364,6 +366,10 @@ class ServingEngine:
         self.cfg = cfg
         self.mesh = mesh
         self.params = params
+        # which fleet replica this engine is (serving/fleet): the label
+        # on every serving metric series it emits — "0" outside a fleet,
+        # so single-engine dashboards and tests see one labeled series
+        self.replica = str(replica)
         self.index: Optional[kc.PrefixIndex] = (
             kc.PrefixIndex(scfg.block_size) if scfg.prefix_cache else None)
         self._cache: Optional[kc.PagedKVCache] = None
@@ -468,6 +474,14 @@ class ServingEngine:
         return row
 
     # -- the serving loop -------------------------------------------
+    def session(self, *, cache: Optional[kc.PagedKVCache] = None
+                ) -> "ServingSession":
+        """Open an INCREMENTAL serving session: the same loop ``run``
+        drives, one ``step_once`` at a time — the fleet Router's entry
+        point (serving/fleet), so N replicas' steps interleave on one
+        host with live load signals readable between them."""
+        return ServingSession(self, cache=cache)
+
     def run(self, requests: List[Request], *, max_steps: int = 10_000,
             cache: Optional[kc.PagedKVCache] = None) -> Dict[object, dict]:
         """Serve ``requests`` (arrival-staggered) to completion. Returns
@@ -475,261 +489,21 @@ class ServingEngine:
         engine stats under the reserved key ``None``. With no explicit
         ``cache`` the engine's persistent cache (and prefix index) carry
         over from the previous run — the warm path; passing a cache
-        resets the index (its block ids would dangle)."""
-        s = self.scfg
-        if cache is None:
-            cache = self._cache if self._cache is not None \
-                else self.fresh_cache()
-        elif self.index is not None:
-            self.index = kc.PrefixIndex(s.block_size)
-        held = len(self.index) if self.index is not None else 0
-        sched = Scheduler(
-            max_slots=s.max_slots, num_blocks=s.num_blocks - held,
-            block_size=s.block_size,
-            max_blocks_per_seq=s.max_blocks_per_seq,
-            watermark=s.watermark, chunk_tokens=s.chunk_tokens,
-            prefix_index=self.index,
-            spec_k=s.spec_k if self.drafter is not None else 0)
+        resets the index (its block ids would dangle). Exactly
+        open-session → step until idle → finalize (ServingSession is the
+        loop; this is the one-engine driver of it)."""
+        sess = ServingSession(self, cache=cache)
+        # fail fast at intake, BEFORE the reset-on-failure guard: a bad
+        # request must not surface as silent KV corruption mid-batch —
+        # and since nothing has been donated yet, it must not cost the
+        # engine its warm cache/index either
         for r in requests:
-            # fail fast at intake: a bad request must not surface as
-            # silent KV corruption mid-batch, after other requests
-            # already prefilled into the donated cache
-            if len(r.prompt) + r.max_new_tokens > s.max_seq_len:
-                raise ValueError(
-                    f"request {r.rid!r}: prompt + max_new_tokens = "
-                    f"{len(r.prompt) + r.max_new_tokens} exceeds "
-                    f"max_seq_len {s.max_seq_len}")
-            sched.add(r)
-        gen: Dict[int, List[int]] = {}                 # slot -> tokens
-        out: Dict[object, dict] = {}
-        stats = {"steps": 0, "prefills": 0, "decode_steps": 0,
-                 "decode_tokens": 0, "chunk_steps": 0, "chunk_tokens": 0,
-                 "prefix_hit_tokens": 0, "prefix_miss_tokens": 0,
-                 "spec_drafted_tokens": 0, "spec_accepted_tokens": 0,
-                 "prefill_s": 0.0, "decode_s": 0.0}
-        waiting_since: Dict[object, float] = {}        # rid -> wall ts
-        # host-side telemetry (docs/observability.md): everything below
-        # records OUTSIDE the jitted step, so the step HLO and the
-        # one-compile contract are untouched with metrics on
-        kv_free_min = sched.free_blocks
-        if metrics_enabled():
-            # materialize the event counters at 0 so a quiet run still
-            # exports the full serving series set (the scheduler never
-            # preempts today; the counter is the dashboard's contract
-            # for when it does)
-            reg = default_registry()
-            names = ["serving/admissions", "serving/evictions",
-                     "serving/preemptions",
-                     "serving/admission_blocked",
-                     "serving/prefix_hit_tokens",
-                     "serving/prefix_miss_tokens"]
-            if self.drafter is not None:
-                names += ["serving/spec_drafted_tokens",
-                          "serving/spec_accepted_tokens"]
-            for name in names:
-                reg.counter(name).inc(0)
-            set_gauge("serving/kv_blocks_total", s.num_blocks)
-            set_gauge("serving/kv_watermark", sched.watermark)
-
-        def finish(slot):
-            nonlocal cache
-            st = sched.running[slot]
-            out[st.req.rid]["tokens"] = gen.pop(slot)
-            newly: List[int] = []
-            if self.index is not None:
-                n_full = len(st.req.prompt) // s.block_size
-                if n_full:
-                    # one small host fetch per FINISHED request — the
-                    # index needs the slot's concrete page ids
-                    row = self._table_row(cache, slot, n_full)
-                    newly = self.index.insert(st.req.prompt,
-                                              [int(b) for b in row])
-                    if newly:
-                        cache = self._retain(cache, self._ids_row(newly),
-                                             jnp.int32(len(newly)))
-            cache = self._free(cache, jnp.int32(slot))
-            sched.release(slot, newly)
-            if self.drafter is not None:
-                self.drafter.on_finish(slot)
-
-        step = 0
+            sess.add(r)
         ok = False
         try:
-            while sched.has_work() and step < max_steps:
-                sched.tick(step)
-                for r in list(sched._waiting):
-                    waiting_since.setdefault(r.rid, time.perf_counter())
-                set_gauge("serving/queue_depth", len(sched._waiting))
-                admissions = sched.admit()
-                for b in self._batched(sched.drain_releases()):
-                    cache = self._release(cache, self._ids_row(b),
-                                          jnp.int32(len(b)))
-                for adm in admissions:
-                    hit = len(adm.shared_ids) * s.block_size
-                    stats["prefix_hit_tokens"] += hit
-                    stats["prefix_miss_tokens"] += len(adm.req.prompt) - hit
-                    cache = self._share(
-                        cache, jnp.int32(adm.slot),
-                        self._ids_row(adm.shared_ids),
-                        jnp.int32(len(adm.shared_ids)),
-                        jnp.int32(adm.n_blocks))
-                drafts: Dict[int, List[int]] = {}
-                if self.drafter is not None:
-                    # draft BEFORE planning so the scheduler charges the
-                    # actual draft counts against the chunk budget
-                    want = [(slot, k) for slot, k
-                            in sorted(sched.spec_quota().items()) if k > 0]
-                    if want:
-                        got = self.drafter.draft_batch(
-                            [(slot,
-                              sched.running[slot].req.prompt + gen[slot],
-                              k) for slot, k in want])
-                        drafts = {slot: list(got.get(slot) or [])[:k]
-                                  for slot, k in want if got.get(slot)}
-                work = sorted(
-                    sched.plan_step({sl: len(d) for sl, d in drafts.items()}
-                                    if self.drafter is not None else None),
-                    key=lambda w: w.slot)
-                if self.drafter is not None and any(w.grow for w in work):
-                    # pre-stage every page the verify windows touch, so
-                    # the in-step one-block growth stays a no-op and the
-                    # step program is byte-identical spec-on vs spec-off
-                    grow_row = np.zeros((s.max_slots,), np.int32)
-                    for w in work:
-                        grow_row[w.slot] = w.grow
-                    cache = self._grow(cache, jnp.asarray(grow_row))
-                if work:
-                    tokens = np.zeros((s.chunk_tokens,), np.int32)
-                    qs = np.zeros((s.max_slots,), np.int32)
-                    ql = np.zeros((s.max_slots,), np.int32)
-                    off = 0
-                    for w in work:                 # packed runs in slot order
-                        st = sched.running[w.slot]
-                        qs[w.slot] = off
-                        ql[w.slot] = w.n
-                        if w.kind == "chunk":
-                            tokens[off:off + w.n] = st.req.prompt[
-                                w.start:w.start + w.n]
-                        else:
-                            # a decode row, or a verify window: the last
-                            # generated token followed by the drafts
-                            tokens[off] = gen[w.slot][-1]
-                            if w.n > 1:
-                                tokens[off + 1:off + w.n] = \
-                                    drafts[w.slot][:w.n - 1]
-                        off += w.n
-                    t0 = time.perf_counter()
-                    # host-side profiler seam: marks the dispatch+wait span
-                    # in host traces without touching the compiled program
-                    with host_trace_range("serving.unified_step"):
-                        cache, nxt = self._step(
-                            self.params, cache, jnp.asarray(tokens),
-                            jnp.asarray(qs), jnp.asarray(ql))
-                    nxt = jax.device_get(nxt)     # host sync: timing honest
-                    now = time.perf_counter()
-                    dt = now - t0
-                    observe("serving/chunk_utilization", off / s.chunk_tokens,
-                            buckets=UTIL_BUCKETS)
-                    n_dec = sum(1 for w in work if w.kind == "decode")
-                    if n_dec:
-                        stats["decode_steps"] += 1
-                        stats["decode_s"] += dt
-                    else:
-                        stats["prefill_s"] += dt
-                    dec_emitted = 0
-                    if any(w.kind == "chunk" for w in work):
-                        stats["chunk_steps"] += 1
-                        stats["chunk_tokens"] += sum(
-                            w.n for w in work if w.kind == "chunk")
-                    trunc = None
-                    for w in work:
-                        st = sched.running[w.slot]
-                        rid = st.req.rid
-                        if w.kind == "decode" and w.n > 1:
-                            # speculative verify: greedy longest-prefix
-                            # acceptance — row j's output is the model's
-                            # next token after [last, d1..dj], so every
-                            # emitted token is EXACTLY the greedy
-                            # continuation (the bitwise-identity
-                            # contract), whatever the drafter proposed
-                            nd = w.n - 1
-                            d = drafts[w.slot][:nd]
-                            base = qs[w.slot]
-                            outs = [int(nxt[base + i]) for i in range(w.n)]
-                            acc = 0
-                            while acc < nd and outs[acc] == d[acc]:
-                                acc += 1
-                            emitted = outs[:acc + 1]
-                            rem = st.req.max_new_tokens - len(gen[w.slot])
-                            emitted = emitted[:rem]
-                            if s.eos_id is not None and s.eos_id in emitted:
-                                emitted = emitted[
-                                    :emitted.index(s.eos_id) + 1]
-                            gen[w.slot].extend(emitted)
-                            out[rid]["steps"] = step
-                            stats["decode_tokens"] += len(emitted)
-                            dec_emitted += len(emitted)
-                            stats["spec_drafted_tokens"] += nd
-                            stats["spec_accepted_tokens"] += acc
-                            inc_counter("serving/spec_drafted_tokens", nd)
-                            inc_counter("serving/spec_accepted_tokens", acc)
-                            observe("serving/spec_accept_rate", acc / nd,
-                                    buckets=SPEC_BUCKETS)
-                            fin = (len(gen[w.slot])
-                                   >= st.req.max_new_tokens
-                                   or emitted[-1] == s.eos_id)
-                            new_len = sched.note_spec(w.slot, nd, acc, fin)
-                            if fin:
-                                finish(w.slot)
-                            elif acc < nd:
-                                # rejected drafts: roll their K/V
-                                # positions back and release the
-                                # over-allocated suffix pages
-                                if trunc is None:
-                                    trunc = np.full((s.max_slots,),
-                                                    _I32_MAX, np.int32)
-                                trunc[w.slot] = new_len
-                        elif w.kind == "decode":
-                            tok = int(nxt[qs[w.slot]])
-                            gen[w.slot].append(tok)
-                            out[rid]["steps"] = step
-                            stats["decode_tokens"] += 1
-                            dec_emitted += 1
-                            if (len(gen[w.slot]) >= st.req.max_new_tokens
-                                    or tok == s.eos_id):
-                                finish(w.slot)
-                        elif w.completes_prompt:
-                            tok = int(nxt[qs[w.slot] + w.n - 1])
-                            gen[w.slot] = [tok]
-                            stats["prefills"] += 1
-                            ttft = now - waiting_since.get(rid, t0)
-                            observe("serving/ttft_s", ttft,
-                                    buckets=TIME_BUCKETS)
-                            out[rid] = {"ttft_step": step, "steps": step,
-                                        "ttft_s": ttft}
-                            if st.req.max_new_tokens == 1 or tok == s.eos_id:
-                                finish(w.slot)
-                    if trunc is not None:
-                        cache = self._truncate(cache, jnp.asarray(trunc))
-                    if n_dec:
-                        # per-token decode latency: the step emitted
-                        # dec_emitted tokens across n_dec decode slots.
-                        # Without speculation dec_emitted == n_dec and
-                        # this is exactly the step latency; a verify
-                        # window emitting K+1 tokens divides its step
-                        # cost across them, keeping TPOT honest spec-on
-                        observe("serving/tpot_s",
-                                dt * n_dec / max(dec_emitted, 1),
-                                buckets=TIME_BUCKETS)
-                kv_free_min = min(kv_free_min, sched.free_blocks)
-                set_gauge("serving/kv_blocks_free", sched.free_blocks)
-                set_gauge("serving/kv_occupancy",
-                          1.0 - (sched.free_blocks
-                                 + (len(self.index) if self.index else 0))
-                          / s.num_blocks)
-                set_gauge("serving/active_slots", len(sched.running))
-                step += 1
-            if sched.has_work():
+            while sess.has_work() and sess.step < max_steps:
+                sess.step_once()
+            if sess.has_work():
                 raise RuntimeError(
                     f"serving loop exceeded {max_steps} steps with work "
                     f"left")
@@ -741,27 +515,468 @@ class ServingEngine:
                 # failed run must cold-start the next one instead of
                 # serving from deleted arrays / desynced refcounts
                 self.reset_state()
-        stats["steps"] = step
-        stats["trace_counts"] = dict(self.trace_counts)
-        stats["free_blocks"] = sched.free_blocks
-        stats["index_blocks"] = len(self.index) if self.index else 0
-        stats["cache"] = cache
-        self._cache = cache
-        # low-watermark + throughput summary gauges for the whole run
-        set_gauge("serving/kv_blocks_free_min", kv_free_min)
-        if stats["decode_s"] > 0:
-            set_gauge("serving/decode_steps_per_sec",
-                      stats["decode_steps"] / stats["decode_s"])
-            set_gauge("serving/decode_tokens_per_sec",
-                      stats["decode_tokens"] / stats["decode_s"])
-        out[None] = stats
-        return out
+        return sess.finalize()
 
     def _batched(self, ids: List[int]):
         """Chunk a host id list into fixed-width release calls."""
         mb = self.scfg.max_blocks_per_seq
         for i in range(0, len(ids), mb):
             yield ids[i:i + mb]
+
+
+# ---------------------------------------------------------------------------
+# the incremental session (one "run", steppable — the fleet unit)
+# ---------------------------------------------------------------------------
+
+class ServingSession:
+    """One serving run opened incrementally: admission, SLO preemption,
+    step planning, ONE device step and finish handling per ``step_once``
+    call. ``ServingEngine.run`` is a plain loop over this object; the
+    fleet Router (serving/fleet/router.py) drives N of them round-robin,
+    reads load signals between steps, and — on preemption or replica
+    failure — moves unfinished work with its already-emitted tokens
+    carried as ``prior`` so the final greedy output is bitwise the
+    uninterrupted run's.
+
+    Resume contract (preemption/fault requeue): a resumed request is
+    reshaped to ``prompt = original prompt + emitted tokens`` with
+    ``max_new_tokens`` reduced by the emitted count; the session records
+    the emitted prefix in ``_prior`` and stitches it back onto the front
+    of the tokens at finish. Greedy decode over the re-prefilled context
+    regenerates exactly the continuation the uninterrupted run would
+    have produced (the cold/warm bitwise-parity contract), so requeueing
+    never changes output."""
+
+    def __init__(self, engine: ServingEngine, *,
+                 cache: Optional[kc.PagedKVCache] = None):
+        eng = engine
+        s = eng.scfg
+        self.eng = eng
+        if cache is None:
+            cache = eng._cache if eng._cache is not None \
+                else eng.fresh_cache()
+        elif eng.index is not None:
+            eng.index = kc.PrefixIndex(s.block_size)
+        self.cache = cache
+        held = len(eng.index) if eng.index is not None else 0
+        self.sched = Scheduler(
+            max_slots=s.max_slots, num_blocks=s.num_blocks - held,
+            block_size=s.block_size,
+            max_blocks_per_seq=s.max_blocks_per_seq,
+            watermark=s.watermark, chunk_tokens=s.chunk_tokens,
+            prefix_index=eng.index,
+            spec_k=s.spec_k if eng.drafter is not None else 0,
+            replica=eng.replica)
+        self.gen: Dict[int, List[int]] = {}            # slot -> tokens
+        self.out: Dict[object, dict] = {}
+        self.stats = {"steps": 0, "prefills": 0, "decode_steps": 0,
+                      "decode_tokens": 0, "chunk_steps": 0,
+                      "chunk_tokens": 0,
+                      "prefix_hit_tokens": 0, "prefix_miss_tokens": 0,
+                      "spec_drafted_tokens": 0, "spec_accepted_tokens": 0,
+                      "preemptions": 0, "requeues": 0, "slo_violations": 0,
+                      "prefill_s": 0.0, "decode_s": 0.0}
+        self.waiting_since: Dict[object, float] = {}   # rid -> wall ts
+        self._first_tok: Dict[object, float] = {}      # rid -> wall ts
+        self._prior: Dict[object, List[int]] = {}      # rid -> resumed toks
+        self.step = 0
+        # host-side telemetry (docs/observability.md): everything this
+        # session records happens OUTSIDE the jitted step, so the step
+        # HLO and the one-compile contract are untouched with metrics on
+        self.kv_free_min = self.sched.free_blocks
+        if metrics_enabled():
+            # materialize the event counters at 0 — with the SAME label
+            # shape the real increments carry — so a quiet run still
+            # exports the full per-replica serving series set
+            # (preemptions stays 0 until an SLO-outranked victim is
+            # actually evicted)
+            reg = default_registry()
+            names = ["serving/admissions", "serving/evictions",
+                     "serving/preemptions",
+                     "serving/admission_blocked",
+                     "serving/prefix_hit_tokens",
+                     "serving/prefix_miss_tokens"]
+            if eng.drafter is not None:
+                names += ["serving/spec_drafted_tokens",
+                          "serving/spec_accepted_tokens"]
+            for name in names:
+                reg.counter(name).inc(0, replica=eng.replica)
+            set_gauge("serving/kv_blocks_total", s.num_blocks,
+                      replica=eng.replica)
+            set_gauge("serving/kv_watermark", self.sched.watermark,
+                      replica=eng.replica)
+
+    # -- intake ------------------------------------------------------
+    def add(self, req: Request) -> None:
+        """Queue a fresh request into this session (validated here so a
+        bad request raises before anything prefills)."""
+        s = self.eng.scfg
+        if len(req.prompt) + req.max_new_tokens > s.max_seq_len:
+            raise ValueError(
+                f"request {req.rid!r}: prompt + max_new_tokens = "
+                f"{len(req.prompt) + req.max_new_tokens} exceeds "
+                f"max_seq_len {s.max_seq_len}")
+        self.sched.add(req)
+
+    def add_resumed(self, req: Request, prior: List[int]) -> None:
+        """Queue a RESUME-shaped request (its prompt already ends with
+        the ``prior`` tokens an earlier placement emitted; its
+        max_new_tokens counts only the remainder) — the fault-requeue
+        entry the Router uses. The session stitches ``prior`` back onto
+        the front of the tokens at finish, so the request's final output
+        is the uninterrupted run's."""
+        if prior:
+            self._prior[req.rid] = list(prior)
+        self.add(req)
+
+    def has_work(self) -> bool:
+        return self.sched.has_work()
+
+    def signals(self) -> Dict[str, float]:
+        """Live load snapshot — the same quantities the per-step gauges
+        export, read directly off the host mirror (no device sync):
+        the router's placement inputs."""
+        s = self.eng.scfg
+        idx = len(self.eng.index) if self.eng.index is not None else 0
+        return {
+            "queue_depth": self.sched.queue_depth(),
+            "running": len(self.sched.running),
+            "free_blocks": self.sched.free_blocks,
+            "kv_occupancy":
+                1.0 - (self.sched.free_blocks + idx) / s.num_blocks,
+            "est_work_tokens": self.sched.pending_work_tokens(),
+        }
+
+    def drain(self) -> List[tuple]:
+        """Extract every UNFINISHED request as a ``(resume_request,
+        prior_tokens)`` pair (host state only — the device cache is left
+        alone; the caller resets the engine). The Router feeds these to
+        surviving replicas via ``add_resumed`` after a replica fault."""
+        items: List[tuple] = []
+        for req in list(self.sched._future) + list(self.sched._waiting):
+            items.append((req, self._prior.get(req.rid, [])))
+        for slot in sorted(self.sched.running):
+            st = self.sched.running[slot]
+            emitted = self.gen.get(slot, [])
+            prior = self._prior.get(st.req.rid, []) + list(emitted)
+            items.append((Request(
+                rid=st.req.rid,
+                prompt=list(st.req.prompt) + list(emitted),
+                max_new_tokens=st.req.max_new_tokens - len(emitted),
+                arrival=0, slo=st.req.slo), prior))
+        return items
+
+    # -- preemption / finish ----------------------------------------
+    def _preempt(self, slot: int) -> None:
+        """Evict ``slot`` for a higher-class waiter: device table freed
+        (shared pages survive via their other refcounts), scheduler
+        mirror released (``serving/preemptions``), and the request
+        requeued at the front of its class with its emitted tokens as
+        ``prior`` — no token is lost or duplicated."""
+        eng = self.eng
+        st = self.sched.preempt(slot)
+        self.cache = eng._free(self.cache, jnp.int32(slot))
+        emitted = self.gen.pop(slot, [])
+        prior = self._prior.pop(st.req.rid, []) + list(emitted)
+        req = Request(rid=st.req.rid,
+                      prompt=list(st.req.prompt) + list(emitted),
+                      max_new_tokens=st.req.max_new_tokens - len(emitted),
+                      arrival=0, slo=st.req.slo)
+        if prior:
+            self._prior[req.rid] = prior
+        self.sched.requeue(req)
+        if eng.drafter is not None:
+            eng.drafter.on_finish(slot)
+        self.stats["preemptions"] += 1
+        self.stats["requeues"] += 1
+        inc_counter("fleet/requeues", 1, reason="preemption",
+                    replica=eng.replica)
+
+    def _finish(self, slot: int) -> None:
+        eng = self.eng
+        s = eng.scfg
+        sched = self.sched
+        st = sched.running[slot]
+        rid = st.req.rid
+        prior = self._prior.pop(rid, [])
+        emitted = self.gen.pop(slot)
+        tokens = prior + emitted
+        self.out[rid]["tokens"] = tokens
+        newly: List[int] = []
+        if eng.index is not None:
+            n_full = len(st.req.prompt) // s.block_size
+            if n_full:
+                # one small host fetch per FINISHED request — the
+                # index needs the slot's concrete page ids
+                row = eng._table_row(self.cache, slot, n_full)
+                newly = eng.index.insert(st.req.prompt,
+                                         [int(b) for b in row])
+                if newly:
+                    self.cache = eng._retain(
+                        self.cache, eng._ids_row(newly),
+                        jnp.int32(len(newly)))
+        self.cache = eng._free(self.cache, jnp.int32(slot))
+        sched.release(slot, newly)
+        if eng.drafter is not None:
+            eng.drafter.on_finish(slot)
+        # SLO verdict (serving/fleet/slo.py): judged per finished
+        # request against its class targets — batch has none. The pace
+        # is measured over THIS placement's emissions only (``emitted``,
+        # not the prior tokens a previous placement produced), so a
+        # resumed request's tpot reflects real decode speed instead of
+        # being deflated by work done elsewhere
+        cls = slo_mod.resolve_class(st.req.slo)
+        first = self._first_tok.pop(rid, None)
+        tpot = None
+        if first is not None and len(emitted) > 1:
+            tpot = (time.perf_counter() - first) / (len(emitted) - 1)
+        for kind in slo_mod.violations(cls, self.out[rid].get("ttft_s"),
+                                       tpot):
+            self.stats["slo_violations"] += 1
+            inc_counter("fleet/slo_violations", 1, slo=cls, kind=kind,
+                        replica=eng.replica)
+
+    # -- one tick of the loop ---------------------------------------
+    def step_once(self) -> None:
+        """One continuous-batching tick: arrivals, SLO preemption,
+        admission, draft/plan/pack, one fixed-shape device step, and
+        emission/finish handling — the exact body ``run`` loops over."""
+        eng = self.eng
+        s = eng.scfg
+        sched = self.sched
+        rep = eng.replica
+        gen, out, stats = self.gen, self.out, self.stats
+        step = self.step
+        sched.tick(step)
+        for r in list(sched._waiting):
+            self.waiting_since.setdefault(r.rid, time.perf_counter())
+        set_gauge("serving/queue_depth", len(sched._waiting), replica=rep)
+        admissions = sched.admit()
+        # SLO preemption: while the next admission candidate outranks a
+        # running slot and could not be admitted, evict the most recent
+        # strictly-lower-class victim and retry (greedy — bounded by the
+        # running-slot count; same-class work never preempts, so an
+        # SLO-less workload can never enter this loop)
+        while True:
+            cand = sched.peek_next()
+            if cand is None:
+                break
+            victim = sched.pick_victim(Scheduler._rank(cand))
+            if victim is None:
+                break
+            self._preempt(victim)
+            admissions += sched.admit()
+        now_adm = time.perf_counter()
+        for adm in admissions:
+            observe("fleet/queue_wait_s",
+                    now_adm - self.waiting_since.get(adm.req.rid, now_adm),
+                    buckets=TIME_BUCKETS, replica=rep,
+                    slo=slo_mod.resolve_class(adm.req.slo))
+        for b in eng._batched(sched.drain_releases()):
+            self.cache = eng._release(self.cache, eng._ids_row(b),
+                                      jnp.int32(len(b)))
+        for adm in admissions:
+            hit = len(adm.shared_ids) * s.block_size
+            stats["prefix_hit_tokens"] += hit
+            stats["prefix_miss_tokens"] += len(adm.req.prompt) - hit
+            self.cache = eng._share(
+                self.cache, jnp.int32(adm.slot),
+                eng._ids_row(adm.shared_ids),
+                jnp.int32(len(adm.shared_ids)),
+                jnp.int32(adm.n_blocks))
+        drafts: Dict[int, List[int]] = {}
+        if eng.drafter is not None:
+            # draft BEFORE planning so the scheduler charges the
+            # actual draft counts against the chunk budget
+            want = [(slot, k) for slot, k
+                    in sorted(sched.spec_quota().items()) if k > 0]
+            if want:
+                got = eng.drafter.draft_batch(
+                    [(slot,
+                      sched.running[slot].req.prompt + gen[slot],
+                      k) for slot, k in want])
+                drafts = {slot: list(got.get(slot) or [])[:k]
+                          for slot, k in want if got.get(slot)}
+        work = sorted(
+            sched.plan_step({sl: len(d) for sl, d in drafts.items()}
+                            if eng.drafter is not None else None),
+            key=lambda w: w.slot)
+        if eng.drafter is not None and any(w.grow for w in work):
+            # pre-stage every page the verify windows touch, so
+            # the in-step one-block growth stays a no-op and the
+            # step program is byte-identical spec-on vs spec-off
+            grow_row = np.zeros((s.max_slots,), np.int32)
+            for w in work:
+                grow_row[w.slot] = w.grow
+            self.cache = eng._grow(self.cache, jnp.asarray(grow_row))
+        if work:
+            tokens = np.zeros((s.chunk_tokens,), np.int32)
+            qs = np.zeros((s.max_slots,), np.int32)
+            ql = np.zeros((s.max_slots,), np.int32)
+            off = 0
+            for w in work:                 # packed runs in slot order
+                st = sched.running[w.slot]
+                qs[w.slot] = off
+                ql[w.slot] = w.n
+                if w.kind == "chunk":
+                    tokens[off:off + w.n] = st.req.prompt[
+                        w.start:w.start + w.n]
+                else:
+                    # a decode row, or a verify window: the last
+                    # generated token followed by the drafts
+                    tokens[off] = gen[w.slot][-1]
+                    if w.n > 1:
+                        tokens[off + 1:off + w.n] = \
+                            drafts[w.slot][:w.n - 1]
+                off += w.n
+            t0 = time.perf_counter()
+            # host-side profiler seam: marks the dispatch+wait span
+            # in host traces without touching the compiled program
+            with host_trace_range("serving.unified_step"):
+                self.cache, nxt = eng._step(
+                    eng.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(qs), jnp.asarray(ql))
+            nxt = jax.device_get(nxt)         # host sync: timing honest
+            now = time.perf_counter()
+            dt = now - t0
+            observe("serving/chunk_utilization", off / s.chunk_tokens,
+                    buckets=UTIL_BUCKETS, replica=rep)
+            n_dec = sum(1 for w in work if w.kind == "decode")
+            if n_dec:
+                stats["decode_steps"] += 1
+                stats["decode_s"] += dt
+            else:
+                stats["prefill_s"] += dt
+            dec_emitted = 0
+            if any(w.kind == "chunk" for w in work):
+                stats["chunk_steps"] += 1
+                stats["chunk_tokens"] += sum(
+                    w.n for w in work if w.kind == "chunk")
+            trunc = None
+            for w in work:
+                st = sched.running[w.slot]
+                rid = st.req.rid
+                if w.kind == "decode" and w.n > 1:
+                    # speculative verify: greedy longest-prefix
+                    # acceptance — row j's output is the model's
+                    # next token after [last, d1..dj], so every
+                    # emitted token is EXACTLY the greedy
+                    # continuation (the bitwise-identity
+                    # contract), whatever the drafter proposed
+                    nd = w.n - 1
+                    d = drafts[w.slot][:nd]
+                    base = qs[w.slot]
+                    outs = [int(nxt[base + i]) for i in range(w.n)]
+                    acc = 0
+                    while acc < nd and outs[acc] == d[acc]:
+                        acc += 1
+                    emitted = outs[:acc + 1]
+                    rem = st.req.max_new_tokens - len(gen[w.slot])
+                    emitted = emitted[:rem]
+                    if s.eos_id is not None and s.eos_id in emitted:
+                        emitted = emitted[
+                            :emitted.index(s.eos_id) + 1]
+                    gen[w.slot].extend(emitted)
+                    out[rid]["steps"] = step
+                    stats["decode_tokens"] += len(emitted)
+                    dec_emitted += len(emitted)
+                    stats["spec_drafted_tokens"] += nd
+                    stats["spec_accepted_tokens"] += acc
+                    inc_counter("serving/spec_drafted_tokens", nd,
+                                replica=rep)
+                    inc_counter("serving/spec_accepted_tokens", acc,
+                                replica=rep)
+                    observe("serving/spec_accept_rate", acc / nd,
+                            buckets=SPEC_BUCKETS, replica=rep)
+                    fin = (len(gen[w.slot])
+                           >= st.req.max_new_tokens
+                           or emitted[-1] == s.eos_id)
+                    new_len = sched.note_spec(w.slot, nd, acc, fin)
+                    if fin:
+                        self._finish(w.slot)
+                    elif acc < nd:
+                        # rejected drafts: roll their K/V
+                        # positions back and release the
+                        # over-allocated suffix pages
+                        if trunc is None:
+                            trunc = np.full((s.max_slots,),
+                                            _I32_MAX, np.int32)
+                        trunc[w.slot] = new_len
+                elif w.kind == "decode":
+                    tok = int(nxt[qs[w.slot]])
+                    gen[w.slot].append(tok)
+                    out[rid]["steps"] = step
+                    stats["decode_tokens"] += 1
+                    dec_emitted += 1
+                    if (len(gen[w.slot]) >= st.req.max_new_tokens
+                            or tok == s.eos_id):
+                        self._finish(w.slot)
+                elif w.completes_prompt:
+                    tok = int(nxt[qs[w.slot] + w.n - 1])
+                    gen[w.slot] = [tok]
+                    stats["prefills"] += 1
+                    if rid in self._prior:
+                        # a RESUMED request (preemption / replica
+                        # fault): this placement's first row is just
+                        # the next decode token — TTFT belongs to the
+                        # placement that emitted the real first token
+                        out.setdefault(rid, {})["steps"] = step
+                    else:
+                        ttft = now - self.waiting_since.get(rid, t0)
+                        observe("serving/ttft_s", ttft,
+                                buckets=TIME_BUCKETS, replica=rep)
+                        out[rid] = {"ttft_step": step, "steps": step,
+                                    "ttft_s": ttft}
+                    self._first_tok.setdefault(rid, now)
+                    if st.req.max_new_tokens == 1 or tok == s.eos_id:
+                        self._finish(w.slot)
+            if trunc is not None:
+                self.cache = eng._truncate(self.cache, jnp.asarray(trunc))
+            if n_dec:
+                # per-token decode latency: the step emitted
+                # dec_emitted tokens across n_dec decode slots.
+                # Without speculation dec_emitted == n_dec and
+                # this is exactly the step latency; a verify
+                # window emitting K+1 tokens divides its step
+                # cost across them, keeping TPOT honest spec-on
+                observe("serving/tpot_s",
+                        dt * n_dec / max(dec_emitted, 1),
+                        buckets=TIME_BUCKETS, replica=rep)
+        self.kv_free_min = min(self.kv_free_min, sched.free_blocks)
+        set_gauge("serving/kv_blocks_free", sched.free_blocks, replica=rep)
+        set_gauge("serving/kv_occupancy",
+                  1.0 - (sched.free_blocks
+                         + (len(eng.index) if eng.index else 0))
+                  / s.num_blocks, replica=rep)
+        set_gauge("serving/active_slots", len(sched.running), replica=rep)
+        self.step = step + 1
+
+    # -- close -------------------------------------------------------
+    def finalize(self) -> Dict[object, dict]:
+        """Close the session: summary stats + gauges, and commit the
+        cache back to the engine (the persistence that IS the warm-TTFT
+        win). Returns the ``run``-shaped result dict."""
+        eng = self.eng
+        stats = self.stats
+        stats["steps"] = self.step
+        stats["trace_counts"] = dict(eng.trace_counts)
+        stats["free_blocks"] = self.sched.free_blocks
+        stats["index_blocks"] = len(eng.index) if eng.index else 0
+        stats["cache"] = self.cache
+        eng._cache = self.cache
+        # low-watermark + throughput summary gauges for the whole run
+        set_gauge("serving/kv_blocks_free_min", self.kv_free_min,
+                  replica=eng.replica)
+        if stats["decode_s"] > 0:
+            set_gauge("serving/decode_steps_per_sec",
+                      stats["decode_steps"] / stats["decode_s"],
+                      replica=eng.replica)
+            set_gauge("serving/decode_tokens_per_sec",
+                      stats["decode_tokens"] / stats["decode_s"],
+                      replica=eng.replica)
+        out = self.out
+        out[None] = stats
+        return out
 
 
 # ---------------------------------------------------------------------------

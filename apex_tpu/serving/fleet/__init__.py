@@ -1,0 +1,66 @@
+"""apex_tpu.serving.fleet — the multi-replica serving service layer.
+
+One engine is not a service: heavy traffic hits N ``ServingEngine``
+replicas behind a load- and SLO-aware front end. This package is that
+front end, pure host python over the replicas' jitted fixed-shape steps
+(docs/serving.md "Fleet"):
+
+- ``slo``     — SLO classes (``latency`` vs ``batch``): priority ranks
+                consumed by the scheduler's budget split / admission /
+                preemption decisions, per-class latency targets judged
+                into ``fleet/slo_violations``.
+- ``replica`` — one engine + its incremental ``ServingSession`` +
+                live load signals (queue depth, free blocks,
+                KV occupancy, estimated work), plus the deterministic
+                fault-injection hook (``FaultPlan`` /
+                ``APEX_TPU_FLEET_FAULT_STEPS``).
+- ``router``  — ``Router.submit(request, slo_class)`` load-aware
+                placement over the replicas' signals, round-robin
+                stepping of every live replica, preemption/requeue
+                bookkeeping, and replica fault tolerance: a replica
+                that raises mid-run is drained, its in-flight requests
+                resume on survivors bitwise-identically (greedy
+                decode), and its engine recovers via ``reset_state()``.
+
+``slo`` is imported eagerly (the scheduler consults it); ``router`` /
+``replica`` load lazily because they import the engine, which imports
+the scheduler, which imports ``slo`` — the lazy hop keeps that chain
+acyclic.
+"""
+
+from apex_tpu.serving.fleet.slo import (  # noqa: F401
+    BATCH,
+    LATENCY,
+    SLOTargets,
+    rank_of,
+    resolve_class,
+    targets_for,
+    violations,
+)
+
+__all__ = [
+    "BATCH", "FaultPlan", "InjectedReplicaFault", "LATENCY", "Replica",
+    "ReplicaSignals", "Router", "SLOTargets", "rank_of", "resolve_class",
+    "targets_for", "violations",
+]
+
+_LAZY = {
+    "FaultPlan": "replica",
+    "InjectedReplicaFault": "replica",
+    "Replica": "replica",
+    "ReplicaSignals": "replica",
+    "Router": "router",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module 'apex_tpu.serving.fleet' has no attribute {name!r}")
+    import importlib
+
+    m = importlib.import_module(f"apex_tpu.serving.fleet.{mod}")
+    val = getattr(m, name)
+    globals()[name] = val
+    return val

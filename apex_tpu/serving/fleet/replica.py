@@ -1,0 +1,172 @@
+"""One fleet replica: an engine, its incremental session, its signals,
+and the deterministic fault-injection hook.
+
+A replica owns a full ``ServingEngine`` — its jitted one-compile step,
+its paged KV cache, its prefix index (the single-process multi-replica
+pattern of the TP2 serving tests: N engines side by side on one host,
+each a self-contained serving stack). The Router steps live replicas
+round-robin through their ``ServingSession`` and reads
+``Replica.signals()`` between steps for placement.
+
+Fault tolerance contract: any exception escaping ``Replica.step`` kills
+the replica for the rest of the drive — the Router harvests its
+finished results, ``drain``s its unfinished requests as resume pairs
+(prompt extended by the tokens already emitted, the emitted prefix
+stitched back at finish), requeues them on survivors, and recovers the
+engine with ``reset_state()`` (cold cache + index; the compiled step
+survives, so a revived replica re-joins the NEXT drive without a
+retrace). Greedy decode over the re-prefilled context regenerates
+exactly the lost continuation, so a fault-interrupted fleet run's
+output is bitwise the no-fault run's.
+
+``FaultPlan`` is the deterministic injection hook the tests, the bench
+and the dryrun leg use: replica r's step raises ``InjectedReplicaFault``
+the moment its local step counter hits the planned value. The env form
+``APEX_TPU_FLEET_FAULT_STEPS="1:3,0:7"`` (replica:step pairs) arms the
+same plan from the outside (docs/performance.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Optional, Tuple
+
+from apex_tpu.serving.engine import ServingEngine, ServingSession
+from apex_tpu.serving.scheduler import Request
+from apex_tpu.utils.envvars import env_str
+
+__all__ = ["FaultPlan", "InjectedReplicaFault", "Replica",
+           "ReplicaSignals"]
+
+_FAULT_ENV = "APEX_TPU_FLEET_FAULT_STEPS"
+
+
+class InjectedReplicaFault(RuntimeError):
+    """The deterministic fault the FaultPlan hook raises — a stand-in
+    for a real replica loss (device OOM, preempted VM, link flap)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """replica id -> the LOCAL step index whose execution raises. A
+    replica that finishes its work before reaching the step never
+    faults — the plan is deterministic given the workload."""
+
+    steps: Mapping[int, int]
+
+    def fires(self, replica: int, local_step: int) -> bool:
+        return self.steps.get(replica) == local_step
+
+    @staticmethod
+    def from_env() -> Optional["FaultPlan"]:
+        """Parse ``APEX_TPU_FLEET_FAULT_STEPS`` ("r:step[,r:step...]")
+        — None when unset. Malformed values raise naming the
+        variable (the utils/envvars contract)."""
+        raw = env_str(_FAULT_ENV)
+        if raw is None:
+            return None
+        steps: Dict[int, int] = {}
+        for part in raw.split(","):
+            fields = part.split(":")
+            try:
+                if len(fields) != 2:
+                    raise ValueError
+                r, s = int(fields[0]), int(fields[1])
+                if r < 0 or s < 0:
+                    raise ValueError
+            except ValueError:
+                raise ValueError(
+                    f"{_FAULT_ENV}={raw!r} must be comma-separated "
+                    f"'replica:step' pairs of non-negative integers "
+                    f"(e.g. '1:3,0:7')") from None
+            steps[r] = s
+        return FaultPlan(steps)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaSignals:
+    """One replica's live load snapshot — the router's placement
+    inputs, read off the scheduler's host mirror (the same quantities
+    the per-step ``serving/*`` gauges export; no device sync)."""
+
+    replica: int
+    alive: bool
+    queue_depth: int
+    running: int
+    free_blocks: int
+    kv_occupancy: float
+    est_work_tokens: int
+
+
+class Replica:
+    """One engine + its current session + its fault/liveness state."""
+
+    def __init__(self, rid: int, engine: ServingEngine):
+        self.rid = rid
+        self.engine = engine
+        self.session: Optional[ServingSession] = None
+        self.alive = True
+        self.local_step = 0
+        self.fault_plan: Optional[FaultPlan] = None
+
+    def begin(self, fault_plan: Optional[FaultPlan] = None) -> None:
+        """Open a fresh session for one drive. A replica that died last
+        drive re-joins here: its engine was reset_state()-recovered, so
+        it cold-starts but does NOT retrace."""
+        self.session = self.engine.session()
+        self.alive = True
+        self.local_step = 0
+        self.fault_plan = fault_plan
+
+    def submit(self, req: Request) -> None:
+        self.session.add(req)
+
+    def submit_resumed(self, req: Request, prior: List[int]) -> None:
+        self.session.add_resumed(req, prior)
+
+    def has_work(self) -> bool:
+        return (self.alive and self.session is not None
+                and self.session.has_work())
+
+    def step(self) -> None:
+        """One session tick; the fault hook fires BEFORE the device
+        step, so the planned step's tokens are never emitted — they are
+        regenerated bitwise on a survivor."""
+        if (self.fault_plan is not None
+                and self.fault_plan.fires(self.rid, self.local_step)):
+            raise InjectedReplicaFault(
+                f"replica {self.rid}: injected fault at local step "
+                f"{self.local_step}")
+        self.session.step_once()
+        self.local_step += 1
+
+    def signals(self) -> ReplicaSignals:
+        if self.session is None:
+            return ReplicaSignals(replica=self.rid, alive=self.alive,
+                                  queue_depth=0, running=0, free_blocks=0,
+                                  kv_occupancy=0.0, est_work_tokens=0)
+        sig = self.session.signals()
+        return ReplicaSignals(
+            replica=self.rid, alive=self.alive,
+            queue_depth=int(sig["queue_depth"]),
+            running=int(sig["running"]),
+            free_blocks=int(sig["free_blocks"]),
+            kv_occupancy=float(sig["kv_occupancy"]),
+            est_work_tokens=int(sig["est_work_tokens"]))
+
+    def fail(self) -> List[Tuple[Request, List[int]]]:
+        """Drain + recover after a fault: harvest nothing here (the
+        Router copies finished results first), return the unfinished
+        resume pairs, reset the engine (donated buffers and index holds
+        are unrecoverable mid-run), and mark the replica dead for the
+        rest of this drive."""
+        items = self.session.drain()
+        self.engine.reset_state()
+        self.session = None
+        self.alive = False
+        return items
+
+    def finalize(self) -> Dict[object, dict]:
+        out = self.session.finalize()
+        self.session = None
+        return out

@@ -1,0 +1,286 @@
+"""The fleet front end: SLO-aware, load-aware routing over N replicas.
+
+Production TPU serving deployments run many engine replicas behind a
+router (the Gemma-on-Cloud-TPU serving reference in PAPERS.md); this is
+that layer for apex_tpu, single-process: every replica is a full
+``ServingEngine`` (own KV pool, own prefix index, own one-compile jitted
+step) and the Router is pure host python that
+
+1. **places** each submitted request on the replica with the least
+   estimated work, breaking ties by queue depth, then KV occupancy,
+   then replica id (``ReplicaSignals`` — the same KV-occupancy /
+   queue-depth quantities the PR-5 gauges export, read off the
+   scheduler's host mirror with no device sync);
+2. **drives** all live replicas round-robin, one ``ServingSession``
+   step each (the fixed-shape jitted steps never retrace —
+   ``trace_counts["step"] == 1`` per replica over any fleet workload);
+3. **requeues**: preemption inside a replica (an SLO-outranked victim
+   evicted for a latency request) is handled by its session; a replica
+   FAULT (any exception escaping its step — deterministically
+   injectable via ``FaultPlan`` / ``APEX_TPU_FLEET_FAULT_STEPS``) makes
+   the Router harvest the dead replica's finished results, drain its
+   unfinished requests as resume pairs and re-place them on survivors,
+   and recover the engine with ``reset_state()``. Greedy decode over a
+   re-prefilled context regenerates exactly the lost continuation, so
+   fleet output — with or without faults, cold or prefix-warm — is
+   bitwise the single-engine run's per request.
+
+Conservation is enforced, not hoped for: ``drive`` raises if any
+submitted rid is missing from (or duplicated in) the merged results.
+
+Metrics (docs/observability.md): every replica's serving series carries
+its ``replica`` label; the Router adds ``fleet/requeues`` (labeled by
+reason: preemption | fault), ``fleet/slo_violations`` (judged per
+finished request against its class targets, serving/fleet/slo.py) and
+the ``fleet/queue_wait_s`` histogram (submit → admission, labeled
+replica + slo class).
+
+Env knobs: ``APEX_TPU_FLEET_REPLICAS`` (default fleet width, 2),
+``APEX_TPU_FLEET_FAULT_STEPS`` (fault plan), plus the SLO knobs in
+slo.py — all read at call time via utils/envvars.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from jax.sharding import Mesh
+
+from apex_tpu.observability import (
+    default_registry,
+    inc_counter,
+    metrics_enabled,
+)
+from apex_tpu.serving.engine import ServingConfig, ServingEngine
+from apex_tpu.serving.fleet import slo
+from apex_tpu.serving.fleet.replica import FaultPlan, Replica
+from apex_tpu.serving.scheduler import Request
+from apex_tpu.utils.envvars import env_int
+
+__all__ = ["Router"]
+
+
+class Router:
+    """N-replica SLO-aware serving front end (single process).
+
+    ``Router(scfg, params)`` builds ``n_replicas`` engines (default
+    ``APEX_TPU_FLEET_REPLICAS`` | 2) sharing weights and mesh — each
+    still owns its cache/index/jitted programs. ``submit`` places one
+    request; ``drive`` serves everything queued; ``serve`` is
+    submit-all + drive. Replicas persist across drives (their prefix
+    indexes stay warm — the fleet-level warm-TTFT economy), and a
+    replica that died in one drive re-joins the next, cold but without
+    retracing."""
+
+    def __init__(self, scfg: ServingConfig, params, *,
+                 n_replicas: Optional[int] = None,
+                 mesh: Optional[Mesh] = None,
+                 fault_plan: Optional[FaultPlan] = None):
+        n = (env_int("APEX_TPU_FLEET_REPLICAS", default=2)
+             if n_replicas is None else n_replicas)
+        if n < 1:
+            raise ValueError(f"n_replicas {n} must be >= 1")
+        self.replicas = [
+            Replica(i, ServingEngine(scfg, params, mesh=mesh,
+                                     replica=str(i)))
+            for i in range(n)
+        ]
+        # explicit plan wins; None re-consults the env at each _begin
+        self._fault_plan = fault_plan
+        self._active = False
+        self._rids: set = set()
+        self._placements: Dict[object, int] = {}
+        self._harvested: Dict[object, dict] = {}
+        self._requeues = 0
+        self._faults: List[dict] = []
+
+    # -- lifecycle ---------------------------------------------------
+    def set_fault_plan(self, plan: Optional[FaultPlan]) -> None:
+        """Arm (or clear, ``None`` = re-consult the env) the fault plan
+        for subsequent drives — the supported way a test/bench swaps
+        plans through one compiled fleet."""
+        if self._active:
+            raise RuntimeError(
+                "set_fault_plan mid-drive: arm the plan before submit")
+        self._fault_plan = plan
+
+    def _begin(self) -> None:
+        plan = (self._fault_plan if self._fault_plan is not None
+                else FaultPlan.from_env())
+        for rep in self.replicas:
+            rep.begin(plan)
+        self._active = True
+        self._rids = set()
+        self._placements = {}
+        self._harvested = {}
+        self._requeues = 0
+        self._faults = []
+        if metrics_enabled():
+            # materialize the fleet series at 0 — one series per label
+            # combination a drive can emit — so a quiet drive still
+            # exports them (the dashboard contract)
+            reg = default_registry()
+            requeues = reg.counter("fleet/requeues")
+            faults = reg.counter("fleet/replica_faults")
+            viols = reg.counter("fleet/slo_violations")
+            for rep in self.replicas:
+                r = str(rep.rid)
+                faults.inc(0, replica=r)
+                for reason in ("preemption", "fault"):
+                    requeues.inc(0, reason=reason, replica=r)
+                for cls in (slo.LATENCY, slo.BATCH):
+                    for kind in ("ttft", "tpot"):
+                        viols.inc(0, slo=cls, kind=kind, replica=r)
+
+    # -- placement ---------------------------------------------------
+    def _place(self, req: Request) -> Replica:
+        alive = [r for r in self.replicas if r.alive]
+        if not alive:
+            raise RuntimeError("fleet: no live replicas to place on")
+
+        def score(rep: Replica):
+            sig = rep.signals()
+            return (sig.est_work_tokens, sig.queue_depth,
+                    sig.kv_occupancy, rep.rid)
+
+        return min(alive, key=score)
+
+    def submit(self, request: Request,
+               slo_class: Optional[str] = None) -> int:
+        """Place ``request`` on the least-loaded live replica and queue
+        it there. ``slo_class`` overrides the request's own ``slo``
+        field. Returns the chosen replica id. Duplicate rids are
+        rejected — conservation (every request emitted exactly once) is
+        only checkable over unique ids."""
+        if not self._active:
+            self._begin()
+        if request.rid in self._rids:
+            raise ValueError(
+                f"fleet: duplicate request id {request.rid!r}")
+        if slo_class is not None:
+            request = dataclasses.replace(request, slo=slo_class)
+        rep = self._place(request)
+        rep.submit(request)
+        self._rids.add(request.rid)
+        self._placements[request.rid] = rep.rid
+        return rep.rid
+
+    # -- fault handling ----------------------------------------------
+    def _on_fault(self, rep: Replica, err: Exception) -> None:
+        self._faults.append({
+            "replica": rep.rid, "local_step": rep.local_step,
+            "error": f"{type(err).__name__}: {err}"})
+        inc_counter("fleet/replica_faults", 1, replica=str(rep.rid))
+        # finished results survive the replica: harvest before drain
+        for rid, v in rep.session.out.items():
+            if rid is not None and "tokens" in v:
+                self._harvested[rid] = v
+        items = rep.fail()
+        if not any(r.alive for r in self.replicas):
+            raise RuntimeError(
+                "fleet: every replica has faulted") from err
+        for req, prior in items:
+            target = self._place(req)
+            target.submit_resumed(req, prior)
+            self._placements[req.rid] = target.rid
+            self._requeues += 1
+            inc_counter("fleet/requeues", 1, reason="fault",
+                        replica=str(rep.rid))
+
+    # -- the drive loop ----------------------------------------------
+    def drive(self, *, max_steps: int = 10_000) -> Dict[object, dict]:
+        """Serve everything submitted since the last drive. Round-robin:
+        every live replica with work takes one session step per fleet
+        step; a replica that raises is drained onto survivors (see
+        ``_on_fault``). Returns the merged ``{rid: result}`` dict with
+        fleet stats (per-replica stats, placements, requeues, faults)
+        under the reserved key ``None``."""
+        if not self._active:
+            self._begin()
+        steps = 0
+        ok = False
+        try:
+            while any(r.has_work() for r in self.replicas):
+                if steps >= max_steps:
+                    raise RuntimeError(
+                        f"fleet drive exceeded {max_steps} steps with "
+                        f"work left")
+                for rep in list(self.replicas):
+                    if not rep.has_work():
+                        continue
+                    try:
+                        rep.step()
+                    except Exception as e:  # noqa: BLE001 — any escape
+                        # from a replica's step is a replica loss; the
+                        # drain either recovers or re-raises (all dead)
+                        self._on_fault(rep, e)
+                steps += 1
+            ok = True
+        finally:
+            if not ok:
+                # mirror the single-engine economy: a failed drive
+                # cold-starts every live replica instead of leaving
+                # half-donated caches behind
+                for rep in self.replicas:
+                    if rep.alive and rep.session is not None:
+                        rep.engine.reset_state()
+                        rep.session = None
+                self._active = False
+        results: Dict[object, dict] = dict(self._harvested)
+        stats_by_replica: Dict[int, dict] = {}
+        for rep in self.replicas:
+            if rep.session is None:
+                continue
+            out = rep.finalize()
+            stats_by_replica[rep.rid] = out.pop(None)
+            results.update(out)
+        self._active = False
+        missing = self._rids - set(results)
+        extra = set(results) - self._rids
+        if missing or extra:
+            raise RuntimeError(
+                f"fleet conservation violated: missing={sorted(map(str, missing))} "
+                f"unexpected={sorted(map(str, extra))}")
+        results[None] = {
+            "replicas": stats_by_replica,
+            "fleet_steps": steps,
+            "requests": len(self._rids),
+            "requeues": self._requeues,
+            "preemptions": sum(s["preemptions"]
+                               for s in stats_by_replica.values()),
+            "slo_violations": sum(s["slo_violations"]
+                                  for s in stats_by_replica.values()),
+            "faults": list(self._faults),
+            "dead_replicas": [r.rid for r in self.replicas
+                              if not r.alive],
+            "placements": dict(self._placements),
+        }
+        return results
+
+    def serve(self, requests: List[Request], *,
+              max_steps: int = 10_000) -> Dict[object, dict]:
+        """submit() every request in order, then drive() to completion
+        — the fleet analog of ``ServingEngine.run``."""
+        for r in requests:
+            self.submit(r)
+        return self.drive(max_steps=max_steps)
+
+    # -- introspection ------------------------------------------------
+    def signals(self) -> List[dict]:
+        """Per-replica load snapshot (dataclass -> dict) — what an
+        operator polls, and what ``_place`` scores."""
+        return [dataclasses.asdict(rep.signals())
+                for rep in self.replicas]
+
+    def trace_counts(self) -> Dict[int, Dict[str, int]]:
+        """Per-replica engine trace counters — the fleet-level
+        no-retrace pin (each replica's step compiles exactly once)."""
+        return {rep.rid: dict(rep.engine.trace_counts)
+                for rep in self.replicas}
+
+    def reset_state(self) -> None:
+        """Cold-start every replica (drop caches + prefix indexes)
+        without touching the compiled steps — the fleet A/B lever."""
+        for rep in self.replicas:
+            rep.engine.reset_state()

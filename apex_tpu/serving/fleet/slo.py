@@ -1,0 +1,120 @@
+"""SLO classes — the request-priority vocabulary the fleet schedules by.
+
+Production serving traffic is not uniform: an interactive chat turn is
+LATENCY-bound (the user is watching the first token render), a batch
+evaluation or synthetic-data job is THROUGHPUT-bound (only aggregate
+tokens/s matters). The router and the scheduler treat the two
+differently at every contention point:
+
+* **step budget** (`Scheduler.plan_step`): latency-class slots are
+  planned first in both the decode and the prompt-chunk phase, so under
+  a tight ``chunk_tokens`` budget a latency prompt chunk displaces
+  batch chunks (and a latency verify window outranks batch windows for
+  speculative budget). With a single class the order degrades to the
+  old sorted-slot order — SLO-less workloads plan byte-identical steps.
+* **admission** (`Scheduler.admit`): the wait queue is FIFO *within* a
+  class, but a latency request may be admitted past queued batch
+  requests (class-aware head-of-line: the blocked head only blocks its
+  own class and below).
+* **preemption** (`ServingSession`): a latency request blocked at
+  admission (no free slot / watermark) evicts the most recently
+  admitted batch-class slot — its blocks return to the pool
+  (``serving/preemptions``) and the request is REQUEUED at the front of
+  its class with the tokens it already emitted carried as ``prior``, so
+  its final greedy output is bitwise the uninterrupted run's.
+
+Classes are ranked: numerically LOWER rank = higher priority. Unknown
+class names raise at the first scheduling decision that consults them,
+never silently schedule as batch.
+
+Env knobs (docs/performance.md, all read at call time via
+utils/envvars): ``APEX_TPU_SERVING_SLO_DEFAULT`` is the class a request
+with ``slo=None`` resolves to (default ``batch`` — existing workloads
+keep today's FIFO economy); ``APEX_TPU_SLO_LATENCY_TTFT_S`` /
+``APEX_TPU_SLO_LATENCY_TPOT_S`` are the latency class's targets, judged
+per finished request into the ``fleet/slo_violations`` counter. The
+batch class has no targets (a violation-free class by definition).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from apex_tpu.utils.envvars import env_float, env_str
+
+__all__ = [
+    "BATCH",
+    "LATENCY",
+    "SLOTargets",
+    "rank_of",
+    "resolve_class",
+    "targets_for",
+    "violations",
+]
+
+LATENCY = "latency"
+BATCH = "batch"
+
+# rank 0 outranks rank 1 at every contention point (budget, admission,
+# preemption); strictly-greater rank is the preemption-victim criterion
+_RANKS = {LATENCY: 0, BATCH: 1}
+
+
+def rank_of(name: str) -> int:
+    """Priority rank of an SLO class name (lower = higher priority).
+    Unknown names raise — a typo'd class must fail at the first
+    scheduling decision, not silently serve as batch."""
+    try:
+        return _RANKS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown SLO class {name!r} (expected one of "
+            f"{sorted(_RANKS)})") from None
+
+
+def resolve_class(name: Optional[str]) -> str:
+    """A request's effective class: its own ``slo`` field, else the
+    ``APEX_TPU_SERVING_SLO_DEFAULT`` env default (``batch`` when unset —
+    SLO-less workloads keep today's pure-FIFO behavior)."""
+    if name is None:
+        name = env_str("APEX_TPU_SERVING_SLO_DEFAULT", default=BATCH)
+    rank_of(name)  # validate
+    return name
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOTargets:
+    """Per-class latency targets; ``None`` = no target (never violated).
+    ``ttft_s`` is judged against the request's arrival→first-token wall
+    time, ``tpot_s`` against its mean decode pace (first token →
+    finish, per emitted token past the first)."""
+
+    ttft_s: Optional[float] = None
+    tpot_s: Optional[float] = None
+
+
+def targets_for(name: str) -> SLOTargets:
+    """The class's targets, env-resolved at call time. Only the latency
+    class carries defaults; batch is target-free."""
+    if name == LATENCY:
+        return SLOTargets(
+            ttft_s=env_float("APEX_TPU_SLO_LATENCY_TTFT_S", default=0.5),
+            tpot_s=env_float("APEX_TPU_SLO_LATENCY_TPOT_S", default=0.1))
+    rank_of(name)  # validate
+    return SLOTargets()
+
+
+def violations(name: str, ttft_s: Optional[float],
+               tpot_s: Optional[float]) -> List[str]:
+    """Which targets a finished request missed (``["ttft", "tpot"]``
+    subset) — the per-kind labels on ``fleet/slo_violations``. ``None``
+    measurements (e.g. a fault-resumed request whose first token landed
+    on the dead replica) are never judged."""
+    t = targets_for(name)
+    out: List[str] = []
+    if t.ttft_s is not None and ttft_s is not None and ttft_s > t.ttft_s:
+        out.append("ttft")
+    if t.tpot_s is not None and tpot_s is not None and tpot_s > t.tpot_s:
+        out.append("tpot")
+    return out

@@ -44,6 +44,21 @@ HALF the leftover budget so prefill always progresses), and
 while reconciling the host mirror with the engine's device-side
 rollback (``kv_cache.truncate_slots``).
 
+**SLO classes** (serving/fleet/slo.py): every request carries an SLO
+class (``latency`` outranks ``batch``; ``slo=None`` resolves via
+``APEX_TPU_SERVING_SLO_DEFAULT``, default batch). The class shapes
+three decisions: ``plan_step`` orders both its decode and its chunk
+phase latency-class slots first (so under a tight budget a
+latency-bound request's chunks displace throughput-bound ones — with a
+single class this is exactly the old sorted-slot order), ``admit`` is
+FIFO within a class but lets a latency request pass queued batch
+requests (the blocked head only blocks its own class and below), and
+the session's preemption path uses ``peek_next``/``pick_victim``/
+``preempt``/``requeue``: a latency request blocked at admission evicts
+the most recently admitted strictly-lower-class slot, returning its
+blocks to the pool (the ``serving/preemptions`` counter — armed here)
+and requeueing the victim at the front of its class section.
+
 Admission policy (free-block watermark): a request is admitted only when
 a slot is free AND the pool would retain >= ``watermark`` free blocks
 after its suffix allocation. The watermark reserves decode headroom for
@@ -66,6 +81,7 @@ from collections import deque
 from typing import Deque, Dict, Iterable, List, Optional
 
 from apex_tpu.observability import inc_counter
+from apex_tpu.serving.fleet import slo as slo_mod
 from apex_tpu.serving.kv_cache import PrefixIndex, blocks_needed
 
 WAITING = "WAITING"
@@ -76,12 +92,16 @@ FINISHED = "FINISHED"
 @dataclasses.dataclass
 class Request:
     """One generation request. ``arrival`` is the engine step index at
-    which the request becomes visible (staggered-arrival workloads)."""
+    which the request becomes visible (staggered-arrival workloads).
+    ``slo`` is the request's SLO class (serving/fleet/slo.py:
+    ``"latency"`` outranks ``"batch"``; ``None`` resolves through
+    ``APEX_TPU_SERVING_SLO_DEFAULT`` at scheduling time)."""
 
     rid: object
     prompt: List[int]
     max_new_tokens: int = 16
     arrival: int = 0
+    slo: Optional[str] = None
 
     def __post_init__(self):
         if not self.prompt:
@@ -89,6 +109,8 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"request {self.rid!r}: max_new_tokens must be >= 1")
+        if self.slo is not None:
+            slo_mod.rank_of(self.slo)       # typo'd class: fail at intake
 
 
 @dataclasses.dataclass
@@ -100,6 +122,8 @@ class _Running:
     prefilled: int         # prompt tokens resident (prefix hit + chunks)
     shared_ids: List[int]  # prefix blocks borrowed from the index
     spec_depth: int = 0    # current adaptive draft depth (speculation on)
+    slo_rank: int = 1      # resolved class rank at admission (0 = latency)
+    admit_seq: int = 0     # admission order — the preemption-victim key
 
 
 @dataclasses.dataclass
@@ -147,8 +171,12 @@ class Scheduler:
                  watermark: Optional[int] = None,
                  chunk_tokens: Optional[int] = None,
                  prefix_index: Optional[PrefixIndex] = None,
-                 spec_k: int = 0):
+                 spec_k: int = 0,
+                 replica: str = "0"):
         self.max_slots = max_slots
+        # which fleet replica this scheduler serves — the label on every
+        # counter it emits ("0" outside a fleet, docs/observability.md)
+        self.replica = str(replica)
         # speculative decoding: spec_k is the MAX draft depth per slot
         # (0 = off); each running slot adapts its own depth within
         # [1, spec_k] to the accept rates note_spec observes
@@ -172,6 +200,7 @@ class Scheduler:
         self._shared_in_use: Dict[int, int] = {}
         # index evictions awaiting their device refcount release
         self._pending_releases: List[int] = []
+        self._admit_seq = 0    # admission order, the preemption-victim key
 
     # -- intake ------------------------------------------------------
     def add(self, req: Request) -> None:
@@ -199,6 +228,53 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self._future or self._waiting or self.running)
 
+    # -- SLO classes / fleet signals ---------------------------------
+    @staticmethod
+    def _rank(req: Request) -> int:
+        """The request's resolved class rank (env default applied at
+        CALL time — serving/fleet/slo.py)."""
+        return slo_mod.rank_of(slo_mod.resolve_class(req.slo))
+
+    def _next_index(self) -> Optional[int]:
+        """Index into the wait queue of the next admission candidate:
+        the FIRST request of the best (lowest-rank) class present —
+        FIFO within a class, class-aware head-of-line across classes (a
+        blocked latency head blocks everything; a blocked batch head
+        never blocks a queued latency request)."""
+        best_rank, best_i = None, None
+        for i, r in enumerate(self._waiting):
+            rk = self._rank(r)
+            if best_rank is None or rk < best_rank:
+                best_rank, best_i = rk, i
+                if rk == 0:
+                    break
+        return best_i
+
+    def peek_next(self) -> Optional[Request]:
+        """The request ``admit`` would try next (None when the queue is
+        empty) — the session's preemption check reads this."""
+        i = self._next_index()
+        return None if i is None else self._waiting[i]
+
+    def queue_depth(self) -> int:
+        """Waiting + not-yet-arrived requests — a router signal."""
+        return len(self._waiting) + len(self._future)
+
+    def pending_work_tokens(self) -> int:
+        """Estimated tokens of work still owed: un-prefilled prompt
+        tokens plus un-emitted decode budget across queued AND running
+        requests — the router's estimated-work placement signal (a
+        heuristic: eos may end a request early)."""
+        total = sum(len(r.prompt) + r.max_new_tokens
+                    for r in self._future)
+        total += sum(len(r.prompt) + r.max_new_tokens
+                     for r in self._waiting)
+        for st in self.running.values():
+            emitted = max(0, st.tokens_in_cache - len(st.req.prompt))
+            total += max(0, len(st.req.prompt) - st.prefilled)
+            total += max(0, st.req.max_new_tokens - emitted)
+        return total
+
     # -- admission ---------------------------------------------------
     def _make_room(self, fresh: int, protect: set) -> None:
         """Evict least-recently-matched prefix-index entries until the
@@ -221,15 +297,18 @@ class Scheduler:
         return out
 
     def admit(self) -> List[Admission]:
-        """Admit FIFO from the wait queue while a slot is free and the
-        pool keeps ``watermark`` blocks after each request's FRESH
-        (non-shared) allocation. Prefix-matched blocks are borrowed from
-        the index (refcount-aware: already resident, charged zero), so
-        admission is not spuriously blocked when most resident blocks
-        are shared prefixes."""
+        """Admit from the wait queue — class-aware FIFO (``_next_index``:
+        FIFO within a class, a latency request passes queued batch
+        requests) — while a slot is free and the pool keeps
+        ``watermark`` blocks after each request's FRESH (non-shared)
+        allocation. Prefix-matched blocks are borrowed from the index
+        (refcount-aware: already resident, charged zero), so admission
+        is not spuriously blocked when most resident blocks are shared
+        prefixes."""
         admitted: List[Admission] = []
         while self._waiting and self._free_slots:
-            req = self._waiting[0]
+            i = self._next_index()
+            req = self._waiting[i]
             prompt = req.prompt
             matched = self.index.match(prompt) if self.index else []
             # always leave >= 1 prompt token to recompute: its logits
@@ -245,9 +324,10 @@ class Scheduler:
             if self.free_blocks - fresh < self.watermark:
                 # the head-of-line request deferred by the watermark: the
                 # KV-pressure signal an operator sizes the pool by
-                inc_counter("serving/admission_blocked", 1)
-                break                         # FIFO: no skip-ahead
-            self._waiting.popleft()
+                inc_counter("serving/admission_blocked", 1,
+                            replica=self.replica)
+                break               # FIFO within the best class: no skip
+            del self._waiting[i]
             slot = self._free_slots.pop(0)
             self.free_blocks -= fresh
             for b in shared_ids:
@@ -256,15 +336,57 @@ class Scheduler:
             self.running[slot] = _Running(
                 req=req, slot=slot, n_blocks=need,
                 tokens_in_cache=prefix_tokens, prefilled=prefix_tokens,
-                shared_ids=list(shared_ids), spec_depth=self.spec_k)
-            inc_counter("serving/admissions", 1)
-            inc_counter("serving/prefix_hit_tokens", prefix_tokens)
+                shared_ids=list(shared_ids), spec_depth=self.spec_k,
+                slo_rank=self._rank(req), admit_seq=self._admit_seq)
+            self._admit_seq += 1
+            inc_counter("serving/admissions", 1, replica=self.replica)
+            inc_counter("serving/prefix_hit_tokens", prefix_tokens,
+                        replica=self.replica)
             inc_counter("serving/prefix_miss_tokens",
-                        len(prompt) - prefix_tokens)
+                        len(prompt) - prefix_tokens, replica=self.replica)
             admitted.append(Admission(slot=slot, req=req,
                                       shared_ids=list(shared_ids),
                                       n_blocks=need))
         return admitted
+
+    # -- preemption / requeue (SLO classes, serving/fleet) -----------
+    def pick_victim(self, rank: int) -> Optional[int]:
+        """The deterministic preemption victim for a blocked candidate
+        of class rank ``rank``: the MOST RECENTLY ADMITTED running slot
+        of a strictly lower-priority class (numerically greater rank) —
+        the least sunk work among the outranked. None when nothing
+        running is outranked (same-class work never preempts)."""
+        cands = [(st.admit_seq, s) for s, st in self.running.items()
+                 if st.slo_rank > rank]
+        return max(cands)[1] if cands else None
+
+    def preempt(self, slot: int) -> _Running:
+        """Evict a running slot to make room for a higher-class request:
+        its blocks return to the pool exactly as ``release`` would
+        (shared prefix pages survive via their other references) but the
+        request is NOT finished — the caller requeues it (the engine
+        session stitches the tokens it already emitted back on as
+        ``prior``). Arms the ``serving/preemptions`` counter. Returns
+        the evicted running state."""
+        st = self.running.pop(slot)
+        self.free_blocks += self._return_blocks(st, set())
+        self._free_slots.append(slot)
+        self._free_slots.sort()
+        inc_counter("serving/preemptions", 1, replica=self.replica)
+        return st
+
+    def requeue(self, req: Request) -> None:
+        """Re-enter preempted / fault-drained work at the FRONT of its
+        class section of the wait queue (after any higher classes): the
+        victim was admitted before every still-waiting peer of its own
+        class, so it keeps that seniority instead of starving behind
+        later arrivals."""
+        rk = self._rank(req)
+        for i, r in enumerate(self._waiting):
+            if self._rank(r) >= rk:
+                self._waiting.insert(i, req)
+                return
+        self._waiting.append(req)
 
     # -- step planning ----------------------------------------------
     def _take_block(self) -> None:
@@ -286,6 +408,15 @@ class Scheduler:
         return (st.req.max_new_tokens
                 - (st.tokens_in_cache - len(st.req.prompt)) - 1)
 
+    def _slot_order(self) -> List[int]:
+        """Budget-allocation order: latency-class slots first, slot
+        order within a class. With a single class this is exactly the
+        old ``sorted(self.running)`` — SLO-less workloads plan
+        byte-identical steps. (The ENGINE still packs rows in plain
+        slot order; only who gets budget changes.)"""
+        return sorted(self.running,
+                      key=lambda s: (self.running[s].slo_rank, s))
+
     def spec_quota(self) -> Dict[int, int]:
         """Per decode-ready slot, the max draft tokens the engine should
         request from the drafter THIS step: the slot's adaptive depth,
@@ -299,7 +430,7 @@ class Scheduler:
         speculation shrinks before it can underflow what plain decode is
         entitled to. Pure read — ``plan_step`` is then called with the
         draft counts the drafter actually produced."""
-        ready = [s for s in sorted(self.running)
+        ready = [s for s in self._slot_order()
                  if self._decode_ready(self.running[s])]
         spare = self.chunk_tokens - len(ready)
         # mid-prefill slots must keep making progress: speculation may
@@ -364,9 +495,14 @@ class Scheduler:
         """Split this step's ``chunk_tokens`` budget over the running
         slots: decode steps first (one token per decode-ready slot —
         guaranteed to fit, chunk_tokens >= max_slots), then prompt
-        chunks FIFO in slot order with whatever budget remains. Advances
-        the host mirror (prefilled / tokens_in_cache / decode block
-        growth) — callers run every returned Work item this step.
+        chunks FIFO with whatever budget remains. BOTH phases walk the
+        slots in SLO order (``_slot_order``: latency class first, slot
+        order within a class), so under a tight budget a latency-bound
+        request's decode window and prompt chunks displace
+        throughput-bound ones — with one class this is the old
+        sorted-slot order, byte for byte. Advances the host mirror
+        (prefilled / tokens_in_cache / decode block growth) — callers
+        run every returned Work item this step.
 
         With ``spec_drafts`` (slot -> draft-token count, from the
         engine's drafter under ``spec_quota``) a decode-ready slot's
@@ -382,7 +518,8 @@ class Scheduler:
         decode steps take pool blocks here."""
         budget = self.chunk_tokens
         work: List[Work] = []
-        for slot in sorted(self.running):
+        order = self._slot_order()
+        for slot in order:
             st = self.running[slot]
             if self._decode_ready(st) and budget >= 1:
                 pos = st.tokens_in_cache
@@ -399,7 +536,7 @@ class Scheduler:
                                  grow=grow))
                 st.tokens_in_cache = pos + n
                 budget -= n
-        for slot in sorted(self.running):
+        for slot in order:
             st = self.running[slot]
             rem = len(st.req.prompt) - st.prefilled
             if rem > 0 and budget > 0:
@@ -437,13 +574,13 @@ class Scheduler:
         return grown
 
     # -- release -----------------------------------------------------
-    def release(self, slot: int, newly_indexed: Iterable[int] = ()) -> None:
-        """Finished sequence: return its slot, and return to the pool
-        every block whose refcount reaches 0 — fresh blocks not handed
-        to the prefix index (``newly_indexed``, which keep the index's
-        refcount), plus shared prefix blocks nobody else references."""
-        st = self.running.pop(slot)
-        newly = {int(b) for b in newly_indexed}
+    def _return_blocks(self, st: _Running, newly: set) -> int:
+        """Blocks a departing slot returns to the pool: every block
+        whose refcount reaches 0 — fresh blocks not handed to the
+        prefix index (``newly``, which keep the index's refcount), plus
+        shared prefix blocks nobody else references. The one accounting
+        shared by ``release`` (finish) and ``preempt`` (eviction), so
+        the two paths cannot diverge from the device's ``free_slot``."""
         freed = 0
         for b in st.shared_ids:
             cnt = self._shared_in_use.get(b, 1) - 1
@@ -455,7 +592,14 @@ class Scheduler:
                     freed += 1
         fresh = st.n_blocks - len(st.shared_ids)
         freed += fresh - len(newly - set(st.shared_ids))
-        self.free_blocks += freed
+        return freed
+
+    def release(self, slot: int, newly_indexed: Iterable[int] = ()) -> None:
+        """Finished sequence: return its slot and its zero-refcount
+        blocks (see ``_return_blocks``)."""
+        st = self.running.pop(slot)
+        self.free_blocks += self._return_blocks(
+            st, {int(b) for b in newly_indexed})
         self._free_slots.append(slot)
         self._free_slots.sort()
-        inc_counter("serving/evictions", 1)
+        inc_counter("serving/evictions", 1, replica=self.replica)

@@ -41,16 +41,23 @@ _METRIC = "bert_large_amp_o2_fused_lamb_samples_per_sec_per_chip"
 # step over transformer.moe at a fixed (t, E, top_k, h, f) point, einsum
 # dispatch vs the sort-based grouped-matmul path (capacity parity mode
 # AND dropless), also dry-compiled by --compile-only as its own rung.
-# Each mode emits one JSON line under its own metric name so it can
-# never masquerade as a samples/sec measurement.
+# --fleet: the serving-fleet A/B rung — the same mixed latency/batch
+# 16-request workload through ONE engine and through an N=2 Router
+# (apex_tpu.serving.fleet), tokens/s + p95 TTFT for both, ok gated on
+# bitwise token identity (incl. a fault-injected fleet pass); the
+# 2-replica steps also dry-compile under --compile-only as a "fleet"
+# rung. Each mode emits one JSON line under its own metric name so it
+# can never masquerade as a samples/sec measurement.
 _COMPILE_ONLY = "--compile-only" in sys.argv[1:]
 _AUTOTUNE = "--autotune" in sys.argv[1:]
 _SERVING = "--serving" in sys.argv[1:]
 _MOE = "--moe" in sys.argv[1:]
+_FLEET = "--fleet" in sys.argv[1:]
 _COMPILE_METRIC = "bert_large_compile_gate_rungs_ok"
 _AUTOTUNE_METRIC = "apex_tpu_autotune_entries_written"
 _SERVING_METRIC = "apex_tpu_serving_decode_steps_per_sec"
 _MOE_METRIC = "apex_tpu_moe_tokens_per_sec"
+_FLEET_METRIC = "apex_tpu_fleet_tokens_per_sec"
 
 
 # -- observability: rung timings ride the telemetry registry ----------
@@ -694,6 +701,137 @@ def _spec_compile_rung(on_cpu: bool, timeout_s: float) -> dict:
     return rung
 
 
+def _fleet_payload(on_cpu: bool) -> dict:
+    """Serving-fleet A/B (metric ``apex_tpu_fleet_tokens_per_sec``): the
+    fixed 16-request mix — every third request latency-class, the rest
+    batch — served through ONE engine and through an N=2 Router, both
+    timed end-to-end (total emitted tokens / wall). A third pass re-runs
+    the fleet with a deterministic replica-1 fault injected mid-drive.
+    ``ok`` requires BOTH fleet passes bitwise token-identical to the
+    single-engine run (the fleet acceptance contract) — a fleet that
+    changes output has no throughput to report."""
+    import dataclasses
+
+    from apex_tpu.serving import FaultPlan, Router
+
+    eng, cfg, scfg = _serving_setup(on_cpu)
+    reqs = [dataclasses.replace(r, slo="latency" if i % 3 == 0 else "batch")
+            for i, r in enumerate(_serving_requests(cfg, scfg, on_cpu))]
+
+    def clone(tag):
+        return [dataclasses.replace(r, rid=f"{tag}{r.rid}") for r in reqs]
+
+    def timed(run, tag):
+        t0 = time.perf_counter()
+        out = run(clone(tag))
+        dt = time.perf_counter() - t0
+        stats = out.pop(None)
+        toks = sum(len(v["tokens"]) for v in out.values())
+        ttfts = sorted(v["ttft_s"] for v in out.values()
+                       if v.get("ttft_s") is not None)
+        p95 = ttfts[int(0.95 * (len(ttfts) - 1))] if ttfts else None
+        return out, stats, toks / max(dt, 1e-9), p95
+
+    eng.run(clone("warm"))                  # warmup: pays the one compile
+    eng.reset_state()
+    base, base_stats, single_tps, single_p95 = timed(eng.run, "s")
+
+    router = Router(scfg, eng.params, n_replicas=2,
+                    fault_plan=FaultPlan({}))
+    router.serve(clone("fwarm"))            # warmup: 1 compile per replica
+    router.reset_state()
+    fleet, fleet_stats, fleet_tps, fleet_p95 = timed(router.serve, "f")
+    same_fleet = all(fleet[f"f{r.rid}"]["tokens"]
+                     == base[f"s{r.rid}"]["tokens"] for r in reqs)
+
+    router.set_fault_plan(FaultPlan({1: 3}))
+    router.reset_state()
+    faulted, fault_stats, _, _ = timed(router.serve, "x")
+    same_fault = all(faulted[f"x{r.rid}"]["tokens"]
+                     == base[f"s{r.rid}"]["tokens"] for r in reqs)
+    one_compile = all(c["step"] == 1
+                      for c in router.trace_counts().values())
+
+    _obs_gauge("bench/fleet_tokens_per_sec", fleet_tps)
+    _obs_gauge("bench/fleet_single_tokens_per_sec", single_tps)
+    if fleet_p95 is not None:
+        _obs_gauge("bench/fleet_ttft_p95_s", fleet_p95)
+    return {
+        "metric": _FLEET_METRIC,
+        "value": round(fleet_tps, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,
+        "ok": same_fleet and same_fault and one_compile,
+        "fleet": True,
+        "detail": {
+            "replicas": 2,
+            "single_tokens_per_sec": round(single_tps, 2),
+            "fleet_vs_single": round(fleet_tps / max(single_tps, 1e-9), 3),
+            "ttft_p95_single_s": (round(single_p95, 4)
+                                  if single_p95 is not None else None),
+            "ttft_p95_fleet_s": (round(fleet_p95, 4)
+                                 if fleet_p95 is not None else None),
+            "fleet_steps": fleet_stats["fleet_steps"],
+            "single_steps": base_stats["steps"],
+            "preemptions": fleet_stats["preemptions"],
+            "fault_pass": {
+                "requeues": fault_stats["requeues"],
+                "dead_replicas": fault_stats["dead_replicas"],
+                "tokens_identical": same_fault,
+            },
+            "tokens_identical": same_fleet,
+            "trace_counts": router.trace_counts(),
+            "slo_mix": {"latency": sum(1 for r in reqs
+                                       if r.slo == "latency"),
+                        "batch": sum(1 for r in reqs if r.slo == "batch")},
+        },
+    }
+
+
+def _fleet_compile_rung(on_cpu: bool, timeout_s: float) -> dict:
+    """Dry-compile the N=2 fleet: each replica's unified step (one
+    program per replica — the router itself is pure host python and
+    adds ZERO compiles, which is exactly what this rung proves)."""
+    import jax.numpy as jnp  # noqa: F811
+
+    rung = {"rung": "fleet", "batch": None, "remat": "fleet"}
+    t_total = 0.0
+    try:
+        from apex_tpu.serving import FaultPlan, Router
+
+        eng, cfg, scfg = _serving_setup(on_cpu)
+        router = Router(scfg, eng.params, n_replicas=2,
+                        fault_plan=FaultPlan({}))
+        for rep in router.replicas:
+            e = rep.engine
+            args = (e.params, e.fresh_cache(),
+                    jnp.zeros((scfg.chunk_tokens,), jnp.int32),
+                    jnp.zeros((scfg.max_slots,), jnp.int32),
+                    jnp.zeros((scfg.max_slots,), jnp.int32))
+            compile_s, err = _compile_with_timeout(e._step, args, timeout_s)
+            if err is not None:
+                msg = ("compile hung" if err == "hung"
+                       else f"{type(err).__name__}: "
+                            f"{str(err).splitlines()[0][:200]}")
+                print(f"bench: compile-only rung fleet/replica{rep.rid}: "
+                      f"FAILED — marked skipped ({msg})", file=sys.stderr,
+                      flush=True)
+                rung.update(ok=False, skipped=True,
+                            error=f"replica{rep.rid}: {msg}")
+                return rung
+            t_total += compile_s
+        print(f"bench: compile-only rung fleet: OK ({t_total:.1f}s, "
+              f"2 replica steps)", file=sys.stderr, flush=True)
+        rung.update(ok=True, compile_s=round(t_total, 1))
+    except Exception as e:  # noqa: BLE001 — a failing rung is data
+        print(f"bench: compile-only rung fleet: FAILED — marked skipped "
+              f"({type(e).__name__}: {str(e).splitlines()[0][:200]})",
+              file=sys.stderr, flush=True)
+        rung.update(ok=False, skipped=True,
+                    error=str(e).splitlines()[0][:200])
+    return rung
+
+
 def _moe_setup(on_cpu: bool):
     """Model + fixed sweep point for the MoE dispatch A/B rung. One
     definition shared by the timed run (--moe) and the dry-compile gate.
@@ -984,6 +1122,15 @@ def main():
         # `--moe --compile-only` falls through to the dry-compile gate
         # below (which carries the per-path moe rungs) — never a timed rep
         emit(_moe_payload(on_cpu))
+        return
+
+    if _FLEET and not _COMPILE_ONLY:
+        # serving-fleet A/B rung: N=2 Router vs single engine tokens/s +
+        # p95 TTFT over the mixed latency/batch mix, ok gated on bitwise
+        # token identity incl. a fault-injected pass; its own metric
+        # name, same discipline. `--fleet --compile-only` falls through
+        # to the dry-compile gate below (which carries the fleet rung)
+        emit(_fleet_payload(on_cpu))
         return
 
     if on_cpu:
@@ -1303,6 +1450,7 @@ def main():
         gate_timeout = float(os.environ.get("BENCH_BATCH_TIMEOUT_S", "900"))
         compile_rungs.append(_serving_compile_rung(on_cpu, gate_timeout))
         compile_rungs.append(_spec_compile_rung(on_cpu, gate_timeout))
+        compile_rungs.append(_fleet_compile_rung(on_cpu, gate_timeout))
         compile_rungs.extend(_moe_compile_rungs(on_cpu, gate_timeout))
         compile_rungs.append(_obs_compile_rung(on_cpu, gate_timeout))
         compile_rungs.append(_analysis_compile_rung())

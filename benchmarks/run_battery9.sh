@@ -95,6 +95,18 @@ run prefix_cache  1800 'prefix leg: OK' \
 #      own "spec" rung.)
 run spec_bench    1800 'spec leg: OK' \
                        python -c 'import __graft_entry__ as g; g.dryrun_spec()'
+# 4c''' — serving-fleet rung (multi-replica router PR): the mixed
+#      latency/batch 16-request workload through an N=2 Router vs one
+#      engine (tokens/s + p95 TTFT, metric apex_tpu_fleet_tokens_per_sec,
+#      ok gated on bitwise token identity incl. a fault-injected fleet
+#      pass), then the graft fleet leg (replica-1 fault mid-drive,
+#      in-flight requeue to the survivor, token-identical recovery,
+#      1 compile per replica). The 2-replica steps also dry-compile in
+#      the overlap_gate compile-only item above as their own "fleet"
+#      rung.
+run fleet_bench   3600 '"ok": true' python bench.py --fleet
+run fleet_leg     1800 'fleet leg: OK' \
+                       python -c 'import __graft_entry__ as g; g.dryrun_fleet()'
 # 4d — MoE dispatch A/B rung (dropless-MoE PR): tokens/s of the einsum
 #      [t,E,C] dispatch vs the sort-based grouped-matmul path (capacity
 #      parity mode AND dropless) at the fixed GPT-medium-class sweep

@@ -1,0 +1,460 @@
+"""Serving fleet: SLO classes, preemption/requeue, multi-replica router
+placement, replica fault tolerance, and request conservation.
+
+Tier-1 hygiene: hermetic CPU mesh, kernel oracle path, and the
+heavyweight compiled objects (one single-engine + one 2-replica router
+over the same tiny model) are built ONCE per module — every fleet test
+drives the same compiled steps, pinning the fleet-level no-retrace
+contract as a side effect.
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from apex_tpu.observability import default_registry
+from apex_tpu.serving import (
+    FaultPlan,
+    InjectedReplicaFault,
+    Request,
+    Router,
+    Scheduler,
+    ServingConfig,
+    ServingEngine,
+    check_invariants,
+    free_block_count,
+    greedy_reference,
+)
+from apex_tpu.serving.fleet import slo
+from apex_tpu.testing import TransformerConfig, transformer_init
+
+_CFG = TransformerConfig(vocab_size=128, seq_len=64, hidden=32, layers=2,
+                         heads=4, causal=True)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer_init(jax.random.PRNGKey(0), _CFG)
+
+
+def _scfg(**kw):
+    base = dict(model=_CFG, num_blocks=96, block_size=4, max_slots=4,
+                max_prefill_len=16, max_seq_len=32)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def single(params):
+    return ServingEngine(_scfg(), params)
+
+
+@pytest.fixture(scope="module")
+def fleet(params):
+    return Router(_scfg(), params, n_replicas=2)
+
+
+def _workload(n=16, seed=0, tag=""):
+    """Staggered mixed-SLO workload: every third request latency-bound."""
+    rng = np.random.RandomState(seed)
+    return [
+        Request(rid=f"{tag}{i}",
+                prompt=rng.randint(1, _CFG.vocab_size,
+                                   size=rng.randint(2, 12)).tolist(),
+                max_new_tokens=int(rng.randint(1, 7)),
+                arrival=int(i // 3),
+                slo=slo.LATENCY if i % 3 == 0 else slo.BATCH)
+        for i in range(n)
+    ]
+
+
+def _clone(reqs, tag):
+    return [Request(rid=f"{tag}{r.rid}", prompt=r.prompt,
+                    max_new_tokens=r.max_new_tokens, arrival=r.arrival,
+                    slo=r.slo) for r in reqs]
+
+
+def _check_replicas(fleet):
+    for rep in fleet.replicas:
+        if not rep.alive:
+            continue
+        eng = rep.engine
+        if eng._cache is None:
+            continue
+        held = eng.index.held_ids() if eng.index is not None else {}
+        check_invariants(eng._cache, index_refs=held)
+        assert (int(free_block_count(eng._cache)) + len(held)
+                == eng.scfg.num_blocks)
+
+
+# ---------------------------------------------------------------------------
+# SLO classes (host-only)
+# ---------------------------------------------------------------------------
+
+def test_slo_class_vocabulary_and_env_default(monkeypatch):
+    assert slo.rank_of(slo.LATENCY) < slo.rank_of(slo.BATCH)
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        slo.rank_of("realtime")
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        Request(rid=0, prompt=[1], slo="realtime")
+    assert slo.resolve_class(None) == slo.BATCH
+    monkeypatch.setenv("APEX_TPU_SERVING_SLO_DEFAULT", "latency")
+    assert slo.resolve_class(None) == slo.LATENCY
+    assert slo.resolve_class("batch") == slo.BATCH   # explicit wins
+
+
+def test_slo_targets_env_knobs(monkeypatch):
+    t = slo.targets_for(slo.LATENCY)
+    assert t.ttft_s == 0.5 and t.tpot_s == 0.1
+    assert slo.targets_for(slo.BATCH) == slo.SLOTargets()
+    monkeypatch.setenv("APEX_TPU_SLO_LATENCY_TTFT_S", "0.025")
+    assert slo.targets_for(slo.LATENCY).ttft_s == 0.025
+    assert slo.violations(slo.LATENCY, 0.1, None) == ["ttft"]
+    assert slo.violations(slo.LATENCY, 0.01, 0.2) == ["tpot"]
+    assert slo.violations(slo.BATCH, 99.0, 99.0) == []
+    assert slo.violations(slo.LATENCY, None, None) == []  # unmeasured
+
+
+def test_plan_step_orders_latency_class_first():
+    """Under a tight budget a latency-bound request's prompt chunks
+    displace batch chunks; with one class the plan is byte-identical to
+    the pre-SLO sorted-slot order."""
+    def mk(slo_l):
+        # admit the batch request FIRST (slot 0), the second request
+        # (slot 1) afterwards — plan ordering is then isolated from the
+        # class-aware admission order
+        sched = Scheduler(max_slots=2, num_blocks=32, block_size=4,
+                          max_blocks_per_seq=8, watermark=0,
+                          chunk_tokens=6)
+        sched.add(Request(rid="b", prompt=list(range(1, 9)),
+                          max_new_tokens=2, slo=slo.BATCH))
+        sched.tick(0)
+        sched.admit()
+        sched.add(Request(rid="l", prompt=list(range(1, 9)),
+                          max_new_tokens=2, slo=slo_l))
+        sched.tick(0)
+        sched.admit()
+        return sched
+
+    # one class: slot 0 (first admitted) drains the budget first
+    sched = mk(slo.BATCH)
+    w = sched.plan_step()
+    assert [(x.slot, x.n) for x in w] == [(0, 6)]
+    # latency in slot 1 now takes the whole first chunk budget
+    sched = mk(slo.LATENCY)
+    w = sched.plan_step()
+    assert [(x.slot, x.n) for x in w] == [(1, 6)]
+    w = sched.plan_step()   # latency finishes its prompt, batch starts
+    assert [(x.slot, x.kind, x.n) for x in w] == [
+        (1, "chunk", 2), (0, "chunk", 4)]
+
+
+def test_admission_class_aware_head_of_line():
+    """A queued latency request passes a blocked batch head; FIFO holds
+    within a class."""
+    sched = Scheduler(max_slots=1, num_blocks=16, block_size=4,
+                      max_blocks_per_seq=4, watermark=0)
+    sched.add(Request(rid="b1", prompt=[1] * 4, max_new_tokens=2,
+                      slo=slo.BATCH))
+    sched.add(Request(rid="b2", prompt=[1] * 4, max_new_tokens=2,
+                      slo=slo.BATCH))
+    sched.add(Request(rid="l1", prompt=[1] * 4, max_new_tokens=2,
+                      slo=slo.LATENCY))
+    sched.tick(0)
+    adm = sched.admit()     # one slot: the latency request wins it
+    assert [a.req.rid for a in adm] == ["l1"]
+    sched.release(adm[0].slot)
+    assert [a.req.rid for a in sched.admit()] == ["b1"]   # FIFO resumes
+
+
+def test_preempt_and_requeue_scheduler_accounting():
+    """preempt() returns blocks exactly like release and requeue()
+    re-enters the victim at the front of its class."""
+    sched = Scheduler(max_slots=2, num_blocks=16, block_size=4,
+                      max_blocks_per_seq=4, watermark=0)
+    sched.add(Request(rid="b1", prompt=[1] * 8, max_new_tokens=2,
+                      slo=slo.BATCH))
+    sched.add(Request(rid="b2", prompt=[1] * 8, max_new_tokens=2,
+                      slo=slo.BATCH))
+    sched.tick(0)
+    sched.admit()
+    assert sched.free_blocks == 16 - 4
+    assert sched.pick_victim(slo.rank_of(slo.LATENCY)) == 1  # most recent
+    assert sched.pick_victim(slo.rank_of(slo.BATCH)) is None  # same class
+    st = sched.preempt(1)
+    assert st.req.rid == "b2"
+    assert sched.free_blocks == 16 - 2
+    assert sched._free_slots == [1]
+    sched.add(Request(rid="b3", prompt=[1] * 4, max_new_tokens=2,
+                      slo=slo.BATCH))
+    sched.tick(0)
+    sched.requeue(st.req)
+    # the victim outranks the newer same-class arrival
+    assert [a.req.rid for a in sched.admit()] == ["b2"]
+
+
+# ---------------------------------------------------------------------------
+# engine-level preemption (the serving/preemptions counter, armed)
+# ---------------------------------------------------------------------------
+
+def test_latency_preempts_batch_and_victim_resumes_bitwise(
+        params, monkeypatch):
+    """The satellite pin: a latency arrival on a full single-slot engine
+    EVICTS the decoding batch request (serving/preemptions leaves its
+    reserved-at-0 era, fleet/requeues counts the requeue), the latency
+    request is served first, and the victim's final output is bitwise
+    the uninterrupted greedy run's."""
+    monkeypatch.setenv("APEX_TPU_METRICS_SINK", "memory")
+    monkeypatch.setenv("APEX_TPU_USE_PALLAS", "0")
+    reg = default_registry()
+    reg.reset()
+    scfg = _scfg(num_blocks=32, max_slots=1, chunk_tokens=8)
+    eng = ServingEngine(scfg, params)
+    b = Request(rid="b", prompt=[3, 5, 7, 11], max_new_tokens=10,
+                slo=slo.BATCH)
+    lat = Request(rid="l", prompt=[2, 4, 6], max_new_tokens=3, arrival=3,
+                  slo=slo.LATENCY)
+    out = eng.run([b, lat])
+    stats = out.pop(None)
+    assert stats["preemptions"] >= 1
+    assert stats["requeues"] >= 1
+    assert reg.counter("serving/preemptions").value() >= 1
+    assert reg.counter("fleet/requeues").value(reason="preemption") >= 1
+    # the latency request finished before the (older) batch request
+    assert out["l"]["steps"] < out["b"]["steps"]
+    assert out["b"]["tokens"] == greedy_reference(params, _CFG, b.prompt,
+                                                  b.max_new_tokens)
+    assert out["l"]["tokens"] == greedy_reference(params, _CFG, lat.prompt,
+                                                  lat.max_new_tokens)
+    assert stats["trace_counts"]["step"] == 1
+    reg.reset()
+
+
+def test_same_class_never_preempts(params, monkeypatch):
+    """An all-batch (or all-latency) overload waits at admission exactly
+    as before — preemption needs a strictly higher class."""
+    monkeypatch.setenv("APEX_TPU_METRICS_SINK", "memory")
+    monkeypatch.setenv("APEX_TPU_USE_PALLAS", "0")
+    reg = default_registry()
+    reg.reset()
+    scfg = _scfg(num_blocks=32, max_slots=1, chunk_tokens=8)
+    eng = ServingEngine(scfg, params)
+    out = eng.run([Request(rid=i, prompt=[3 + i, 5], max_new_tokens=4,
+                           slo=slo.LATENCY) for i in range(3)])
+    stats = out.pop(None)
+    assert stats["preemptions"] == 0
+    assert reg.counter("serving/preemptions").value() == 0
+    assert len(out) == 3
+    reg.reset()
+
+
+# ---------------------------------------------------------------------------
+# the fleet: parity, fault tolerance, conservation (module router)
+# ---------------------------------------------------------------------------
+
+def test_fleet_parity_cold_warm_and_replica_label(single, fleet,
+                                                  monkeypatch):
+    """The acceptance pin: the N=2 fleet serves the 16-request mixed
+    latency/batch workload bitwise token-identical to the single engine
+    — cold AND prefix-warm — with one step compile per replica, both
+    replicas actually used, and per-replica metric series."""
+    monkeypatch.setenv("APEX_TPU_METRICS_SINK", "memory")
+    monkeypatch.setenv("APEX_TPU_USE_PALLAS", "0")
+    reg = default_registry()
+    reg.reset()
+    reqs = _workload()
+    base = single.run(_clone(reqs, "s"))
+    base.pop(None)
+
+    cold = fleet.serve(_clone(reqs, "c"))
+    cold_stats = cold.pop(None)
+    assert set(cold_stats["placements"].values()) == {0, 1}  # both used
+    for r in reqs:
+        assert cold[f"c{r.rid}"]["tokens"] == base[f"s{r.rid}"]["tokens"]
+
+    warm = fleet.serve(_clone(reqs, "w"))
+    warm_stats = warm.pop(None)
+    for r in reqs:
+        assert warm[f"w{r.rid}"]["tokens"] == base[f"s{r.rid}"]["tokens"]
+    assert sum(s["prefix_hit_tokens"]
+               for s in warm_stats["replicas"].values()) > 0
+
+    for counts in fleet.trace_counts().values():
+        assert counts["step"] == 1, counts
+        assert all(v <= 1 for v in counts.values()), counts
+    _check_replicas(fleet)
+
+    # the replica label: one serving series per replica, and the
+    # label-less read still aggregates the fleet total
+    ttft = reg.histogram("serving/ttft_s")
+    labels = {dict(k).get("replica") for k in ttft._series}
+    assert labels == {"0", "1"}
+    assert ttft.count(replica="0") + ttft.count(replica="1") \
+        == ttft.count() > 0
+    wait = reg.histogram("fleet/queue_wait_s")
+    assert wait.count() >= len(reqs)
+    reg.reset()
+
+
+def test_fleet_fault_injected_replica_drains_to_survivor(single, fleet):
+    """Replica 1 dies mid-drive (deterministic FaultPlan): its in-flight
+    requests requeue to replica 0 and every request's output is STILL
+    bitwise the single-engine (no-fault) run's; the dead engine
+    recovered via reset_state (no retrace), and the next drive re-joins
+    it."""
+    reqs = _workload(seed=7)
+    base = single.run(_clone(reqs, "s"))
+    base.pop(None)
+    before = fleet.trace_counts()
+
+    fleet.set_fault_plan(FaultPlan({1: 2}))
+    try:
+        out = fleet.serve(_clone(reqs, "f"))
+    finally:
+        fleet.set_fault_plan(FaultPlan({}))
+    stats = out.pop(None)
+    assert stats["dead_replicas"] == [1]
+    assert stats["requeues"] > 0
+    assert stats["faults"][0]["replica"] == 1
+    for r in reqs:
+        assert out[f"f{r.rid}"]["tokens"] == base[f"s{r.rid}"]["tokens"], \
+            r.rid
+    assert fleet.trace_counts() == before     # recovery never retraces
+
+    # the dead replica re-joins the next drive, cold but compiled
+    out2 = fleet.serve(_clone(reqs, "g"))
+    stats2 = out2.pop(None)
+    assert stats2["dead_replicas"] == []
+    assert stats2["replicas"][1]["steps"] > 0
+    for r in reqs:
+        assert out2[f"g{r.rid}"]["tokens"] == base[f"s{r.rid}"]["tokens"]
+    assert fleet.trace_counts() == before
+    _check_replicas(fleet)
+
+
+def test_fleet_conservation_property(fleet):
+    """The conservation property: across random workloads, placements,
+    SLO mixes and injected faults, every submitted request is emitted
+    exactly once — no loss, no duplication — and each emits exactly its
+    decode budget (no eos configured). Invariants stay clean on the
+    survivors."""
+    for seed in (11, 23, 31):
+        rng = random.Random(seed)
+        reqs = _workload(n=12, seed=seed, tag=f"p{seed}-")
+        plan = (FaultPlan({rng.randrange(2): rng.randrange(1, 6)})
+                if rng.random() < 0.8 else FaultPlan({}))
+        fleet.set_fault_plan(plan)
+        try:
+            out = fleet.serve(reqs)
+        finally:
+            fleet.set_fault_plan(FaultPlan({}))
+        stats = out.pop(None)
+        assert set(out) == {r.rid for r in reqs}          # exactly once
+        for r in reqs:
+            assert len(out[r.rid]["tokens"]) == r.max_new_tokens, r.rid
+        assert stats["requests"] == len(reqs)
+        _check_replicas(fleet)
+    for counts in fleet.trace_counts().values():
+        assert counts["step"] == 1, counts
+
+
+def test_fleet_conservation_guard_raises_on_loss(fleet, monkeypatch):
+    """The conservation check is a real guard: silently dropping a
+    drained request surfaces as a RuntimeError, not a short dict."""
+    fleet.set_fault_plan(FaultPlan({0: 1}))
+    monkeypatch.setattr(
+        "apex_tpu.serving.engine.ServingSession.drain", lambda self: [])
+    try:
+        with pytest.raises(RuntimeError, match="conservation"):
+            fleet.serve(_workload(n=6, seed=3, tag="x"))
+    finally:
+        fleet.set_fault_plan(FaultPlan({}))
+
+
+def test_all_replicas_dead_raises(fleet):
+    fleet.set_fault_plan(FaultPlan({0: 0, 1: 0}))
+    try:
+        with pytest.raises(RuntimeError, match="every replica"):
+            fleet.serve(_workload(n=4, seed=5, tag="d"))
+    finally:
+        fleet.set_fault_plan(FaultPlan({}))
+    # a failed drive cold-starts the survivors' engines like a failed run
+    assert all(rep.session is None for rep in fleet.replicas)
+
+
+def test_slo_violations_and_queue_wait_metrics(single, monkeypatch):
+    """An impossible latency TTFT target makes every latency request a
+    violation; batch requests never violate."""
+    monkeypatch.setenv("APEX_TPU_METRICS_SINK", "memory")
+    monkeypatch.setenv("APEX_TPU_USE_PALLAS", "0")
+    monkeypatch.setenv("APEX_TPU_SLO_LATENCY_TTFT_S", "0.000001")
+    reg = default_registry()
+    reg.reset()
+    reqs = [Request(rid=f"v{i}", prompt=[2 + i, 3, 4], max_new_tokens=2,
+                    slo=slo.LATENCY if i % 2 == 0 else slo.BATCH)
+            for i in range(4)]
+    out = single.run(reqs)
+    stats = out.pop(None)
+    n_latency = sum(1 for r in reqs if r.slo == slo.LATENCY)
+    assert stats["slo_violations"] >= n_latency
+    assert reg.counter("fleet/slo_violations").value(
+        slo="latency", kind="ttft") == n_latency
+    assert reg.counter("fleet/slo_violations").value(slo="batch") == 0
+    assert reg.histogram("fleet/queue_wait_s").count() == len(reqs)
+    reg.reset()
+
+
+# ---------------------------------------------------------------------------
+# knobs / plumbing
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_env_parsing(monkeypatch):
+    assert FaultPlan.from_env() is None
+    monkeypatch.setenv("APEX_TPU_FLEET_FAULT_STEPS", "1:3,0:7")
+    plan = FaultPlan.from_env()
+    assert plan.steps == {1: 3, 0: 7}
+    assert plan.fires(1, 3) and not plan.fires(1, 2)
+    for bad in ("1", "a:b", "1:3:5", "-1:2"):
+        monkeypatch.setenv("APEX_TPU_FLEET_FAULT_STEPS", bad)
+        with pytest.raises(ValueError, match="APEX_TPU_FLEET_FAULT_STEPS"):
+            FaultPlan.from_env()
+
+
+def test_router_replica_count_env_default(params, monkeypatch):
+    """Engine construction is lazy (no compile until first step), so the
+    width knob is cheap to pin."""
+    monkeypatch.setenv("APEX_TPU_FLEET_REPLICAS", "3")
+    r = Router(_scfg(), params)
+    assert [rep.engine.replica for rep in r.replicas] == ["0", "1", "2"]
+    assert len(Router(_scfg(), params, n_replicas=1).replicas) == 1
+    with pytest.raises(ValueError, match="n_replicas"):
+        Router(_scfg(), params, n_replicas=0)
+
+
+def test_router_rejects_duplicate_rid_and_submit_returns_placement(
+        params, fleet):
+    rid = "dup-test"
+    rep = fleet.submit(Request(rid=rid, prompt=[1, 2], max_new_tokens=1))
+    assert rep in (0, 1)
+    with pytest.raises(ValueError, match="duplicate"):
+        fleet.submit(Request(rid=rid, prompt=[3], max_new_tokens=1))
+    out = fleet.drive()
+    assert rid in out
+
+
+def test_signals_reflect_queued_work(params, fleet):
+    sigs = fleet.signals()
+    assert [s["replica"] for s in sigs] == [0, 1]
+    fleet.submit(Request(rid="sig-a", prompt=[1] * 8, max_new_tokens=4))
+    sigs = fleet.signals()
+    loaded = [s for s in sigs if s["est_work_tokens"] > 0]
+    assert len(loaded) == 1 and loaded[0]["queue_depth"] == 1
+    assert loaded[0]["est_work_tokens"] == 12
+    # the next submit balances onto the OTHER replica
+    other = fleet.submit(Request(rid="sig-b", prompt=[2] * 4,
+                                 max_new_tokens=2))
+    assert other != loaded[0]["replica"]
+    out = fleet.drive()
+    assert set(out) - {None} == {"sig-a", "sig-b"}

@@ -69,6 +69,32 @@ def test_counter_gauge_roundtrip(enabled_registry):
     assert len(snap["ops"]["series"]) == 2      # one per label set
 
 
+def test_label_subset_aggregation_reads(enabled_registry):
+    """The fleet-era read semantics: accessors match every series whose
+    label set CONTAINS the query, so instrumentation can gain a
+    dimension (the serving metrics' ``replica`` label) without breaking
+    label-less readers. Sums/counts aggregate; gauges resolve only when
+    unambiguous; storage never collapses (one series per label set)."""
+    reg = enabled_registry
+    inc_counter("served", 3, replica="0")
+    inc_counter("served", 4, replica="1")
+    c = reg.counter("served")
+    assert c.value() == 7                       # label-less = fleet total
+    assert c.value(replica="1") == 4            # exact series
+    assert len(reg.snapshot()["served"]["series"]) == 2
+    h = reg.histogram("wait", buckets=(1.0,))
+    h.observe(0.5, replica="0")
+    h.observe(2.5, replica="1")
+    assert h.count() == 2 and h.sum() == pytest.approx(3.0)
+    assert h.count(replica="0") == 1
+    g = reg.gauge("occ")
+    g.set(0.25, replica="0")
+    assert g.value() == 0.25                    # one match: unambiguous
+    g.set(0.5, replica="1")
+    assert g.value() is None                    # ambiguous, never summed
+    assert g.value(replica="1") == 0.5
+
+
 def test_histogram_buckets_sum_count(enabled_registry):
     reg = enabled_registry
     h = reg.histogram("lat", buckets=(0.1, 1.0, 10.0))

@@ -462,13 +462,22 @@ def test_engine_rejects_oversized_requests_at_intake():
     """Requests that cannot fit their lifetime fail loudly at run()
     intake, not as silent KV corruption mid-batch. (Prompts longer than
     the old padded-prefill shape are now simply CHUNKED — only the
-    max_seq_len cap remains.)"""
+    max_seq_len cap remains.) And since intake rejects BEFORE anything
+    is donated to the device, it must not cost the engine its warm
+    cache/prefix index (the reset-on-failure guard covers only started
+    loops)."""
     params = transformer_init(jax.random.PRNGKey(0), _CFG)
     scfg = ServingConfig(model=_CFG, num_blocks=16, block_size=4,
                          max_slots=2, max_prefill_len=4, max_seq_len=8)
     eng = ServingEngine(scfg, params)
     with pytest.raises(ValueError, match="max_seq_len"):
         eng.run([Request(rid=0, prompt=[1] * 3, max_new_tokens=12)])
+    out = eng.run([Request(rid=1, prompt=[1, 2, 3, 4], max_new_tokens=2)])
+    out.pop(None)
+    assert eng._cache is not None and len(eng.index) > 0  # warmed
+    with pytest.raises(ValueError, match="max_seq_len"):
+        eng.run([Request(rid=2, prompt=[1] * 3, max_new_tokens=12)])
+    assert eng._cache is not None and len(eng.index) > 0  # STILL warm
 
 
 def test_rope_max_seq_len_past_position_range_rejected():
