@@ -45,6 +45,7 @@ _SUBMODULES = (
     "contrib",
     "models",
     "observability",
+    "quantization",
     "serving",
     "testing",
     "tuning",

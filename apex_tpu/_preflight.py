@@ -328,6 +328,35 @@ def _probe_grouped_matmul() -> None:
         assert _maxdiff(a, c) < 0.1, "grouped_matmul grad mismatch vs oracle"
 
 
+def _probe_quant_matmul() -> None:
+    """Blockwise-scaled quantized matmul vs the dequantize-einsum
+    oracle over the SAME payloads (int8 + fp8 widths), forward and
+    custom_vjp grads — the low-precision compute kernel
+    (quantization/scaled_matmul.py)."""
+    from apex_tpu.quantization import quant_matmul
+
+    m, k, n = 192, 200, 160
+    lhs = jax.random.normal(jax.random.PRNGKey(0), (m, k), jnp.float32)
+    rhs = jax.random.normal(jax.random.PRNGKey(1), (k, n), jnp.float32)
+    do = jax.random.normal(jax.random.PRNGKey(2), (m, n), jnp.float32)
+
+    with _pinned_env("APEX_TPU_QUANT_TILE_M", None), \
+            _pinned_env("APEX_TPU_QUANT_TILE_N", None), \
+            _pinned_env("APEX_TPU_QUANT_TILE_K", None):
+        for qdtype in ("int8", "fp8"):
+            def loss(lhs, rhs, use, qdtype=qdtype):
+                y = quant_matmul(lhs, rhs, dtype=qdtype, use_pallas=use)
+                return jnp.vdot(y, do)
+
+            gp = jax.jit(jax.grad(lambda l, r: loss(l, r, True),
+                                  argnums=(0, 1)))(lhs, rhs)
+            gr = jax.grad(lambda l, r: loss(l, r, False),
+                          argnums=(0, 1))(lhs, rhs)
+            for a, c in zip(gp, gr):
+                assert _maxdiff(a, c) < 0.1, (
+                    f"quant_matmul grad mismatch vs oracle ({qdtype})")
+
+
 # family name (as consulted by default_use_pallas) -> probe
 PROBES: Dict[str, Callable[[], None]] = {
     "layer_norm": _probe_layer_norm,
@@ -337,6 +366,7 @@ PROBES: Dict[str, Callable[[], None]] = {
     "flash_attention_dropout": _probe_flash_attention_dropout,
     "paged_attention": _probe_paged_attention,
     "grouped_matmul": _probe_grouped_matmul,
+    "quant_matmul": _probe_quant_matmul,
     "optim_flat": _probe_optim_flat,
 }
 

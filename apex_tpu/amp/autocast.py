@@ -27,10 +27,10 @@ import jax.numpy as jnp
 
 from apex_tpu.amp import lists as _lists
 
-_LOW, _HIGH, _PROMOTE = "low", "high", "promote"
+_LOW, _HIGH, _PROMOTE, _QMM = "low", "high", "promote", "quant_matmul"
 
 # Runtime-extensible registries (ref: apex.amp.register_half_function etc.)
-_extra: dict = {_LOW: [], _HIGH: [], _PROMOTE: []}
+_extra: dict = {_LOW: [], _HIGH: [], _PROMOTE: [], _QMM: []}
 
 
 def register_half_function(module_name: str, fn_name: str) -> None:
@@ -79,16 +79,45 @@ def _map_float_args(fn, args, kwargs):
     return args, kwargs
 
 
+def _quantizable_matmul(args, kwargs) -> bool:
+    """True for the unambiguous ``x @ w`` shape the quantized kernel
+    accepts: two float operands, rhs a 2-D weight, contraction dims
+    matching. Anything else (vectors, batched rhs, kwargs like
+    ``precision``) keeps the plain cast behavior."""
+    if len(args) != 2 or kwargs:
+        return False
+    a, b = args
+    return (_is_float_array(a) and _is_float_array(b)
+            and getattr(a, "ndim", 0) >= 2 and getattr(b, "ndim", 0) == 2
+            and a.shape[-1] == b.shape[0])
+
+
 def _cast_wrapper(orig, category):
     @functools.wraps(orig)
     def wrapper(*args, **kwargs):
         policy = _current_policy()
         if policy is None:
             return orig(*args, **kwargs)
-        if category == _LOW:
+        if category == _QMM:
+            quant = getattr(policy, "matmul_quant", None)
+            if quant and _quantizable_matmul(args, kwargs):
+                from apex_tpu.quantization import quant_matmul
+
+                # the quant path's own jnp internals must not re-enter
+                # the interceptor (the oracle's fp32 einsum would be
+                # cast back to half) — run it casts-disabled
+                with autocast(enabled=False):
+                    return quant_matmul(
+                        *args, dtype=quant,
+                        bwd_quant=getattr(policy, "matmul_quant_bwd",
+                                          False))
+            category_now = _LOW     # gate-off: exactly the old behavior
+        else:
+            category_now = category
+        if category_now == _LOW:
             dtype = policy.compute_dtype
             args, kwargs = _map_float_args(lambda a: a.astype(dtype), args, kwargs)
-        elif category == _HIGH:
+        elif category_now == _HIGH:
             args, kwargs = _map_float_args(
                 lambda a: a.astype(jnp.float32), args, kwargs
             )
@@ -109,6 +138,7 @@ def _cast_wrapper(orig, category):
 def _entries():
     for cat, base in (
         (_LOW, _lists.LOW_PRECISION_FUNCS),
+        (_QMM, _lists.MATMUL_FUNCS),
         (_HIGH, _lists.HIGH_PRECISION_FUNCS),
         (_PROMOTE, _lists.PROMOTE_FUNCS),
     ):

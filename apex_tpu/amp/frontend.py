@@ -276,6 +276,8 @@ def initialize(
     loss_scale=None,
     half_dtype=None,
     keep_fp32_predicate=None,
+    matmul_quant=None,
+    matmul_quant_bwd=None,
     num_losses: int = 1,
     verbosity: int = 1,
 ):
@@ -299,9 +301,23 @@ def initialize(
         loss_scale=loss_scale,
         half_dtype=half_dtype,
         keep_fp32_predicate=keep_fp32_predicate,
+        matmul_quant=matmul_quant,
+        matmul_quant_bwd=matmul_quant_bwd,
     )
     if verbosity:
         print(f"apex_tpu.amp: opt_level={opt_level}, policy={policy}")
+
+    if policy.matmul_quant:
+        # materialize the quantized-matmul saving counter at 0 with the
+        # SAME label shape the trace-time increments carry, so a run
+        # that never traces a quantizable matmul still exports the
+        # series (the serving counters' convention, docs/quantization.md)
+        from apex_tpu.observability import default_registry, \
+            metrics_enabled
+
+        if metrics_enabled():
+            default_registry().counter("quant/matmul_bytes_saved").inc(
+                0, qdtype=policy.matmul_quant)
 
     cast_params = policy.cast_params(params)
 

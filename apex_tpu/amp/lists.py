@@ -20,12 +20,23 @@ LOW_PRECISION_FUNCS = [
     ("jax.lax", "conv_general_dilated"),
     ("jax.lax", "conv"),
     ("jax.lax", "conv_with_general_padding"),
-    ("jax.numpy", "matmul"),
-    ("jax.numpy", "dot"),
     ("jax.numpy", "vdot"),
     ("jax.numpy", "inner"),
     ("jax.numpy", "tensordot"),
     ("jax.numpy", "einsum"),
+]
+
+# The dense-matmul entry points: behave exactly like LOW_PRECISION_FUNCS
+# unless the active policy carries a matmul-precision override
+# (``Policy.matmul_quant``, the O2_INT8 mode), in which case
+# matmul-shaped calls route through the blockwise-scaled quantized
+# kernel (quantization/scaled_matmul.py). Kept as their own list so the
+# quant route wraps ONLY the unambiguous ``x @ w`` shapes —
+# einsum/dot_general calls with general dimension numbers stay on the
+# cast path.
+MATMUL_FUNCS = [
+    ("jax.numpy", "matmul"),
+    ("jax.numpy", "dot"),
 ]
 
 # Numerically sensitive ops pinned to fp32 (reference FP32_FUNCS + the

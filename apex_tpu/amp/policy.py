@@ -12,6 +12,16 @@ TPU reading of the levels (SURVEY.md §3.2 mapping):
   O2 — params cast to half (BatchNorm kept fp32), fp32 master weights held by
        the optimizer, dynamic loss scaling.
   O3 — pure half, no master weights, static scale 1 (speed ceiling).
+  O2_INT8 — O2 plus the matmul-precision override: the autocast
+       interceptor additionally routes dense/MLP matmuls through the
+       blockwise-scaled int8 kernel (``matmul_quant="int8"``,
+       quantization/scaled_matmul.py; per-tile fp32 scales, fp32 MXU
+       accumulation). ``matmul_quant_bwd`` picks whether the backward's
+       cotangent matmuls run at the same quantized width (default: fp32
+       — accuracy-first, like the error-compensated comms default).
+       With ``matmul_quant`` unset every other level lowers
+       byte-identical HLO to the pre-quantization stack
+       (docs/quantization.md; pinned by tests).
 
 ``half_dtype`` selects bfloat16 (TPU-native default; scaler is then inert in
 practice but kept for parity) or float16 (exercises the full scaler ladder).
@@ -48,6 +58,18 @@ class Policy:
     loss_scale: Union[str, float] = 1.0          # "dynamic" or a number
     half_dtype: object = None                    # bf16 (default) or fp16
     keep_fp32_predicate: Callable[[str], bool] = default_keep_fp32_predicate
+    # matmul-precision override (O2_INT8): None = off (byte-identical to
+    # today's paths), "int8" | "fp8" = route dense matmuls through
+    # quantization.quant_matmul; matmul_quant_bwd picks the backward
+    # width (False = fp32 cotangent matmuls, the accuracy-first default)
+    matmul_quant: Optional[str] = None
+    matmul_quant_bwd: bool = False
+
+    def __post_init__(self):
+        if self.matmul_quant not in (None, "int8", "fp8"):
+            raise ValueError(
+                f"matmul_quant={self.matmul_quant!r} not in "
+                f"(None, 'int8', 'fp8')")
 
     @staticmethod
     def from_opt_level(
@@ -60,6 +82,8 @@ class Policy:
         loss_scale=None,
         half_dtype=None,
         keep_fp32_predicate=None,
+        matmul_quant=None,
+        matmul_quant_bwd=None,
     ) -> "Policy":
         half = canonical_half_dtype(half_dtype) or default_half_dtype()
         presets = {
@@ -91,16 +115,32 @@ class Policy:
                 master_weights=False,
                 loss_scale=1.0,
             ),
+            # O2 + the int8 matmul-precision override: patch_functions
+            # turns the interceptor on so the matmul entry points route
+            # through quantization.quant_matmul (module doc)
+            "O2_INT8": dict(
+                cast_model_type=half,
+                patch_functions=True,
+                keep_batchnorm_fp32=True,
+                master_weights=True,
+                loss_scale="dynamic",
+                matmul_quant="int8",
+            ),
         }
         if opt_level not in presets:
-            raise ValueError(f"Unexpected opt_level {opt_level!r}; expected O0..O3")
+            raise ValueError(
+                f"Unexpected opt_level {opt_level!r}; expected O0..O3 or "
+                f"O2_INT8")
         cfg = presets[opt_level]
+        cfg.setdefault("matmul_quant", None)
         overrides = dict(
             cast_model_type=cast_model_type,
             patch_functions=patch_functions,
             keep_batchnorm_fp32=keep_batchnorm_fp32,
             master_weights=master_weights,
             loss_scale=loss_scale,
+            matmul_quant=matmul_quant,
+            matmul_quant_bwd=matmul_quant_bwd,
         )
         for k, v in overrides.items():
             if v is not None:
@@ -146,3 +186,4 @@ O0 = Policy.from_opt_level("O0")
 O1 = Policy.from_opt_level("O1")
 O2 = Policy.from_opt_level("O2")
 O3 = Policy.from_opt_level("O3")
+O2_INT8 = Policy.from_opt_level("O2_INT8")

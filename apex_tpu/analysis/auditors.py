@@ -32,9 +32,9 @@ Three checks:
 Entry points are :class:`EntryPoint` records; :func:`default_entry_points`
 builds the repo's representative set (train step, DDP bucket flush, ZeRO
 scatter flush, decomposed TP matmul, serving paged decode, ragged
-speculative verify, the unified serving step, and the pipeline-parallel
-1F1B + interleaved train steps on a pp=2 stage ring) sized to trace in
-well under a minute on CPU. The same traced jaxprs feed the memory
+speculative verify, the unified serving step — full-width AND over the
+int8 KV pool — and the pipeline-parallel 1F1B + interleaved train steps
+on a pp=2 stage ring) sized to trace in well under a minute on CPU. The same traced jaxprs feed the memory
 estimator (analysis/memory.py) and the SPMD checker (analysis/spmd.py)
 — :func:`trace_entry` is the share point, so each entry traces once per
 run however many layers consume it.
@@ -443,6 +443,38 @@ def default_entry_points() -> List[EntryPoint]:
     eps.append(EntryPoint(
         name="serving_unified_step", fn=sv_step, args=_sv_args,
         args_variant=_sv_args, axis_sizes={"model": 1}, specs=sv_specs))
+
+    # -- 7b. the SAME unified step over the int8 KV pool (the
+    #        APEX_TPU_SERVING_KV_INT8 program): quantized payload +
+    #        scale-sidecar pools donated through the step, in-kernel
+    #        dequantization at fetch time — donation, dtype-drift and
+    #        the APX4xx/APX5xx layers all run over the quantized
+    #        program too
+    sv_qspecs = (param_specs(sv_cfg), kc.quant_cache_pspecs("model"),
+                 P(), P(), P())
+    sv_qstep = jax.jit(
+        smap(lambda p, c, t, qs, ql: _step_body(
+            p, c, t, qs, ql, cfg=sv_cfg, scfg={"tp": 1}),
+            sv_mesh, sv_qspecs, (kc.quant_cache_pspecs("model"), P())),
+        donate_argnums=(1,))
+
+    def _svq_args(tok_dtype=np.int32):
+        # same run layout as the full-width entry, over the DOUBLED
+        # pool the int8 variant holds in the same bytes
+        cache = kc.quantized_kv_cache(
+            layers=sv_cfg.layers, num_blocks=16, block_size=4,
+            n_kv_heads=sv_cfg.heads,
+            head_dim=sv_cfg.hidden // sv_cfg.heads,
+            max_slots=2, max_blocks_per_seq=8)
+        tokens = np.zeros((4,), tok_dtype)
+        qs = np.array([0, 3], np.int32)
+        ql = np.array([3, 1], np.int32)
+        return (sv_params, cache, tokens, qs, ql)
+
+    eps.append(EntryPoint(
+        name="serving_unified_step_int8", fn=sv_qstep, args=_svq_args,
+        args_variant=_svq_args, axis_sizes={"model": 1},
+        specs=sv_qspecs))
 
     # -- 8/9. pipeline-parallel train steps (1F1B + interleaved) on the
     #         circulating stage ring — pp=2 whenever the process has two

@@ -554,9 +554,14 @@ def _overlap_extra(params: dict, features: dict, tag: str
 # -- paged decode (ragged multi-query) --------------------------------------
 
 def _paged_shapes() -> List[dict]:
-    return [{"slots": 4, "max_blocks": mb, "bs": 16, "group": g, "d": 64,
-             "nb": 32, "tq": tq}
-            for mb in (1, 7) for g in (1, 4) for tq in (4, 24)]
+    shapes = [{"slots": 4, "max_blocks": mb, "bs": 16, "group": g, "d": 64,
+               "nb": 32, "tq": tq}
+              for mb in (1, 7) for g in (1, 4) for tq in (4, 24)]
+    # the int8-KV variant (quant=True): same grid, each fetched page
+    # adds a scale-sidecar block pair riding the same table-driven
+    # index maps — two representative shapes keep the sweep bounded
+    shapes += [dict(s, quant=True) for s in (shapes[1], shapes[-1])]
+    return shapes
 
 
 def _paged_layout(s_n: int, tq: int, q_tile: int) -> List[int]:
@@ -611,6 +616,15 @@ def _paged_build(params: dict, features: dict) -> Optional[KernelGeom]:
             return (table[flat], 0, h, 0)
         return index
 
+    def scale_map(i):
+        # the quant variant's sidecar pages: same page selection, minus
+        # the head_dim axis (ops/paged_attention.scale_map)
+        def index(w, h, j):
+            s = min(work_slot[w], s_n - 1)
+            flat = min(max(s * mb + j * fetch + i, 0), flat_len - 1)
+            return (table[flat], 0, h)
+        return index
+
     blocks = [BlockGeom("q", (tq_pad, hq, d), (tq_pad, hq, d),
                         lambda w, h, j: (0, 0, 0)),
               BlockGeom("out", (tq_pad, hq, d), (tq_pad, hq, d),
@@ -620,9 +634,17 @@ def _paged_build(params: dict, features: dict) -> Optional[KernelGeom]:
                                 page_map(i)))
         blocks.append(BlockGeom(f"v{i}", (1, bs, 1, d), (nb, bs, hkv, d),
                                 page_map(i)))
-    bytes_el = 2
-    vmem = (2 * tq_pad * hq * d * bytes_el          # resident q + out
+    quant = bool(features.get("quant"))
+    if quant:
+        for i in range(fetch):
+            blocks.append(BlockGeom(f"ks{i}", (1, bs, 1), (nb, bs, hkv),
+                                    scale_map(i)))
+            blocks.append(BlockGeom(f"vs{i}", (1, bs, 1), (nb, bs, hkv),
+                                    scale_map(i)))
+    bytes_el = 1 if quant else 2
+    vmem = (2 * tq_pad * hq * d * 2                 # resident q + out
             + fetch * 2 * bs * d * bytes_el * 2     # double-buffered pages
+            + (fetch * 2 * bs * 4 * 2 if quant else 0)   # scale pages
             + rows * d * 4 + 2 * rows * 4)          # (acc, m, l) scratch
     return KernelGeom(
         "paged_decode", (n_work, hkv, nj), blocks,
@@ -639,6 +661,60 @@ def _paged_defaults(features: dict) -> dict:
         "kv_fetch": cost_model.paged_kv_fetch_default(
             features["bs"], features["d"]),
         "q_tile": cost_model.paged_q_tile_default(features["group"]),
+    }
+
+
+# -- blockwise-scaled quantized matmul (quantization/scaled_matmul.py) -----
+
+def _quant_shapes() -> List[dict]:
+    return [{"m": m, "k": k, "n": 384}
+            for m in (48, 1024) for k in (200, 1024)]
+
+
+def _quant_build(params: dict, features: dict) -> Optional[KernelGeom]:
+    """Mirror of quantization.scaled_matmul._qmm_pallas: dense grid
+    (m-tile, n-tile, k-block) with k minor (the revisit axis of the
+    fp32 accumulator), int8/fp8 payload tiles plus their (rows, 1) /
+    (1, cols) scale-sidecar blocks."""
+    if params.get("backend") == "jnp":
+        return None
+    m, k, n = features["m"], features["k"], features["n"]
+    tile_m, tile_k = params["tile_m"], params["tile_k"]
+    k_pad = _ceil(max(_pad128(k), 1), tile_k) * tile_k
+    n_pad128 = _pad128(n)
+    tile_n = min(params["tile_n"], n_pad128)
+    m_pad = _pad_to(m, tile_m)
+    n_pad = _ceil(n_pad128, tile_n) * tile_n
+    nm, nn, nk = m_pad // tile_m, n_pad // tile_n, k_pad // tile_k
+    blocks = [
+        BlockGeom("lq", (tile_m, tile_k), (m_pad, k_pad),
+                  lambda i, j, kb: (i, kb)),
+        BlockGeom("ls", (tile_m, 1), (m_pad, nk),
+                  lambda i, j, kb: (i, kb)),
+        BlockGeom("rq", (tile_k, tile_n), (k_pad, n_pad),
+                  lambda i, j, kb: (kb, j)),
+        BlockGeom("rs", (1, tile_n), (nk, n_pad),
+                  lambda i, j, kb: (kb, j)),
+        BlockGeom("out", (tile_m, tile_n), (m_pad, n_pad),
+                  lambda i, j, kb: (i, j)),
+    ]
+    vmem = (2 * (tile_m * tile_k + tile_k * tile_n) * 1   # int8 payloads
+            + 2 * (tile_m + tile_n) * 4                   # scale sidecars
+            + tile_m * tile_n * (4 + 4))                  # fp32 acc + out
+    return KernelGeom(
+        "quant_matmul", (nm, nn, nk), blocks,
+        vmem_bytes=vmem, vmem_budget=_vmem_budget(),
+        tag=_tag("quant_matmul", features, params))
+
+
+def _quant_defaults(features: dict) -> dict:
+    from apex_tpu.tuning import cost_model
+
+    return {
+        "tile_m": cost_model.quant_tile_m_default(features["k"],
+                                                  features["n"]),
+        "tile_n": cost_model.quant_tile_n_default(features["n"]),
+        "tile_k": cost_model.quant_tile_k_default(features["k"]),
     }
 
 
@@ -725,6 +801,8 @@ FAMILIES: Dict[str, Family] = {
                _paged_build, _paged_defaults),
         Family("moe_grouped", "moe_grouped", _moe_shapes, _moe_build,
                _moe_defaults, extra=_moe_extra),
+        Family("quant_matmul", "quant_matmul", _quant_shapes,
+               _quant_build, _quant_defaults),
         Family("overlap_tp", "overlap_tp", _overlap_shapes,
                _overlap_build, extra=_overlap_extra),
     )

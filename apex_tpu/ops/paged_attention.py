@@ -127,16 +127,20 @@ def packed_row_slots(query_start, query_len, total_q: int):
 # ---------------------------------------------------------------------------
 
 def ragged_paged_attention_ref(q, k_pool, v_pool, block_tables, query_start,
-                               query_len, kv_len, *, scale=None):
+                               query_len, kv_len, *, scale=None,
+                               k_scale=None, v_scale=None):
     """Unfused oracle for the ragged multi-query layout: gather each row's
     slot pages, causal-mask against the ragged lengths, fp32 softmax.
 
     q: [total_q, Hq, D] packed; k_pool/v_pool: [N, bs, Hkv, D];
     block_tables: [S, max_blocks] int32; query_start/query_len/kv_len:
-    [S] int32. Returns [total_q, Hq, D]; rows not covered by any slot's
-    run are exactly 0. Materializes [total_q, max_blocks*bs, Hkv, D] —
-    the memory-bound path the Pallas kernel exists to avoid; used as the
-    fallback and the test oracle."""
+    [S] int32. With ``k_scale``/``v_scale`` ([N, bs, Hkv] fp32 — the
+    int8 pool's per-(token, head) sidecars, serving/kv_cache.py) the
+    pools are int8 payloads dequantized at fetch time. Returns
+    [total_q, Hq, D]; rows not covered by any slot's run are exactly 0.
+    Materializes [total_q, max_blocks*bs, Hkv, D] — the memory-bound
+    path the Pallas kernel exists to avoid; used as the fallback and
+    the test oracle."""
     tq, hq, d = q.shape
     nb, bs, hkv, _ = k_pool.shape
     s_n, maxb = block_tables.shape
@@ -150,6 +154,12 @@ def ragged_paged_attention_ref(q, k_pool, v_pool, block_tables, query_start,
     idx = jnp.clip(block_tables, 0, nb - 1)
     k = k_pool[idx].reshape(s_n, t, hkv, d).astype(jnp.float32)
     v = v_pool[idx].reshape(s_n, t, hkv, d).astype(jnp.float32)
+    if k_scale is not None:
+        # dequantize the GATHERED pages only (the whole-pool multiply
+        # would materialize fp32 copies of a pool quantization just
+        # grew 2-4x)
+        k = k * k_scale[idx].reshape(s_n, t, hkv)[..., None]
+        v = v * v_scale[idx].reshape(s_n, t, hkv)[..., None]
     r = jnp.arange(tq)
     sid, valid = packed_row_slots(qs, ql, tq)
     pos = kl[sid] - ql[sid] + (r - qs[sid])                  # abs position
@@ -214,15 +224,22 @@ def _work_metadata(query_len, q_tile: int, n_work: int, n_slots: int):
 
 def _ragged_kernel(wslot_ref, wqt_ref, tbl_ref, qs_ref, ql_ref, kl_ref,
                    q_ref, *rest, kv_fetch, block_size, scale, nj, q_tile,
-                   group, rows, n_slots, d):
+                   group, rows, n_slots, d, quantized):
     """Grid (work item w, kv_head h, fetch-step j). rest is kv_fetch
-    k-page refs, kv_fetch v-page refs, the out ref, then (acc, m, l)
-    scratch. The (m, l, acc) recurrence accumulates across j per work
-    item; init at j == 0, emit at the last j."""
+    k-page refs, kv_fetch v-page refs (+ kv_fetch k-scale and v-scale
+    page refs on the int8 pool), the out ref, then (acc, m, l) scratch.
+    The (m, l, acc) recurrence accumulates across j per work item; init
+    at j == 0, emit at the last j."""
     k_refs = rest[:kv_fetch]
     v_refs = rest[kv_fetch:2 * kv_fetch]
-    o_ref = rest[2 * kv_fetch]
-    acc_ref, m_ref, l_ref = rest[2 * kv_fetch + 1:]
+    rest = rest[2 * kv_fetch:]
+    ks_refs = vs_refs = ()
+    if quantized:
+        ks_refs = rest[:kv_fetch]
+        vs_refs = rest[kv_fetch:2 * kv_fetch]
+        rest = rest[2 * kv_fetch:]
+    o_ref = rest[0]
+    acc_ref, m_ref, l_ref = rest[1:]
     del tbl_ref  # consumed by the index maps, not the body
     w = pl.program_id(0)
     h = pl.program_id(1)
@@ -264,6 +281,12 @@ def _ragged_kernel(wslot_ref, wqt_ref, tbl_ref, qs_ref, ql_ref, kl_ref,
         def _(i=i, page=page):
             kb = k_refs[i][0, :, 0, :].astype(jnp.float32)    # [bs, D]
             vb = v_refs[i][0, :, 0, :].astype(jnp.float32)
+            if quantized:
+                # int8 pool: dequantize the fetched page rows at their
+                # per-(token, head) sidecar scales, IN KERNEL — HBM
+                # moved the 1-byte payload, VMEM holds the fp32 view
+                kb = kb * ks_refs[i][0, :, 0][:, None]
+                vb = vb * vs_refs[i][0, :, 0][:, None]
             sc = jax.lax.dot_general(
                 qv, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -296,7 +319,9 @@ def _ragged_kernel(wslot_ref, wqt_ref, tbl_ref, qs_ref, ql_ref, kl_ref,
 
 
 def _ragged_pallas(q, k_pool, v_pool, block_tables, query_start, query_len,
-                   kv_len, scale, block_rows, kv_fetch, q_tile):
+                   kv_len, scale, block_rows, kv_fetch, q_tile,
+                   k_scale=None, v_scale=None):
+    quantized = k_scale is not None
     tq, hq, d = q.shape
     nb, bs, hkv, _ = k_pool.shape
     s_n, max_blocks = block_tables.shape
@@ -328,6 +353,17 @@ def _ragged_pallas(q, k_pool, v_pool, block_tables, query_start, query_len,
     def whole(w, h, j, *refs):
         return (0, 0, 0)
 
+    def scale_map(i):
+        # same page selection as page_map, minus the head_dim axis —
+        # the scale sidecar pools are [N, bs, Hkv]
+        def index(w, h, j, wslot_ref, wqt_ref, tbl_ref, qs_ref, ql_ref,
+                  kl_ref):
+            s = jnp.minimum(wslot_ref[w], s_n - 1)
+            flat = jnp.clip(s * max_blocks + j * kv_fetch + i, 0,
+                            tbl_ref.shape[0] - 1)
+            return (tbl_ref[flat], 0, h)
+        return index
+
     in_specs = [pl.BlockSpec((tq_pad, hq, d), whole)]
     args = [qp]
     for i in range(kv_fetch):
@@ -336,6 +372,11 @@ def _ragged_pallas(q, k_pool, v_pool, block_tables, query_start, query_len,
     for i in range(kv_fetch):
         in_specs.append(pl.BlockSpec((1, bs, 1, d), page_map(i)))
         args.append(v_pool)
+    if quantized:
+        for pool in (k_scale, v_scale):
+            for i in range(kv_fetch):
+                in_specs.append(pl.BlockSpec((1, bs, 1), scale_map(i)))
+                args.append(pool)
 
     grid_spec = _pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=6,
@@ -352,6 +393,7 @@ def _ragged_pallas(q, k_pool, v_pool, block_tables, query_start, query_len,
         functools.partial(
             _ragged_kernel, kv_fetch=kv_fetch, block_size=bs, scale=scale,
             nj=nj, q_tile=q_tile, group=group, rows=rows, n_slots=s_n, d=d,
+            quantized=quantized,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((tq_pad, hq, d), q.dtype),
@@ -372,7 +414,7 @@ def _ragged_pallas(q, k_pool, v_pool, block_tables, query_start, query_len,
 
 def ragged_paged_attention(q, k_pool, v_pool, block_tables, query_start,
                            query_len, kv_len, *, scale=None,
-                           use_pallas=None):
+                           use_pallas=None, k_scale=None, v_scale=None):
     """Ragged multi-query paged attention: per-slot query RUNS packed
     token-major against the block-paged KV pool.
 
@@ -380,9 +422,14 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, query_start,
     k_pool/v_pool: [num_blocks, block_size, Hkv, D] with Hq % Hkv == 0
     (GQA shares each KV page across the query group in-kernel);
     block_tables: [S, max_blocks] int32 page ids; query_start/query_len/
-    kv_len: [S] int32 run metadata (module doc). The run's K/V must
-    already be in the cache (kv_len INCLUDES the run). Rows covered by
-    no run return exactly 0. No backward: inference-only.
+    kv_len: [S] int32 run metadata (module doc). With ``k_scale``/
+    ``v_scale`` ([N, bs, Hkv] fp32, both or neither) the pools are the
+    int8 variant's payloads (serving/kv_cache.quantized_kv_cache) and
+    each fetched page dequantizes in-kernel at its per-(token, head)
+    sidecar scale — same grid, the scale pages ride the same
+    table-driven index maps. The run's K/V must already be in the cache
+    (kv_len INCLUDES the run). Rows covered by no run return exactly 0.
+    No backward: inference-only.
     """
     if q.ndim != 3:
         raise ValueError(f"ragged_paged_attention expects q "
@@ -406,6 +453,13 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, query_start,
                 f"{block_tables.shape} ({s_n} slots)")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be passed together "
+                         "(the int8 pool's sidecars)")
+    if k_scale is not None and k_scale.shape != k_pool.shape[:-1]:
+        raise ValueError(
+            f"k_scale {k_scale.shape} must be the pool minus head_dim "
+            f"({k_pool.shape[:-1]})")
     group = hq // hkv
     max_blocks = block_tables.shape[1]
 
@@ -415,15 +469,16 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, query_start,
     if not use or _pltpu is None:
         return ragged_paged_attention_ref(
             q, k_pool, v_pool, block_tables, query_start, query_len, kv_len,
-            scale=scale)
+            scale=scale, k_scale=k_scale, v_scale=v_scale)
     p = _paged_params(s_n, max_blocks, bs, group, d, q.dtype, tq)
     return _ragged_pallas(q, k_pool, v_pool, block_tables, query_start,
                           query_len, kv_len, scale, p["block_rows"],
-                          p["kv_fetch"], p["q_tile"])
+                          p["kv_fetch"], p["q_tile"],
+                          k_scale=k_scale, v_scale=v_scale)
 
 
 def paged_attention(q, k_pool, v_pool, block_tables, lengths, *, scale=None,
-                    use_pallas=None):
+                    use_pallas=None, k_scale=None, v_scale=None):
     """Decode-shaped entry (the PR-3 signature, kept for probes and
     sweeps): one query token per slot against the block-paged KV pool —
     slot s is the packed run ``(query_start=s, query_len=(lengths[s]>0),
@@ -446,4 +501,5 @@ def paged_attention(q, k_pool, v_pool, block_tables, lengths, *, scale=None,
         q, k_pool, v_pool, block_tables,
         jnp.arange(s_n, dtype=jnp.int32),
         (lengths > 0).astype(jnp.int32), lengths,
-        scale=scale, use_pallas=use_pallas)
+        scale=scale, use_pallas=use_pallas,
+        k_scale=k_scale, v_scale=v_scale)

@@ -1,0 +1,48 @@
+"""Low-precision subsystem: blockwise-scaled quantization for compute
+and memory (ROADMAP item 3 — the end-to-end story whose wire half is
+``parallel/quantized_collectives.py``).
+
+Three consumers of one scheme (narrow payload + per-block fp32 absmax
+scale sidecar, qtensor.py):
+
+* ``quant_matmul`` (scaled_matmul.py) — the Pallas blockwise-scaled
+  int8/fp8 matmul family, registered as the ``quant_matmul`` tunable
+  and routed into dense/MLP matmuls by the amp ``O2_INT8`` policy mode
+  (amp/policy.py).
+* the int8 paged KV cache (serving/kv_cache.py ``quantized_kv_cache``)
+  — int8 K/V pools with per-(token, head) scales, dequantized in-kernel
+  by ops/paged_attention.py, behind ``APEX_TPU_SERVING_KV_INT8=1``.
+* the quantized collectives that came first (parallel/) — unchanged,
+  already validated by tests/L0/test_quantized_comms_fuzz.py.
+
+docs/quantization.md covers the error models, policy modes, KV layout
+and tunables.
+"""
+
+from apex_tpu.quantization.qtensor import (
+    FP8_MAX,
+    INT8_QMAX,
+    QTensor,
+    dequantize,
+    quant_itemsize,
+    quantize,
+)
+from apex_tpu.quantization.scaled_matmul import (
+    matmul_bytes_saved,
+    quant_matmul,
+    quant_matmul_ref,
+    quantized_operands,
+)
+
+__all__ = [
+    "FP8_MAX",
+    "INT8_QMAX",
+    "QTensor",
+    "dequantize",
+    "matmul_bytes_saved",
+    "quant_itemsize",
+    "quant_matmul",
+    "quant_matmul_ref",
+    "quantize",
+    "quantized_operands",
+]

@@ -1,0 +1,145 @@
+"""Blockwise quantize / dequantize — the scale-sidecar library under the
+low-precision subsystem.
+
+The wire format is the one ``parallel/quantized_collectives.py`` proved
+for gradients (EQuARX, PAPERS.md), brought to compute and memory: a
+narrow payload plus a PER-BLOCK fp32 absmax scale, so one outlier costs
+its own block's resolution, never the tensor's. Blocks run along ONE
+axis (the matmul contraction axis for ``scaled_matmul.quant_matmul``,
+head_dim for the int8 KV cache), and the scales ride as a SIDECAR array
+— ``QTensor(q, scale)`` is a plain pytree the jitted consumers carry
+like any other operand pair.
+
+Two payload widths:
+
+* ``int8`` — symmetric round-to-nearest-even into [-127, 127]
+  (``jnp.round`` is RNE; ties cannot bias a sum). Scale =
+  absmax / 127 per block. **Error model** (fuzzed by
+  tests/L0/test_quantization_fuzz.py the way
+  test_quantized_comms_fuzz.py fuzzes the wire): the roundtrip error is
+  elementwise bounded by half a quantization step,
+
+      |x - dequant(quant(x))| <= scale / 2 = absmax_block / 254,
+
+  i.e. worst-case ~0.4% of the block's absmax. Exact zeros survive
+  exactly; a value equal to the block absmax maps to exactly ±127 (no
+  clamping error).
+
+* ``fp8`` (``float8_e4m3fn`` layout, emulated on CPU via XLA's f8
+  casts) — scale = absmax / 448 (the e4m3 max normal), payload is the
+  f8 cast of ``x / scale``. **Error model**: e4m3 carries 3 mantissa
+  bits, so the roundtrip error is relative,
+
+      |x - dequant(quant(x))| <= |x| * 2^-4 + scale * 2^-7,
+
+  (half-ulp of the 3-bit mantissa, plus the subnormal floor near zero).
+  fp8 trades the int8 format's uniform absolute error for wider dynamic
+  range WITHIN a block — denormal-heavy blocks keep relative precision
+  an int8 grid would flush to zero.
+
+All-zero blocks take scale 1 (zeros quantize exactly, no 0/0), matching
+the collectives' convention. Non-block-aligned trailing extents are
+zero-padded internally — zeros quantize exactly, so a ragged tail costs
+nothing — and the padding never leaves this module (``quantize``
+returns the original extent; consumers that WANT the padded layout,
+like the matmul kernel, pad first and quantize the padded operand so
+kernel and oracle see byte-identical payloads).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "QTensor",
+    "FP8_MAX",
+    "INT8_QMAX",
+    "dequantize",
+    "quantize",
+    "quant_itemsize",
+]
+
+INT8_QMAX = 127.0
+FP8_MAX = 448.0          # float8_e4m3fn largest normal
+
+
+def _qdtype(dtype: str):
+    if dtype == "int8":
+        return jnp.int8
+    if dtype == "fp8":
+        return jnp.float8_e4m3fn
+    raise ValueError(f"quantized dtype {dtype!r} not in ('int8', 'fp8')")
+
+
+def quant_itemsize(dtype: str) -> int:
+    """Payload bytes per element — both formats are 1 byte; the sidecar
+    adds 4 bytes per block (the capacity arithmetic the KV pool and the
+    bytes-saved counters share)."""
+    _qdtype(dtype)
+    return 1
+
+
+class QTensor(NamedTuple):
+    """A quantized payload + its per-block fp32 scale sidecar.
+
+    ``q`` has the source array's shape; ``scale`` has the same shape
+    with the block axis divided by the block size (ceil). The block
+    axis and size are CALL metadata (the consumer resolved them — e.g.
+    the matmul kernel's ``tile_k``), not pytree state, exactly like the
+    ragged run metadata of ops/paged_attention.py."""
+
+    q: jax.Array
+    scale: jax.Array
+
+
+def quantize(x, *, block: int, axis: int = -1,
+             dtype: str = "int8") -> QTensor:
+    """Blockwise-quantize ``x`` along ``axis`` with per-block absmax
+    scales (module doc for the error model). ``block`` need not divide
+    the axis extent — the ragged tail is padded with exact zeros
+    internally and the returned payload keeps ``x``'s shape."""
+    qdt = _qdtype(dtype)
+    qmax = INT8_QMAX if dtype == "int8" else FP8_MAX
+    axis = axis % x.ndim
+    n = x.shape[axis]
+    block = max(1, min(int(block), n))
+    xm = jnp.moveaxis(x.astype(jnp.float32), axis, -1)
+    pad = (-n) % block
+    if pad:
+        xm = jnp.concatenate(
+            [xm, jnp.zeros(xm.shape[:-1] + (pad,), jnp.float32)], axis=-1)
+    nb = xm.shape[-1] // block
+    rows = xm.reshape(xm.shape[:-1] + (nb, block))
+    amax = jnp.max(jnp.abs(rows), axis=-1)
+    scale = jnp.where(amax > 0, amax, 1.0) / qmax          # [..., nb]
+    scaled = rows / scale[..., None]
+    if dtype == "int8":
+        q = jnp.clip(jnp.round(scaled), -INT8_QMAX, INT8_QMAX)
+    else:
+        q = jnp.clip(scaled, -FP8_MAX, FP8_MAX)
+    q = q.astype(qdt).reshape(xm.shape)
+    if pad:
+        q = q[..., :n]
+    return QTensor(q=jnp.moveaxis(q, -1, axis),
+                   scale=jnp.moveaxis(scale, -1, axis))
+
+
+def dequantize(qt: QTensor, *, block: int, axis: int = -1,
+               out_dtype=jnp.float32):
+    """Invert :func:`quantize` up to the documented roundtrip error:
+    each payload element multiplies its block's scale. ``block``/
+    ``axis`` must be the values the payload was quantized with (call
+    metadata, not stored — the consumer that resolved the tile owns
+    them)."""
+    q, scale = qt
+    axis = axis % q.ndim
+    n = q.shape[axis]
+    block = max(1, min(int(block), n))
+    qm = jnp.moveaxis(q, axis, -1).astype(jnp.float32)
+    sm = jnp.moveaxis(scale, axis, -1)
+    idx = jnp.arange(n) // block                            # [n] -> block id
+    out = qm * sm[..., idx]
+    return jnp.moveaxis(out, -1, axis).astype(out_dtype)

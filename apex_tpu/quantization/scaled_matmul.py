@@ -1,0 +1,318 @@
+"""Blockwise-scaled low-precision matmul (``quant_matmul``) — Pallas
+kernel family + dequantize-einsum oracle.
+
+Apex's reason to exist is mixed precision; this is the compute half of
+the end-to-end low-precision story (ROADMAP item 3 — the wire half
+shipped as ``parallel/quantized_collectives.py``). The scheme is the
+same one the collectives proved: quantize both operands BLOCKWISE along
+the contraction axis (per-tile absmax scales held as a fp32 SIDECAR
+array, qtensor.py), run the narrow matmul on the MXU, and apply the
+scale outer product per k-block while accumulating in fp32:
+
+    out[i, j] = sum_kb  ( lq[i, kb·K:...] · rq[kb·K:..., j] )    (int)
+                * ls[i, kb] * rs[kb, j]                          (fp32)
+
+which equals the dequantize-einsum exactly in real arithmetic (the
+scales are constant within a block), so ``quant_matmul_ref`` — the jnp
+dequantize-einsum over the SAME quantized payloads — is both the
+fallback and the test oracle; kernel-vs-oracle differences are fp32
+accumulation-order noise only, and the QUANTIZATION error itself is the
+qtensor.py model (int8: elementwise <= absmax_block/254 per operand).
+
+Two operand widths, one kernel body:
+
+* ``int8`` — int8 x int8 MXU products accumulated in int32 per k-tile
+  (exact), scaled into the fp32 accumulator.
+* ``fp8`` — ``float8_e4m3fn`` payload; the kernel body upcasts the f8
+  tiles to fp32 before the dot (CPU/interpret emulation; on an fp8-MXU
+  generation the upcast drops out — the PAYLOAD layout and scale
+  sidecar are already the native format).
+
+Backward (``jax.custom_vjp``): dlhs = dout @ rhs^T and
+drhs = lhs^T @ dout, computed either at the SAME quantized width
+(``bwd_quant=True`` — both cotangents re-quantize along their own
+contraction axes) or in plain fp32 (the default; amp policy
+``matmul_quant_bwd`` picks, docs/quantization.md).
+
+Tunables (``quant_matmul`` family, tuning/registry.py): ``tile_m``
+(output rows per grid step, sublane multiple of 8 — int8 tiles
+natively want 32), ``tile_n`` (output columns, lane multiple of 128)
+and ``tile_k`` (contraction elements per k-step — ALSO the
+quantization block size, so the tuner trades scale resolution against
+MXU occupancy), resolved env (APEX_TPU_QUANT_TILE_M /
+APEX_TPU_QUANT_TILE_N / APEX_TPU_QUANT_TILE_K) > tune cache > cost
+model, the PR-1 order; ``autotune.sweep_quant`` sweeps exactly this
+space and the sanitizer (analysis/sanitizer.py) validates every
+candidate's geometry statically.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from apex_tpu.observability import inc_counter
+from apex_tpu.ops._utils import default_use_pallas, env_flag, env_int, \
+    pallas_interpret
+from apex_tpu.quantization.qtensor import QTensor, quantize
+
+try:
+    from jax.experimental.pallas import tpu as _pltpu
+except Exception:  # pragma: no cover
+    _pltpu = None
+
+_HIGHEST = jax.lax.Precision.HIGHEST
+
+__all__ = ["quant_matmul", "quant_matmul_ref", "quantized_operands",
+           "matmul_bytes_saved"]
+
+
+def _ceil(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _pad128(n: int) -> int:
+    return max(128, _ceil(n, 128) * 128)
+
+
+def _quant_params(m: int, k: int, n: int, dtype, qdtype: str) -> dict:
+    """Resolved {"tile_m", "tile_n", "tile_k", "backend"} for one call:
+    env wins outright, then the tune cache for this shape class, then
+    the cost model — the same three-layer order as every PR-1 family."""
+    from apex_tpu import tuning
+
+    cfg = tuning.quant_matmul_config(m, k, n, dtype, qdtype)
+    tm = env_int("APEX_TPU_QUANT_TILE_M", quantum=8)
+    tn = env_int("APEX_TPU_QUANT_TILE_N", quantum=128)
+    tk = env_int("APEX_TPU_QUANT_TILE_K", quantum=128)
+    return {
+        "tile_m": tm if tm is not None else cfg["tile_m"],
+        "tile_n": tn if tn is not None else cfg["tile_n"],
+        "tile_k": tk if tk is not None else cfg["tile_k"],
+        "backend": cfg["backend"],
+    }
+
+
+def _auto_use_kernel(m: int, k: int, n: int, dtype, qdtype: str) -> bool:
+    """Backend decision for auto mode (use_pallas=None): preflight
+    registry and APEX_TPU_USE_PALLAS first (ops/_utils), then a pinned
+    cache entry or the cost-model row threshold may route the class to
+    the dequantize-einsum oracle; env=1 beats both (env > cache >
+    model)."""
+    if not default_use_pallas("quant_matmul"):
+        return False
+    if env_flag("APEX_TPU_USE_PALLAS"):
+        return True
+    return _quant_params(m, k, n, dtype, qdtype)["backend"] != "jnp"
+
+
+def matmul_bytes_saved(m: int, k: int, n: int, itemsize: int,
+                       tile_k: int) -> int:
+    """Analytic operand-bytes saving of ONE quantized matmul vs reading
+    both operands at their original width: narrow payloads cost 1 B/elt
+    and the sidecar adds one fp32 scale per (row, k-block). The
+    ``quant/matmul_bytes_saved`` counter and its test share this
+    formula — one definition, no drift (the quantized_wire_bytes
+    discipline)."""
+    nk = _ceil(int(k), int(tile_k))
+    full = (m * k + k * n) * itemsize
+    quant = (m * k + k * n) * 1 + (m * nk + nk * n) * 4
+    return max(0, full - quant)
+
+
+# ---------------------------------------------------------------------------
+# quantized-operand prologue (shared by kernel and oracle)
+# ---------------------------------------------------------------------------
+
+def quantized_operands(lhs, rhs, tile_k: int, qdtype: str):
+    """Pad ``lhs [m, k]`` / ``rhs [k, n]`` to the k-tile grid and
+    quantize both along k with block = tile_k. Kernel and oracle both
+    consume THIS output, so the quantization error is identical on
+    either path and parity tests measure only accumulation order.
+    Returns (lhs_qt, rhs_qt, k_pad)."""
+    m, k = lhs.shape
+    _, n = rhs.shape
+    k_pad = _ceil(max(_pad128(k), 1), tile_k) * tile_k
+    lhs_p = jnp.pad(lhs.astype(jnp.float32), ((0, 0), (0, k_pad - k)))
+    rhs_p = jnp.pad(rhs.astype(jnp.float32), ((0, k_pad - k), (0, 0)))
+    lqt = quantize(lhs_p, block=tile_k, axis=1, dtype=qdtype)
+    rqt = quantize(rhs_p, block=tile_k, axis=0, dtype=qdtype)
+    return lqt, rqt, k_pad
+
+
+# ---------------------------------------------------------------------------
+# jnp reference (oracle + fallback)
+# ---------------------------------------------------------------------------
+
+def quant_matmul_ref(lqt: QTensor, rqt: QTensor, tile_k: int,
+                     out_dtype=jnp.float32):
+    """Dequantize-einsum oracle over the quantized payloads: per
+    k-block, the integer partial products scale by the fp32 outer
+    product of the block scales — the memory-bound unfused path the
+    kernel exists to avoid, and the parity target of the fuzz suite."""
+    m, k_pad = lqt.q.shape
+    _, n = rqt.q.shape
+    nk = k_pad // tile_k
+    lq = lqt.q.astype(jnp.float32).reshape(m, nk, tile_k)
+    rq = rqt.q.astype(jnp.float32).reshape(nk, tile_k, n)
+    part = jnp.einsum("mbk,bkn->bmn", lq, rq, precision=_HIGHEST)
+    out = jnp.einsum("bmn,mb,bn->mn", part, lqt.scale, rqt.scale,
+                     precision=_HIGHEST)
+    return out.astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+def _qmm_kernel(lq_ref, ls_ref, rq_ref, rs_ref, out_ref, acc_ref, *, nk,
+                int_payload: bool):
+    """Grid (m-tile i, n-tile j, k-block kb) with kb minor: consecutive
+    kb steps revisit one output tile, accumulating the scaled partial
+    products in fp32 VMEM scratch; the last k-block flushes."""
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    if int_payload:
+        part = jax.lax.dot_general(
+            lq_ref[...], rq_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32).astype(jnp.float32)
+    else:
+        # fp8 emulation: upcast the f8 tiles; on an fp8-MXU device this
+        # cast drops out of the lowering (the payload is already native)
+        part = jax.lax.dot_general(
+            lq_ref[...].astype(jnp.float32),
+            rq_ref[...].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    acc_ref[...] += part * (ls_ref[...] * rs_ref[...])
+
+    @pl.when(kb == nk - 1)
+    def _emit():
+        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
+def _qmm_pallas(lqt: QTensor, rqt: QTensor, m: int, n: int, tile_m: int,
+                tile_n: int, tile_k: int, out_dtype, int_payload: bool):
+    k_pad = lqt.q.shape[1]
+    nk = k_pad // tile_k
+    n_pad128 = _pad128(n)
+    tile_n = min(tile_n, n_pad128)
+    # the grid floor-divides: pad outputs to tile multiples or trailing
+    # blocks would never be visited (= garbage out), same rule as gmm
+    m_pad = _ceil(max(m, 1), tile_m) * tile_m
+    n_pad = _ceil(n_pad128, tile_n) * tile_n
+    nm, nn = m_pad // tile_m, n_pad // tile_n
+
+    lq = jnp.pad(lqt.q, ((0, m_pad - m), (0, 0)))
+    ls = jnp.pad(lqt.scale, ((0, m_pad - m), (0, 0)))       # [m_pad, nk]
+    rq = jnp.pad(rqt.q, ((0, 0), (0, n_pad - n)))
+    rs = jnp.pad(rqt.scale, ((0, 0), (0, n_pad - n)))       # [nk, n_pad]
+
+    out = pl.pallas_call(
+        functools.partial(_qmm_kernel, nk=nk, int_payload=int_payload),
+        grid=(nm, nn, nk),
+        in_specs=[
+            pl.BlockSpec((tile_m, tile_k), lambda i, j, kb: (i, kb)),
+            pl.BlockSpec((tile_m, 1), lambda i, j, kb: (i, kb)),
+            pl.BlockSpec((tile_k, tile_n), lambda i, j, kb: (kb, j)),
+            pl.BlockSpec((1, tile_n), lambda i, j, kb: (kb, j)),
+        ],
+        out_specs=pl.BlockSpec((tile_m, tile_n), lambda i, j, kb: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, n_pad), out_dtype),
+        scratch_shapes=[_pltpu.VMEM((tile_m, tile_n), jnp.float32)],
+        interpret=pallas_interpret(),
+    )(lq, ls, rq, rs)
+    return out[:m, :n]
+
+
+# ---------------------------------------------------------------------------
+# differentiable core (custom_vjp) + public API
+# ---------------------------------------------------------------------------
+
+def _qmm_dispatch(lhs, rhs, qdtype, out_dtype, use_pallas):
+    m, k = lhs.shape
+    _, n = rhs.shape
+    p = _quant_params(m, k, n, lhs.dtype, qdtype)
+    tile_k = p["tile_k"]
+    use = use_pallas
+    if use is None:
+        use = _auto_use_kernel(m, k, n, lhs.dtype, qdtype)
+    # trace-time analytic accounting, the comms/bytes_on_wire idiom:
+    # counts once per trace, reporting the per-call operand saving
+    inc_counter("quant/matmul_bytes_saved",
+                matmul_bytes_saved(m, k, n,
+                                   jnp.dtype(lhs.dtype).itemsize, tile_k),
+                qdtype=qdtype)
+    lqt, rqt, _ = quantized_operands(lhs, rhs, tile_k, qdtype)
+    out_dtype = out_dtype or lhs.dtype
+    if not use or _pltpu is None:
+        return quant_matmul_ref(lqt, rqt, tile_k, out_dtype=out_dtype)
+    return _qmm_pallas(lqt, rqt, m, n, p["tile_m"], p["tile_n"], tile_k,
+                       out_dtype, int_payload=(qdtype == "int8"))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _qmm_core(lhs, rhs, qdtype, bwd_quant, out_dtype, use_pallas):
+    return _qmm_dispatch(lhs, rhs, qdtype, out_dtype, use_pallas)
+
+
+def _qmm_core_fwd(lhs, rhs, qdtype, bwd_quant, out_dtype, use_pallas):
+    out = _qmm_dispatch(lhs, rhs, qdtype, out_dtype, use_pallas)
+    return out, (lhs, rhs)
+
+
+def _qmm_core_bwd(qdtype, bwd_quant, out_dtype, use_pallas, res, dout):
+    lhs, rhs = res
+    del out_dtype                    # cotangent dtypes follow the primals
+    if bwd_quant:
+        # bwd at the SAME quantized width: each cotangent re-quantizes
+        # along its own contraction axis (n for dlhs, m for drhs)
+        dlhs = _qmm_dispatch(dout, rhs.T, qdtype, lhs.dtype, use_pallas)
+        drhs = _qmm_dispatch(lhs.T, dout, qdtype, rhs.dtype, use_pallas)
+    else:
+        d32 = dout.astype(jnp.float32)
+        dlhs = jnp.matmul(d32, rhs.astype(jnp.float32).T,
+                          precision=_HIGHEST).astype(lhs.dtype)
+        drhs = jnp.matmul(lhs.astype(jnp.float32).T, d32,
+                          precision=_HIGHEST).astype(rhs.dtype)
+    return dlhs, drhs
+
+
+_qmm_core.defvjp(_qmm_core_fwd, _qmm_core_bwd)
+
+
+def quant_matmul(lhs, rhs, *, dtype: str = "int8", bwd_quant: bool = False,
+                 out_dtype=None, use_pallas=None):
+    """Blockwise-scaled low-precision matmul ``lhs @ rhs``.
+
+    ``lhs``: ``[..., m, k]`` float (leading batch dims collapse into
+    rows); ``rhs``: ``[k, n]`` float. Both operands quantize to
+    ``dtype`` ("int8" | "fp8") with per-(row, k-tile) fp32 scales;
+    accumulation is fp32 on the MXU. Returns ``[..., m, n]`` in
+    ``out_dtype`` (default lhs.dtype). Differentiable in both operands
+    (custom_vjp: cotangents at the same quantized width when
+    ``bwd_quant``, plain fp32 otherwise). The quantization error is the
+    qtensor.py model per operand; ``quant_matmul_ref`` over the same
+    payloads is the oracle and the auto-mode fallback.
+    """
+    if lhs.ndim < 2 or rhs.ndim != 2:
+        raise ValueError(f"quant_matmul expects lhs [..., m, k], "
+                         f"rhs [k, n]: got {lhs.shape} / {rhs.shape}")
+    if lhs.shape[-1] != rhs.shape[0]:
+        raise ValueError(f"contraction mismatch: lhs k={lhs.shape[-1]} vs "
+                         f"rhs k={rhs.shape[0]}")
+    from apex_tpu.quantization.qtensor import _qdtype
+    _qdtype(dtype)                             # validate the width token
+    lead = lhs.shape[:-2]
+    flat = lhs.reshape((-1, lhs.shape[-1])) if lead else lhs
+    out = _qmm_core(flat, rhs, dtype, bool(bwd_quant), out_dtype,
+                    use_pallas)
+    return out.reshape(lead + (lhs.shape[-2], rhs.shape[1])) if lead \
+        else out

@@ -50,6 +50,7 @@ from apex_tpu.serving.fleet import (  # noqa: F401
 from apex_tpu.serving.kv_cache import (  # noqa: F401
     PagedKVCache,
     PrefixIndex,
+    QuantPagedKVCache,
     alloc_decode_blocks,
     allocate_slot,
     append_layer,
@@ -61,7 +62,12 @@ from apex_tpu.serving.kv_cache import (  # noqa: F401
     free_block_count,
     free_slot,
     grow_slots,
+    is_quantized,
+    kv_quantize,
     paged_kv_cache,
+    quant_cache_pspecs,
+    quantized_kv_cache,
+    quantized_pool_blocks,
     release_blocks,
     retain_blocks,
     share_prefix,
@@ -79,11 +85,13 @@ from apex_tpu.serving.speculative import (  # noqa: F401
 __all__ = [
     "BATCH", "Drafter", "DraftModelDrafter", "FaultPlan",
     "InjectedReplicaFault", "LATENCY", "NgramDrafter", "PagedKVCache",
-    "PrefixIndex", "Replica", "ReplicaSignals", "Request", "Router",
-    "Scheduler", "ServingConfig", "ServingEngine", "ServingSession",
-    "StubDrafter", "alloc_decode_blocks", "allocate_slot",
-    "append_layer", "blocks_needed", "cache_pspecs", "check_invariants",
-    "cow_append", "extend_slots", "free_block_count", "free_slot",
-    "greedy_reference", "grow_slots", "paged_kv_cache", "release_blocks",
+    "PrefixIndex", "QuantPagedKVCache", "Replica", "ReplicaSignals",
+    "Request", "Router", "Scheduler", "ServingConfig", "ServingEngine",
+    "ServingSession", "StubDrafter", "alloc_decode_blocks",
+    "allocate_slot", "append_layer", "blocks_needed", "cache_pspecs",
+    "check_invariants", "cow_append", "extend_slots", "free_block_count",
+    "free_slot", "greedy_reference", "grow_slots", "is_quantized",
+    "kv_quantize", "paged_kv_cache", "quant_cache_pspecs",
+    "quantized_kv_cache", "quantized_pool_blocks", "release_blocks",
     "retain_blocks", "share_prefix", "truncate_slots", "write_prefill",
 ]

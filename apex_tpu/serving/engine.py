@@ -65,8 +65,14 @@ size, default 16), ``APEX_TPU_SERVING_MAX_SLOTS`` (slot count, default
 8), ``APEX_TPU_SERVING_CHUNK_TOKENS`` (per-step token budget),
 ``APEX_TPU_PREFIX_CACHE`` (0 disables prefix sharing),
 ``APEX_TPU_SERVING_SPEC`` (1 enables speculative decoding, default
-off), ``APEX_TPU_SERVING_SPEC_K`` (max draft depth, default 4) —
-defaults for ServingConfig, explicit arguments win.
+off), ``APEX_TPU_SERVING_SPEC_K`` (max draft depth, default 4),
+``APEX_TPU_SERVING_KV_INT8`` (1 quantizes the KV pool to int8 with
+per-(token, head) fp32 scales — SAME pool bytes, more blocks
+(``ServingConfig.pool_blocks``: ~2-4x vs an fp32 cache dtype, ~1.8x vs
+bf16 — the sidecar's 4 B/row fixed cost bites harder against a 2 B
+payload), greedy output token-matched against the full-width cache by
+the quant leg/bench rung; default off = byte-for-byte today's cache
+path) — defaults for ServingConfig, explicit arguments win.
 """
 
 from __future__ import annotations
@@ -145,6 +151,7 @@ class ServingConfig:
     prefix_cache: Optional[bool] = None     # APEX_TPU_PREFIX_CACHE | on
     spec: Optional[bool] = None             # APEX_TPU_SERVING_SPEC | off
     spec_k: Optional[int] = None            # APEX_TPU_SERVING_SPEC_K | 4
+    kv_int8: Optional[bool] = None          # APEX_TPU_SERVING_KV_INT8 | off
 
     def __post_init__(self):
         s = object.__setattr__
@@ -181,12 +188,30 @@ class ServingConfig:
             raise ValueError(
                 f"spec_k {self.spec_k} must be >= 1 (set spec=False to "
                 f"disable speculation)")
+        if self.kv_int8 is None:
+            # default OFF: unset leaves the engine byte-for-byte on the
+            # full-width cache path (docs/quantization.md)
+            s(self, "kv_int8", bool(env_flag("APEX_TPU_SERVING_KV_INT8",
+                                             default=False)))
         if self.dtype is None:
             s(self, "dtype", self.model.dtype)
 
     @property
     def max_blocks_per_seq(self) -> int:
         return int(math.ceil(self.max_seq_len / self.block_size))
+
+    @property
+    def pool_blocks(self) -> int:
+        """The pool's ACTUAL block count: ``num_blocks`` full-width, or
+        the int8 variant's count in the SAME byte budget
+        (kv_cache.quantized_pool_blocks — the capacity doubling that is
+        the point of ``APEX_TPU_SERVING_KV_INT8``). The scheduler's
+        watermark, the occupancy gauges and the router's placement
+        signals all see THIS count."""
+        if not self.kv_int8:
+            return self.num_blocks
+        return kc.quantized_pool_blocks(self.num_blocks,
+                                        self.model.head_dim, self.dtype)
 
     @property
     def n_kv_heads(self) -> int:
@@ -315,8 +340,16 @@ def _step_body(params, cache, tokens, query_start, query_len, *, cfg, scfg):
             q = _rope_at(q, *rope_rows)
             k = _rope_at(k, *rope_rows)
         cache = kc.append_layer(cache, li, row_blk, row_off, k, v)
+        # the int8 pool's per-(token, head) scale sidecars ride into the
+        # kernel for fetch-time dequantization; a full-width cache is
+        # byte-for-byte the pre-quantization program (the branch is
+        # trace-time python on the cache's static pytree type)
+        scales = ({"k_scale": cache.k_scale[li],
+                   "v_scale": cache.v_scale[li]}
+                  if kc.is_quantized(cache) else {})
         o = ragged_paged_attention(q, cache.k_pool[li], cache.v_pool[li],
-                                   cache.block_tables, qs, ql, kl)
+                                   cache.block_tables, qs, ql, kl,
+                                   **scales)
         o = o.reshape(1, tq, -1)                       # [1, Tq, nh*d]
         o = row_parallel_linear(
             o, lp["proj"]["kernel"], lp["proj"]["bias"], axis=ax,
@@ -388,7 +421,8 @@ class ServingEngine:
                 "(set spec=True or APEX_TPU_SERVING_SPEC=1)")
 
         pspec = param_specs(cfg)
-        cspec = kc.cache_pspecs(tp_axis="model")
+        cspec = (kc.quant_cache_pspecs(tp_axis="model") if scfg.kv_int8
+                 else kc.cache_pspecs(tp_axis="model"))
         opts = {"cfg": cfg, "scfg": {"tp": tp}}
         counts = self.trace_counts
 
@@ -452,6 +486,14 @@ class ServingEngine:
 
     def fresh_cache(self) -> kc.PagedKVCache:
         s = self.scfg
+        if s.kv_int8:
+            # SAME pool bytes as the full-width cache, MORE blocks —
+            # the concurrent-slot capacity lever (scfg.pool_blocks)
+            return kc.quantized_kv_cache(
+                layers=self.cfg.layers, num_blocks=s.pool_blocks,
+                block_size=s.block_size, n_kv_heads=s.n_kv_heads,
+                head_dim=self.cfg.head_dim, max_slots=s.max_slots,
+                max_blocks_per_seq=s.max_blocks_per_seq)
         return kc.paged_kv_cache(
             layers=self.cfg.layers, num_blocks=s.num_blocks,
             block_size=s.block_size, n_kv_heads=s.n_kv_heads,
@@ -560,7 +602,7 @@ class ServingSession:
         self.cache = cache
         held = len(eng.index) if eng.index is not None else 0
         self.sched = Scheduler(
-            max_slots=s.max_slots, num_blocks=s.num_blocks - held,
+            max_slots=s.max_slots, num_blocks=s.pool_blocks - held,
             block_size=s.block_size,
             max_blocks_per_seq=s.max_blocks_per_seq,
             watermark=s.watermark, chunk_tokens=s.chunk_tokens,
@@ -601,10 +643,21 @@ class ServingSession:
                           "serving/spec_accepted_tokens"]
             for name in names:
                 reg.counter(name).inc(0, replica=eng.replica)
-            set_gauge("serving/kv_blocks_total", s.num_blocks,
+            set_gauge("serving/kv_blocks_total", s.pool_blocks,
                       replica=eng.replica)
             set_gauge("serving/kv_watermark", self.sched.watermark,
                       replica=eng.replica)
+            if s.kv_int8:
+                # the quantized pool's capacity story, exported even on
+                # a quiet run (docs/quantization.md): payload + sidecar
+                # bytes per pool block x the doubled block count
+                row = s.block_size * s.n_kv_heads
+                blk = 2 * row * (self.eng.cfg.head_dim + 4)
+                set_gauge("quant/kv_pool_bytes",
+                          self.eng.cfg.layers * s.pool_blocks * blk,
+                          replica=eng.replica)
+                set_gauge("quant/kv_pool_blocks", s.pool_blocks,
+                          replica=eng.replica)
 
     # -- intake ------------------------------------------------------
     def add(self, req: Request) -> None:
@@ -643,7 +696,7 @@ class ServingSession:
             "running": len(self.sched.running),
             "free_blocks": self.sched.free_blocks,
             "kv_occupancy":
-                1.0 - (self.sched.free_blocks + idx) / s.num_blocks,
+                1.0 - (self.sched.free_blocks + idx) / s.pool_blocks,
             "est_work_tokens": self.sched.pending_work_tokens(),
         }
 
@@ -947,7 +1000,7 @@ class ServingSession:
         set_gauge("serving/kv_occupancy",
                   1.0 - (sched.free_blocks
                          + (len(eng.index) if eng.index else 0))
-                  / s.num_blocks, replica=rep)
+                  / s.pool_blocks, replica=rep)
         set_gauge("serving/active_slots", len(sched.running), replica=rep)
         self.step = step + 1
 

@@ -118,6 +118,119 @@ def paged_kv_cache(layers: int, num_blocks: int, block_size: int,
     )
 
 
+# ---------------------------------------------------------------------------
+# int8 quantized pool variant (docs/quantization.md "KV layout")
+# ---------------------------------------------------------------------------
+
+class QuantPagedKVCache(NamedTuple):
+    """The int8 pool variant (``APEX_TPU_SERVING_KV_INT8=1``): K/V
+    payloads are int8 with a PER-(token, head) fp32 absmax scale riding
+    as a sidecar pool of the same block geometry — the
+    quantization/qtensor.py scheme with the block axis = head_dim, so
+    every write quantizes exactly the rows it lands (append stays a
+    scatter) and ops/paged_attention.py dequantizes pages IN KERNEL at
+    fetch time. All table/refcount machinery (share_prefix, cow_append,
+    extend/grow/truncate_slots, free/retain/release, check_invariants,
+    the PrefixIndex) is FIELD-NAME generic over this NamedTuple —
+    quantization changes pool bytes, never the sharing semantics."""
+
+    k_pool: jax.Array       # [L, N, bs, Hkv, D] int8
+    v_pool: jax.Array       # [L, N, bs, Hkv, D] int8
+    k_scale: jax.Array      # [L, N, bs, Hkv] fp32 absmax/127 per row
+    v_scale: jax.Array      # [L, N, bs, Hkv] fp32
+    block_tables: jax.Array  # [max_slots, max_blocks_per_seq] int32
+    n_blocks: jax.Array     # [max_slots] int32
+    seq_lens: jax.Array     # [max_slots] int32
+    refcount: jax.Array     # [N] int32 (0 = free)
+
+    # -- static views (same layout as PagedKVCache) ------------------
+    @property
+    def num_blocks(self) -> int:
+        return self.k_pool.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k_pool.shape[2]
+
+    @property
+    def max_slots(self) -> int:
+        return self.block_tables.shape[0]
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return self.block_tables.shape[1]
+
+
+def quantized_kv_cache(layers: int, num_blocks: int, block_size: int,
+                       n_kv_heads: int, head_dim: int, max_slots: int,
+                       max_blocks_per_seq: Optional[int] = None
+                       ) -> QuantPagedKVCache:
+    """A fresh int8 cache: zero payloads AND zero scales (dequantized
+    unwritten rows read as exact 0, matching the fp pool's zeros)."""
+    if max_blocks_per_seq is None:
+        max_blocks_per_seq = num_blocks
+    shape = (layers, num_blocks, block_size, n_kv_heads, head_dim)
+    return QuantPagedKVCache(
+        k_pool=jnp.zeros(shape, jnp.int8),
+        v_pool=jnp.zeros(shape, jnp.int8),
+        k_scale=jnp.zeros(shape[:-1], jnp.float32),
+        v_scale=jnp.zeros(shape[:-1], jnp.float32),
+        block_tables=jnp.zeros((max_slots, max_blocks_per_seq), jnp.int32),
+        n_blocks=jnp.zeros((max_slots,), jnp.int32),
+        seq_lens=jnp.zeros((max_slots,), jnp.int32),
+        refcount=jnp.zeros((num_blocks,), jnp.int32),
+    )
+
+
+def is_quantized(cache) -> bool:
+    """Static (trace-time python) test for the int8 pool variant."""
+    return isinstance(cache, QuantPagedKVCache)
+
+
+def quant_cache_pspecs(tp_axis: Optional[str] = "model",
+                       data_axis: Optional[str] = None) -> QuantPagedKVCache:
+    """``cache_pspecs`` for the int8 variant: scale pools shard exactly
+    like their payload pools minus the head_dim axis (KV heads on the
+    TP axis, blocks optionally on data)."""
+    base = cache_pspecs(tp_axis, data_axis)
+    return QuantPagedKVCache(
+        k_pool=base.k_pool,
+        v_pool=base.v_pool,
+        k_scale=P(None, data_axis, None, tp_axis),
+        v_scale=P(None, data_axis, None, tp_axis),
+        block_tables=base.block_tables,
+        n_blocks=base.n_blocks,
+        seq_lens=base.seq_lens,
+        refcount=base.refcount,
+    )
+
+
+def quantized_pool_blocks(num_blocks: int, head_dim: int, dtype) -> int:
+    """Blocks the int8 pool holds in the SAME byte budget as a
+    ``num_blocks`` pool of ``dtype``: per (token, head) row the fp pool
+    costs ``head_dim * itemsize`` bytes and the int8 pool costs
+    ``head_dim + 4`` (payload + one fp32 scale); block_size, kv heads
+    and layers scale both sides identically and cancel. This is the
+    capacity lever behind ``APEX_TPU_SERVING_KV_INT8`` — an fp32 pool
+    at head_dim 64 yields 3.7x the blocks, i.e. 3.7x the concurrent
+    sequences the watermark admission path can hold resident."""
+    fp_row = int(head_dim) * jnp.dtype(dtype).itemsize
+    q_row = int(head_dim) + 4
+    return max(int(num_blocks), (int(num_blocks) * fp_row) // q_row)
+
+
+def kv_quantize(x):
+    """Quantize K/V rows ``[..., D]`` to (int8 payload, fp32 scale) with
+    one absmax scale per row — exactly ``quantization.quantize`` with
+    block = head_dim (error <= absmax_row / 254 per element), THROUGH
+    that one definition so the KV write path can never diverge from the
+    library's error model. Shared by write_prefill and append_layer."""
+    from apex_tpu.quantization import quantize
+
+    qt = quantize(x, block=x.shape[-1], axis=-1)
+    return qt.q, qt.scale[..., 0]
+
+
 def cache_pspecs(tp_axis: Optional[str] = "model",
                  data_axis: Optional[str] = None) -> PagedKVCache:
     """PartitionSpecs for shard_map in/out specs: KV heads on the TP axis
@@ -255,14 +368,25 @@ def write_prefill(cache: PagedKVCache, slot, k, v, length) -> PagedKVCache:
     valid = pos < length
     blocks = jnp.where(valid, blocks, cache.num_blocks)       # drop target
     offs = pos % bs
-    return cache._replace(
-        k_pool=cache.k_pool.at[:, blocks, offs].set(
-            k.astype(cache.k_pool.dtype), mode="drop"),
-        v_pool=cache.v_pool.at[:, blocks, offs].set(
-            v.astype(cache.v_pool.dtype), mode="drop"),
-        seq_lens=cache.seq_lens.at[slot].set(
-            jnp.asarray(length, jnp.int32)),
-    )
+    new = {"seq_lens": cache.seq_lens.at[slot].set(
+        jnp.asarray(length, jnp.int32))}
+    if is_quantized(cache):
+        kq, ks = kv_quantize(k)
+        vq, vs = kv_quantize(v)
+        new.update(
+            k_pool=cache.k_pool.at[:, blocks, offs].set(kq, mode="drop"),
+            v_pool=cache.v_pool.at[:, blocks, offs].set(vq, mode="drop"),
+            k_scale=cache.k_scale.at[:, blocks, offs].set(ks, mode="drop"),
+            v_scale=cache.v_scale.at[:, blocks, offs].set(vs, mode="drop"),
+        )
+    else:
+        new.update(
+            k_pool=cache.k_pool.at[:, blocks, offs].set(
+                k.astype(cache.k_pool.dtype), mode="drop"),
+            v_pool=cache.v_pool.at[:, blocks, offs].set(
+                v.astype(cache.v_pool.dtype), mode="drop"),
+        )
+    return cache._replace(**new)
 
 
 # ---------------------------------------------------------------------------
@@ -307,22 +431,26 @@ def cow_append(cache: PagedKVCache, active) -> PagedKVCache:
         body, (cache.refcount, cache.block_tables),
         jnp.arange(cache.max_slots))
 
+    # the quantized variant's scale sidecars are pools of the same block
+    # geometry (axis 1 = pool block), so COW copies them alongside
+    pool_fields = tuple(f for f in ("k_pool", "v_pool",
+                                    "k_scale", "v_scale")
+                        if f in cache._fields)
+
     def _copy(pools):
-        kp, vp = pools
-        return (kp.at[:, dst].set(kp[:, src_c], mode="drop"),
-                vp.at[:, dst].set(vp[:, src_c], mode="drop"))
+        return tuple(p.at[:, dst].set(p[:, src_c], mode="drop")
+                     for p in pools)
 
     # the page gather+scatter is the expensive part and the common case
     # is "no COW anywhere" — gate it at RUNTIME so the steady-state step
     # pays one predicate, not [L, S, bs, Hkv, D] of HBM traffic
-    k_pool, v_pool = jax.lax.cond(
+    pools = jax.lax.cond(
         jnp.any(shared), _copy, lambda pools: pools,
-        (cache.k_pool, cache.v_pool))
+        tuple(getattr(cache, f) for f in pool_fields))
     return cache._replace(
-        k_pool=k_pool,
-        v_pool=v_pool,
         block_tables=tables,
         refcount=rc,
+        **dict(zip(pool_fields, pools)),
     )
 
 
@@ -473,7 +601,22 @@ def append_layer(cache: PagedKVCache, layer: int, block_ids, offsets,
     [n, n_kv_heads, head_dim] with block_ids/offsets [n] — one row per
     decode slot (alloc_decode_blocks) OR per packed ragged query row
     (the unified serving step); rows whose block_id is the drop target
-    write nothing."""
+    write nothing. On the int8 variant each row quantizes at its own
+    per-(token, head) absmax scale (kv_quantize) and the scale sidecar
+    scatters with the payload."""
+    if is_quantized(cache):
+        kq, ks = kv_quantize(k_tok)
+        vq, vs = kv_quantize(v_tok)
+        return cache._replace(
+            k_pool=cache.k_pool.at[layer, block_ids, offsets].set(
+                kq, mode="drop"),
+            v_pool=cache.v_pool.at[layer, block_ids, offsets].set(
+                vq, mode="drop"),
+            k_scale=cache.k_scale.at[layer, block_ids, offsets].set(
+                ks, mode="drop"),
+            v_scale=cache.v_scale.at[layer, block_ids, offsets].set(
+                vs, mode="drop"),
+        )
     return cache._replace(
         k_pool=cache.k_pool.at[layer, block_ids, offsets].set(
             k_tok.astype(cache.k_pool.dtype), mode="drop"),

@@ -39,6 +39,7 @@ from apex_tpu.tuning.shape_class import (
     moe_key,
     optim_key,
     paged_key,
+    quant_key,
     softmax_key,
 )
 
@@ -46,9 +47,10 @@ __all__ = [
     "TuneDB", "active_db", "cache_path", "invalidate", "lookup", "pinned",
     "snapshot_dir", "tuning_enabled", "class_key", "device_kind",
     "dtype_token", "flash_key", "ln_key", "moe_key", "optim_key",
-    "paged_key", "softmax_key", "flash_config", "ln_block_rows",
-    "moe_grouped_config", "optim_block_rows", "paged_decode_config",
-    "softmax_row_chunk", "cost_model", "registry", "shape_class",
+    "paged_key", "quant_key", "softmax_key", "flash_config",
+    "ln_block_rows", "moe_grouped_config", "optim_block_rows",
+    "paged_decode_config", "quant_matmul_config", "softmax_row_chunk",
+    "cost_model", "registry", "shape_class",
 ]
 
 
@@ -208,6 +210,39 @@ def moe_grouped_config(t: int, e: int, h: int, f: int, dtype) -> dict:
         cfg["tile_t"] = _clamp_rows(entry.get("tile_t"), tt_d, quantum=8,
                                     lo=8, hi=4096)
         cfg["tile_f"] = _clamp_rows(entry.get("tile_f"), tf_d, quantum=128,
+                                    lo=128, hi=4096)
+        if entry.get("backend") in ("pallas", "jnp"):
+            cfg["backend"] = entry["backend"]
+    return cfg
+
+
+def quant_matmul_config(m: int, k: int, n: int, dtype,
+                        qdtype: str = "int8") -> dict:
+    """Resolved config for one blockwise-scaled matmul shape class:
+    ``{"tile_m", "tile_n", "tile_k", "backend"}``. Cache entry wins
+    field-wise where present (clamped to legal values); the cost model
+    fills the rest — including the oracle-fallback backend rule
+    (cost_model.quant_backend_default). Env overrides
+    (APEX_TPU_QUANT_TILE_M / _N / _K) are applied by
+    quantization/scaled_matmul.py BEFORE consulting this — the standard
+    env > cache > model order."""
+    tm_d = cost_model.quant_tile_m_default(k, n, device=device_kind())
+    tn_d = cost_model.quant_tile_n_default(n)
+    tk_d = cost_model.quant_tile_k_default(k)
+    cfg = {
+        "tile_m": tm_d,
+        "tile_n": tn_d,
+        "tile_k": tk_d,
+        "backend": cost_model.quant_backend_default(m, k, n,
+                                                    device=device_kind()),
+    }
+    entry = lookup(quant_key(m, k, n, dtype, qdtype))
+    if entry:
+        cfg["tile_m"] = _clamp_rows(entry.get("tile_m"), tm_d, quantum=8,
+                                    lo=8, hi=4096)
+        cfg["tile_n"] = _clamp_rows(entry.get("tile_n"), tn_d, quantum=128,
+                                    lo=128, hi=4096)
+        cfg["tile_k"] = _clamp_rows(entry.get("tile_k"), tk_d, quantum=128,
                                     lo=128, hi=4096)
         if entry.get("backend") in ("pallas", "jnp"):
             cfg["backend"] = entry["backend"]

@@ -52,6 +52,9 @@ _SWEEP_ENV = (
     "APEX_TPU_PAGED_BLOCK_ROWS",
     "APEX_TPU_PAGED_KV_FETCH",
     "APEX_TPU_PAGED_Q_TILE",
+    "APEX_TPU_QUANT_TILE_M",
+    "APEX_TPU_QUANT_TILE_N",
+    "APEX_TPU_QUANT_TILE_K",
     "APEX_TPU_SOFTMAX_CHUNK",
     "APEX_TPU_USE_PALLAS",
 )
@@ -626,6 +629,117 @@ def sweep_moe(db: cache.TuneDB, *, hardware: bool, reps: int,
             + (f" ({best[2]:.3f} ms)" if hardware else " (verified)"))
 
 
+def sweep_quant(db: cache.TuneDB, *, hardware: bool, reps: int,
+                log=print) -> None:
+    """(tile_m, tile_n, tile_k) sweep for the blockwise-scaled
+    quantized matmul (quantization/scaled_matmul.py, registry family
+    ``quant_matmul``), int8 and fp8 payload widths.
+
+    Hardware sessions time a full quant_matmul f+b step per (m, k, n)
+    class — median of ``reps`` value_and_grad calls per candidate,
+    winner recorded with milliseconds. Interpret sessions VERIFY each
+    candidate against the dequantize-einsum oracle over the SAME
+    quantized payloads (fwd + both fp32-policy grads) and record the
+    cost-model default — the moe sweep's policy."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.quantization import quant_matmul
+
+    space = registry.TUNABLES["quant_matmul"].params
+    ladder = (
+        (4096, 1024, 4096),       # GPT-medium MLP up-projection class
+        (8192, 4096, 1024),       # ...and its down-projection
+    ) if hardware else ((96, 200, 160),)
+    for m, k, n in ladder:
+        for qdtype in ("int8", "fp8"):
+            keys = jax.random.split(jax.random.PRNGKey(m + n), 3)
+            lhs = jax.random.normal(keys[0], (m, k), jnp.float32)
+            rhs = jax.random.normal(keys[1], (k, n), jnp.float32)
+            do = jax.random.normal(keys[2], (m, n), jnp.float32)
+
+            def loss(lhs, rhs, use):
+                y = quant_matmul(lhs, rhs, dtype=qdtype, use_pallas=use)
+                return jnp.vdot(y, do)
+
+            best = None
+            for tm in space["tile_m"]:
+                for tn in space["tile_n"]:
+                    for tk in space["tile_k"]:
+                        entry = {"tile_m": tm, "tile_n": tn, "tile_k": tk}
+                        db_c = cache.TuneDB()
+                        db_c.record(
+                            shape_class.quant_key(m, k, n, jnp.float32,
+                                                  qdtype),
+                            entry, source="sweep-candidate")
+                        try:
+                            with _sweep_env(), cache.pinned(db_c):
+                                g = jax.jit(jax.grad(
+                                    lambda lhs, rhs: loss(lhs, rhs, True),
+                                    argnums=(0, 1)))
+                                gp = g(lhs, rhs)
+                                jax.block_until_ready(gp)
+                                if hardware:
+                                    times = []
+                                    for _ in range(max(1, reps)):
+                                        t0 = time.perf_counter()
+                                        jax.block_until_ready(g(lhs, rhs))
+                                        times.append(
+                                            time.perf_counter() - t0)
+                                    times.sort()
+                                    score = times[len(times) // 2] * 1e3
+                                else:
+                                    go = jax.grad(
+                                        lambda lhs, rhs: loss(lhs, rhs,
+                                                              False),
+                                        argnums=(0, 1))(lhs, rhs)
+                                    for a, c in zip(gp, go):
+                                        assert _maxdiff(a, c) < 0.1, \
+                                            f"grad mismatch {_maxdiff(a, c)}"
+                                    score = (
+                                        abs(tm
+                                            - cost_model.quant_tile_m_default(
+                                                k, n))
+                                        + abs(tn
+                                              - cost_model.quant_tile_n_default(
+                                                  n))
+                                        + abs(tk
+                                              - cost_model.quant_tile_k_default(
+                                                  k)))
+                        except Exception as err:  # noqa: BLE001
+                            log(f"autotune: quant_matmul m={m} "
+                                f"tiles=({tm},{tn},{tk}) {qdtype}: "
+                                f"REJECTED ({type(err).__name__}: "
+                                f"{str(err).splitlines()[0][:120]})")
+                            continue
+                        if best is None or score < best[3]:
+                            best = (tm, tn, tk, score)
+            if best is None:
+                log(f"autotune: quant_matmul m={m} {qdtype}: no viable "
+                    f"candidate; class keeps its cost-model default")
+                continue
+            if hardware:
+                entry = {"tile_m": best[0], "tile_n": best[1],
+                         "tile_k": best[2]}
+            else:  # verified, but keep the measured-rule defaults
+                entry = {
+                    "tile_m": cost_model.quant_tile_m_default(k, n),
+                    "tile_n": cost_model.quant_tile_n_default(n),
+                    "tile_k": cost_model.quant_tile_k_default(k),
+                }
+            registry.validate_entry("quant_matmul", entry)
+            db.record(
+                shape_class.quant_key(m, k, n, jnp.float32, qdtype), entry,
+                source="hardware" if hardware else "interpret+cost_model",
+                ms=best[3] if hardware else None,
+                note=f"swept {len(space['tile_m'])}x{len(space['tile_n'])}"
+                     f"x{len(space['tile_k'])} candidates")
+            log(f"autotune: quant_matmul m={m} k={k} n={n} {qdtype} -> "
+                f"tile_m={entry['tile_m']} tile_n={entry['tile_n']} "
+                f"tile_k={entry['tile_k']}"
+                + (f" ({best[3]:.3f} ms)" if hardware else " (verified)"))
+
+
 # ------------------------------------------------------------------
 # BASELINE.md projection table
 # ------------------------------------------------------------------
@@ -771,7 +885,8 @@ def run(*, out: Optional[str] = None, interpret: bool = False,
 def _run_inner(*, out, kernels, seqs, hiddens, dtype, reps, quick,
                hardware, log) -> "cache.TuneDB":
     kernels = kernels or ["flash", "layer_norm", "rms_norm", "optim_flat",
-                          "overlap_tp", "paged_decode", "moe_grouped"]
+                          "overlap_tp", "paged_decode", "moe_grouped",
+                          "quant_matmul"]
     seqs = seqs or ([256] if quick else [256, 512])
     hiddens = hiddens or ([256] if quick else [256, 1024])
     out_path = Path(out) if out else cache.cache_path()
@@ -796,6 +911,8 @@ def _run_inner(*, out, kernels, seqs, hiddens, dtype, reps, quick,
         sweep_paged(db, hardware=hardware, reps=reps, log=log)
     if "moe_grouped" in kernels:
         sweep_moe(db, hardware=hardware, reps=reps, log=log)
+    if "quant_matmul" in kernels:
+        sweep_quant(db, hardware=hardware, reps=reps, log=log)
     path = db.save(out_path)
     cache.invalidate()  # the freshly-written file is live immediately
     log(f"autotune: wrote {len(db.entries)} entries to {path}")
@@ -814,9 +931,11 @@ def main(argv: Optional[list] = None) -> int:
                     help=f"output tunedb path (default {cache.cache_path()})")
     ap.add_argument("--kernels",
                     default="flash,layer_norm,rms_norm,optim_flat,"
-                            "overlap_tp,paged_decode,moe_grouped",
+                            "overlap_tp,paged_decode,moe_grouped,"
+                            "quant_matmul",
                     help="comma list: flash,layer_norm,rms_norm,"
-                         "optim_flat,overlap_tp,paged_decode,moe_grouped")
+                         "optim_flat,overlap_tp,paged_decode,moe_grouped,"
+                         "quant_matmul")
     ap.add_argument("--seqs", default=None,
                     help="flash seq classes to sweep, comma list")
     ap.add_argument("--hiddens", default=None,

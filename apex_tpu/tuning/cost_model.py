@@ -388,6 +388,61 @@ def moe_backend_default(t: int, e: int, h: int, f: int,
 
 
 # ------------------------------------------------------------------
+# blockwise-scaled low-precision matmul (quantization/scaled_matmul.py)
+# ------------------------------------------------------------------
+
+# Oracle-fallback threshold: below this many output rows the quantize
+# prologue + grid overhead exceed what the dequantize-einsum oracle
+# costs, so auto mode routes the class to the oracle. A pinned cache
+# entry ({"backend": ...}) overrides per class; APEX_TPU_USE_PALLAS=1
+# beats both (env > cache > model, as everywhere).
+QUANT_FALLBACK_ROWS = 256
+
+
+def quant_tile_m_default(k: int, n: int, device: str = "cpu") -> int:
+    """Output rows per grid step. 256 (eight int8-native 32-sublane
+    tiles — the narrow payload keeps the resident footprint small, so
+    taller tiles than the bf16 gmm default are affordable) shrunk by
+    powers of two while the per-step residents — int8 lhs/rhs tiles +
+    fp32 accumulator + output, double-buffered inputs — push past 75%
+    of scoped VMEM. Anything finer is autotune's to prove."""
+    _, _, vmem = device_spec(device)
+    tn = quant_tile_n_default(n)
+    tk = quant_tile_k_default(k)
+    tm = 256
+    while tm > 32 and (
+        2 * (tm * tk + tk * tn) * 1 + tm * tn * (4 + 4)
+    ) > 0.75 * vmem:
+        tm //= 2
+    return tm
+
+
+def quant_tile_n_default(n: int) -> int:
+    """Output columns per grid step: 256 (two MXU lanes' worth, the
+    moe_tile_f rationale), clamped to the padded width for narrow
+    outputs."""
+    return min(256, _ceil128(n))
+
+
+def quant_tile_k_default(k: int) -> int:
+    """Contraction elements per k-step — ALSO the quantization block,
+    so this knob trades scale resolution (smaller blocks isolate
+    outliers better) against MXU occupancy and sidecar bytes. 256
+    matches the quantized-collectives chunk that the comms fuzz proved,
+    clamped to the padded contraction for narrow k."""
+    return min(256, _ceil128(k))
+
+
+def quant_backend_default(m: int, k: int, n: int,
+                          device: str = "cpu") -> str:
+    """"pallas" or "jnp" — the documented oracle-fallback rule: tiny
+    row counts can't amortize the quantize prologue + grid
+    (QUANT_FALLBACK_ROWS)."""
+    del k, n, device  # row count dominates; the rest is autotune's
+    return "jnp" if m < QUANT_FALLBACK_ROWS else "pallas"
+
+
+# ------------------------------------------------------------------
 # softmax tiling
 # ------------------------------------------------------------------
 

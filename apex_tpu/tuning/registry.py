@@ -66,6 +66,22 @@ def _moe_check(params: dict, features: dict) -> Optional[str]:
     return None
 
 
+def _quant_check(params: dict, features: dict) -> Optional[str]:
+    err = _mult("tile_m", 8)(params, features)
+    if err:
+        return err
+    err = _mult("tile_n", 128)(params, features)
+    if err:
+        return err
+    err = _mult("tile_k", 128)(params, features)
+    if err:
+        return err
+    backend = params.get("backend", "pallas")
+    if backend not in ("pallas", "jnp"):
+        return f"backend={backend!r} not in ('pallas', 'jnp')"
+    return None
+
+
 def _softmax_check(params: dict, _features: dict) -> Optional[str]:
     c = params.get("row_chunk", 0)
     if c < 0:
@@ -197,6 +213,34 @@ TUNABLES: Dict[str, Tunable] = {
                           "moe_tile_f_default / moe_backend_default",
             env={"tile_t": "APEX_TPU_MOE_TILE_T",
                  "tile_f": "APEX_TPU_MOE_TILE_F",
+                 "backend": "APEX_TPU_USE_PALLAS"},
+        ),
+        Tunable(
+            kernel="quant_matmul",
+            params={
+                "tile_m": [32, 128, 256, 512],
+                "tile_n": [128, 256, 512],
+                "tile_k": [128, 256, 512],
+                "backend": ["pallas", "jnp"],
+            },
+            check=_quant_check,
+            doc="Blockwise-scaled low-precision matmul (quantization/"
+                "scaled_matmul.py, int8 + fp8-layout operands with "
+                "per-tile fp32 scale sidecars): tile_m = output rows per "
+                "grid step (sublane multiple of 8; int8 tiles natively "
+                "want 32), tile_n = output columns (lane multiple of "
+                "128), tile_k = contraction elements per k-step AND the "
+                "quantization block size (scale resolution vs occupancy "
+                "trade). The cost model also owns the oracle-fallback "
+                "row threshold (cost_model.QUANT_FALLBACK_ROWS) behind "
+                "the backend default. Class carries rows, contraction, "
+                "output width, source dtype and payload width.",
+            defaults_from="cost_model.quant_tile_m_default / "
+                          "quant_tile_n_default / quant_tile_k_default / "
+                          "quant_backend_default",
+            env={"tile_m": "APEX_TPU_QUANT_TILE_M",
+                 "tile_n": "APEX_TPU_QUANT_TILE_N",
+                 "tile_k": "APEX_TPU_QUANT_TILE_K",
                  "backend": "APEX_TPU_USE_PALLAS"},
         ),
         Tunable(

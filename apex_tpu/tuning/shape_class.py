@@ -212,6 +212,28 @@ def moe_key(t: int, e: int, h: int, f: int, dtype, device=None) -> str:
     return class_key("moe_grouped", moe_features(t, e, h, f, dtype), device)
 
 
+def quant_features(m: int, k: int, n: int, dtype, qdtype: str) -> dict:
+    """Blockwise-scaled low-precision matmul (quantization/
+    scaled_matmul.py): the optimum moves with the row count (m — seq
+    bucket, batch dims collapse into it), the contraction and output
+    widths (the resident tile footprint AND the k-tile = quantization
+    block trade), the ORIGINAL operand dtype (what the narrow payload
+    is saving against) and the payload width ("int8" | "fp8")."""
+    return {
+        "m": seq_bucket(m),
+        "k": hidden_bucket(k),
+        "n": hidden_bucket(n),
+        "dt": dtype_token(dtype),
+        "q": str(qdtype),
+    }
+
+
+def quant_key(m: int, k: int, n: int, dtype, qdtype: str,
+              device=None) -> str:
+    return class_key("quant_matmul",
+                     quant_features(m, k, n, dtype, qdtype), device)
+
+
 def softmax_features(rows: int, cols: int, dtype) -> dict:
     return {
         "rows": seq_bucket(rows),

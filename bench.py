@@ -48,16 +48,26 @@ _METRIC = "bert_large_amp_o2_fused_lamb_samples_per_sec_per_chip"
 # 2-replica steps also dry-compile under --compile-only as a "fleet"
 # rung. Each mode emits one JSON line under its own metric name so it
 # can never masquerade as a samples/sec measurement.
+# --quant: the low-precision A/B rung — (a) a fixed-point fp32-vs-int8
+# matmul f+b step (quantization.quant_matmul) with tokens/s for both and
+# the error bound vs the fp32 product checked, and (b) the int8-KV
+# serving A/B: the fixed 16-request mix through a full-width engine and
+# an APEX_TPU_SERVING_KV_INT8 engine — ok gated on bitwise token
+# identity plus the doubled block capacity at equal pool bytes; the
+# quantized matmul fwd+bwd and the int8-KV unified step also dry-compile
+# under --compile-only as a "quant" rung.
 _COMPILE_ONLY = "--compile-only" in sys.argv[1:]
 _AUTOTUNE = "--autotune" in sys.argv[1:]
 _SERVING = "--serving" in sys.argv[1:]
 _MOE = "--moe" in sys.argv[1:]
 _FLEET = "--fleet" in sys.argv[1:]
+_QUANT = "--quant" in sys.argv[1:]
 _COMPILE_METRIC = "bert_large_compile_gate_rungs_ok"
 _AUTOTUNE_METRIC = "apex_tpu_autotune_entries_written"
 _SERVING_METRIC = "apex_tpu_serving_decode_steps_per_sec"
 _MOE_METRIC = "apex_tpu_moe_tokens_per_sec"
 _FLEET_METRIC = "apex_tpu_fleet_tokens_per_sec"
+_QUANT_METRIC = "apex_tpu_quant_tokens_per_sec"
 
 
 # -- observability: rung timings ride the telemetry registry ----------
@@ -832,6 +842,193 @@ def _fleet_compile_rung(on_cpu: bool, timeout_s: float) -> dict:
     return rung
 
 
+def _quant_matmul_ab(on_cpu: bool) -> dict:
+    """The matmul half of the quant rung: one fixed (m, k, n) MLP-class
+    point, fp32 (HIGHEST) vs int8 quant_matmul f+b steps, tokens/s for
+    both plus the relative error of the quantized product against the
+    fp32 one checked against the documented blockwise bound."""
+    import jax.numpy as jnp  # noqa: F811 — bench defers jax-heavy imports
+
+    from apex_tpu.quantization import quant_matmul
+
+    m, k, n = (512, 256, 384) if on_cpu else (8192, 1024, 4096)
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    lhs = jax.random.normal(keys[0], (m, k), jnp.float32)
+    rhs = jax.random.normal(keys[1], (k, n), jnp.float32)
+    do = jax.random.normal(keys[2], (m, n), jnp.float32)
+    iters = 3 if on_cpu else 20
+
+    def mk(quant):
+        def loss(l, r):
+            y = quant_matmul(l, r) if quant else jnp.matmul(
+                l, r, precision=jax.lax.Precision.HIGHEST)
+            return jnp.vdot(y, do)
+        return jax.jit(jax.grad(loss, argnums=(0, 1)))
+
+    rows = {}
+    for name, step in (("fp32", mk(False)), ("int8", mk(True))):
+        g = step(lhs, rhs)
+        jax.block_until_ready(g)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            g = step(lhs, rhs)
+        jax.block_until_ready(g)
+        dt = (time.perf_counter() - t0) / iters
+        rows[name] = {"tokens_per_sec": round(m / dt, 1),
+                      "step_ms": round(dt * 1e3, 3)}
+        _obs_gauge("bench/quant_matmul_tokens_per_sec",
+                   rows[name]["tokens_per_sec"], path=name)
+    full = jnp.matmul(lhs, rhs, precision=jax.lax.Precision.HIGHEST)
+    qout = quant_matmul(lhs, rhs)
+    rel = float(jnp.max(jnp.abs(qout - full)) / jnp.max(jnp.abs(full)))
+    # two int8 operands at ~0.4% of blockwise absmax each: a 2% ceiling
+    # on the product's relative error is generous and catches a broken
+    # scale path outright
+    bound_ok = rel < 0.02
+    return {
+        "paths": rows,
+        "int8_vs_fp32": round(rows["int8"]["tokens_per_sec"]
+                              / max(rows["fp32"]["tokens_per_sec"], 1e-9),
+                              3),
+        "rel_error": round(rel, 6),
+        "bound_ok": bound_ok,
+        "config": {"m": m, "k": k, "n": n},
+    }
+
+
+def _quant_payload(on_cpu: bool) -> dict:
+    """The low-precision A/B rung (metric
+    ``apex_tpu_quant_tokens_per_sec``): int8-KV serving tokens/s over
+    the fixed 16-request mix vs the full-width engine — ok gated on
+    BITWISE token identity, the >= 2x block capacity at equal pool
+    bytes, and the matmul half's error bound. A quantization that
+    changes greedy output or loses capacity has no throughput to
+    report."""
+    mm = _quant_matmul_ab(on_cpu)
+
+    import dataclasses
+
+    from apex_tpu.serving import ServingEngine
+
+    eng, cfg, scfg = _serving_setup(on_cpu)
+    reqs = _serving_requests(cfg, scfg, on_cpu)
+
+    def clone(tag):
+        return [dataclasses.replace(r, rid=f"{tag}{r.rid}") for r in reqs]
+
+    def timed(e, tag):
+        t0 = time.perf_counter()
+        out = e.run(clone(tag))
+        dt = time.perf_counter() - t0
+        stats = out.pop(None)
+        toks = sum(len(v["tokens"]) for v in out.values())
+        return out, stats, toks / max(dt, 1e-9)
+
+    eng.run(clone("warm"))                  # warmup: pays the one compile
+    eng.reset_state()
+    base, base_stats, fp_tps = timed(eng, "s")
+
+    qscfg = dataclasses.replace(scfg, kv_int8=True)
+    qeng = ServingEngine(qscfg, eng.params)
+    qeng.run(clone("qwarm"))
+    qeng.reset_state()
+    qout, q_stats, q_tps = timed(qeng, "q")
+    same = all(qout[f"q{r.rid}"]["tokens"] == base[f"s{r.rid}"]["tokens"]
+               for r in reqs)
+    # factor vs THIS config's cache dtype (bf16 here) plus the
+    # acceptance-criterion factor vs an fp32 pool at the same bytes —
+    # the "doubles concurrent slots" claim is stated against fp32
+    import jax.numpy as jnp  # noqa: F811
+    from apex_tpu.serving import quantized_pool_blocks
+
+    factor = qscfg.pool_blocks / max(scfg.pool_blocks, 1)
+    factor_fp32 = quantized_pool_blocks(
+        scfg.num_blocks, cfg.head_dim, jnp.float32) / max(
+        scfg.num_blocks, 1)
+    _obs_gauge("bench/quant_kv_tokens_per_sec", q_tps)
+    _obs_gauge("bench/quant_kv_block_factor", factor)
+    return {
+        "metric": _QUANT_METRIC,
+        "value": round(q_tps, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,
+        "ok": (same and factor_fp32 >= 2.0 and bool(mm["bound_ok"])
+               and q_stats["trace_counts"]["step"] == 1),
+        "quant": True,
+        "detail": {
+            "matmul_ab": mm,
+            "kv_int8_tokens_per_sec": round(q_tps, 2),
+            "fp_tokens_per_sec": round(fp_tps, 2),
+            "kv_int8_vs_fp": round(q_tps / max(fp_tps, 1e-9), 3),
+            "pool_blocks_fp": scfg.pool_blocks,
+            "pool_blocks_int8": qscfg.pool_blocks,
+            "block_capacity_factor": round(factor, 3),
+            "block_capacity_factor_vs_fp32": round(factor_fp32, 3),
+            # the capacity lever the router load-balances on: blocks
+            # free at the admission watermark, both widths
+            "kv_free_min_fp": base_stats["free_blocks"],
+            "kv_free_min_int8": q_stats["free_blocks"],
+            "tokens_identical": same,
+            "trace_counts": q_stats["trace_counts"],
+        },
+    }
+
+
+def _quant_compile_rung(on_cpu: bool, timeout_s: float) -> dict:
+    """Dry-compile the quant surface: the int8 quant_matmul f+b step and
+    the int8-KV engine's unified step (one program over the quantized
+    pool — proving the kv_int8 flag costs one compile, like every
+    serving rung)."""
+    import dataclasses
+
+    import jax.numpy as jnp  # noqa: F811
+
+    from apex_tpu.quantization import quant_matmul
+    from apex_tpu.serving import ServingEngine
+
+    rung = {"rung": "quant", "batch": None, "remat": "quant"}
+    t_total = 0.0
+    try:
+        m, k, n = (256, 256, 384) if on_cpu else (8192, 1024, 4096)
+        lhs = jax.random.normal(jax.random.PRNGKey(0), (m, k), jnp.float32)
+        rhs = jax.random.normal(jax.random.PRNGKey(1), (k, n), jnp.float32)
+        mm_step = jax.jit(jax.grad(
+            lambda l, r: jnp.sum(quant_matmul(l, r)), argnums=(0, 1)))
+
+        eng, cfg, scfg = _serving_setup(on_cpu)
+        qeng = ServingEngine(dataclasses.replace(scfg, kv_int8=True),
+                             eng.params)
+        for name, step, args in (
+            ("matmul", mm_step, (lhs, rhs)),
+            ("kv_step", qeng._step,
+             (qeng.params, qeng.fresh_cache(),
+              jnp.zeros((scfg.chunk_tokens,), jnp.int32),
+              jnp.zeros((scfg.max_slots,), jnp.int32),
+              jnp.zeros((scfg.max_slots,), jnp.int32))),
+        ):
+            compile_s, err = _compile_with_timeout(step, args, timeout_s)
+            if err is not None:
+                msg = ("compile hung" if err == "hung"
+                       else f"{type(err).__name__}: "
+                            f"{str(err).splitlines()[0][:200]}")
+                print(f"bench: compile-only rung quant/{name}: FAILED — "
+                      f"marked skipped ({msg})", file=sys.stderr,
+                      flush=True)
+                rung.update(ok=False, skipped=True, error=f"{name}: {msg}")
+                return rung
+            t_total += compile_s
+        print(f"bench: compile-only rung quant: OK ({t_total:.1f}s)",
+              file=sys.stderr, flush=True)
+        rung.update(ok=True, compile_s=round(t_total, 1))
+    except Exception as e:  # noqa: BLE001 — a failing rung is data
+        print(f"bench: compile-only rung quant: FAILED — marked skipped "
+              f"({type(e).__name__}: {str(e).splitlines()[0][:200]})",
+              file=sys.stderr, flush=True)
+        rung.update(ok=False, skipped=True,
+                    error=str(e).splitlines()[0][:200])
+    return rung
+
+
 def _moe_setup(on_cpu: bool):
     """Model + fixed sweep point for the MoE dispatch A/B rung. One
     definition shared by the timed run (--moe) and the dry-compile gate.
@@ -1122,6 +1319,14 @@ def main():
         # `--moe --compile-only` falls through to the dry-compile gate
         # below (which carries the per-path moe rungs) — never a timed rep
         emit(_moe_payload(on_cpu))
+        return
+
+    if _QUANT and not _COMPILE_ONLY:
+        # low-precision A/B rung: fp32-vs-int8 matmul tokens/s + the
+        # int8-KV serving capacity/parity pass; its own metric name,
+        # same discipline. `--quant --compile-only` falls through to
+        # the dry-compile gate below (which carries the quant rung)
+        emit(_quant_payload(on_cpu))
         return
 
     if _FLEET and not _COMPILE_ONLY:
@@ -1451,6 +1656,7 @@ def main():
         compile_rungs.append(_serving_compile_rung(on_cpu, gate_timeout))
         compile_rungs.append(_spec_compile_rung(on_cpu, gate_timeout))
         compile_rungs.append(_fleet_compile_rung(on_cpu, gate_timeout))
+        compile_rungs.append(_quant_compile_rung(on_cpu, gate_timeout))
         compile_rungs.extend(_moe_compile_rungs(on_cpu, gate_timeout))
         compile_rungs.append(_obs_compile_rung(on_cpu, gate_timeout))
         compile_rungs.append(_analysis_compile_rung())

@@ -107,6 +107,20 @@ run spec_bench    1800 'spec leg: OK' \
 run fleet_bench   3600 '"ok": true' python bench.py --fleet
 run fleet_leg     1800 'fleet leg: OK' \
                        python -c 'import __graft_entry__ as g; g.dryrun_fleet()'
+# 4c'''' — low-precision rung (quantization PR): fp32-vs-int8 matmul
+#      tokens/s at the fixed MLP-class point plus the int8-KV serving
+#      A/B (metric apex_tpu_quant_tokens_per_sec, ok gated on bitwise
+#      token identity vs the full-width engine, the >= 2x-vs-fp32 block
+#      capacity at equal pool bytes, and the blockwise error bound),
+#      then the graft quant leg (int8 matmul fwd+bwd vs the
+#      dequantize-einsum oracle in interpret mode + int8-KV serving
+#      token-identical with the doubled pool, 1 compile, refcounts
+#      exact). The quantized matmul f+b step and the int8-KV unified
+#      step also dry-compile in the overlap_gate compile-only item
+#      above as their own "quant" rung.
+run quant_bench   3600 '"ok": true' python bench.py --quant
+run quant_leg     1800 'quant leg: OK' \
+                       python -c 'import __graft_entry__ as g; g.dryrun_quant()'
 # 4d — MoE dispatch A/B rung (dropless-MoE PR): tokens/s of the einsum
 #      [t,E,C] dispatch vs the sort-based grouped-matmul path (capacity
 #      parity mode AND dropless) at the fixed GPT-medium-class sweep

@@ -644,8 +644,8 @@ def test_apx203_silent_on_valid_ring():
 _REQUIRED_ENTRY_POINTS = {
     "train_step", "ddp_bucket_flush", "zero_scatter_flush",
     "overlap_tp_matmul", "serving_paged_decode", "serving_ragged_verify",
-    "serving_unified_step", "pp_1f1b_train_step",
-    "pp_interleaved_train_step",
+    "serving_unified_step", "serving_unified_step_int8",
+    "pp_1f1b_train_step", "pp_interleaved_train_step",
 }
 
 
