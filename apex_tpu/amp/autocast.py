@@ -67,6 +67,26 @@ def _current_policy():
     return _tstate.stack[-1] if _tstate.stack else None
 
 
+def active_matmul_quant() -> Optional[Tuple[str, bool]]:
+    """The active policy's matmul-precision override, or ``None``.
+
+    Returns ``(width_token, bwd_quant)`` — e.g. ``("int8", False)``
+    under O2_INT8 — when an autocast context with ``matmul_quant`` set
+    is active on THIS thread. The tensor-parallel layers
+    (transformer/tensor_parallel/layers.py) consult this at trace time
+    for their explicit ``quant_matmul`` call sites: the autocast
+    interceptor only sees public ``jnp.matmul`` calls, and the TP
+    layers' GEMMs pass ``preferred_element_type`` (kwargs disqualify
+    the generic interception), so the policy reaches them through this
+    accessor instead."""
+    policy = _current_policy()
+    quant = getattr(policy, "matmul_quant", None) \
+        if policy is not None else None
+    if not quant:
+        return None
+    return quant, bool(getattr(policy, "matmul_quant_bwd", False))
+
+
 def _is_float_array(x) -> bool:
     return isinstance(x, (jax.Array, jnp.ndarray)) and jnp.issubdtype(
         jnp.asarray(x).dtype, jnp.floating

@@ -52,7 +52,30 @@ except ImportError:  # pragma: no cover
 
 
 def _matmul(x, kernel):
-    """Shard-local GEMM with fp32 MXU accumulation, result in input dtype."""
+    """Shard-local GEMM with fp32 MXU accumulation, result in input dtype.
+
+    Under an active amp policy with the ``matmul_quant`` override
+    (O2_INT8), the unambiguous ``[..., m, k] @ [k, n]`` projection routes
+    through the blockwise-scaled ``quantization.quant_matmul`` instead —
+    the explicit call site the autocast interceptor cannot reach (the
+    ``preferred_element_type`` kwarg disqualifies generic interception),
+    so the planner's quant gate applies to the TP column/row stack too.
+    Gate off (no policy, or ``matmul_quant=None``) this lowers
+    byte-identical HLO to the plain GEMM (pinned by
+    tests/L0/run_transformer/test_layers.py)."""
+    from apex_tpu.amp.autocast import active_matmul_quant, autocast
+
+    quant = active_matmul_quant()
+    if quant is not None and kernel.ndim == 2 and x.ndim >= 2 \
+            and x.shape[-1] == kernel.shape[0]:
+        from apex_tpu.quantization import quant_matmul
+
+        # casts-disabled: the quant path's own jnp internals must not
+        # re-enter the autocast interceptor (amp/autocast.py does the
+        # same around its quant route)
+        with autocast(enabled=False):
+            return quant_matmul(x, kernel, dtype=quant[0],
+                                bwd_quant=quant[1])
     return jnp.matmul(x, kernel, preferred_element_type=jnp.float32).astype(
         jnp.result_type(x, kernel)
     )
@@ -81,13 +104,19 @@ def column_parallel_linear(
                 "gather_output is incompatible with sequence parallelism (ref "
                 "asserts the same)"
             )
+        from apex_tpu.amp.autocast import active_matmul_quant
         from apex_tpu.parallel import overlap
 
-        if overlap.overlap_tp_enabled():
+        if overlap.overlap_tp_enabled() and active_matmul_quant() is None:
             # decomposed collective matmul: the seq-dim all-gather and the
             # GEMM become one ppermute-pipelined op (ring chunks each
             # overlapped with a partial matmul); its custom_vjp decomposes
-            # the backward reduce-scatter symmetrically
+            # the backward reduce-scatter symmetrically. The decomposed
+            # ring computes at FULL width, so an active matmul_quant
+            # policy (O2_INT8) takes precedence: monolithic collective +
+            # quant_matmul via _matmul rather than silently dropping the
+            # requested int8 compute — which combination wins on hardware
+            # is an A/B to measure.
             y = overlap.all_gather_matmul(x, kernel, axis, 0, None)
         else:
             x = gather_from_sequence_parallel_region(
@@ -126,12 +155,15 @@ def row_parallel_linear(
             )
         x = scatter_to_tensor_model_parallel_region(x, axis)
     if sequence_parallel_enabled:
+        from apex_tpu.amp.autocast import active_matmul_quant
         from apex_tpu.parallel import overlap
 
-        if overlap.overlap_tp_enabled():
+        if overlap.overlap_tp_enabled() and active_matmul_quant() is None:
             # decomposed collective matmul: only the destination slice of
             # the product is computed per ring step, pipelined against the
-            # partial-sum ppermutes (see parallel/overlap.py)
+            # partial-sum ppermutes (see parallel/overlap.py). An active
+            # matmul_quant policy wins over the full-width ring — see the
+            # column path's rationale.
             y = overlap.matmul_reduce_scatter(x, kernel, axis, 0, None)
         else:
             y = reduce_scatter_to_sequence_parallel_region(

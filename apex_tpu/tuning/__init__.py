@@ -19,7 +19,7 @@ crash); env-var validation stays at the op layer where it always lived.
 
 from __future__ import annotations
 
-from apex_tpu.tuning import cost_model, registry, shape_class
+from apex_tpu.tuning import comm_model, cost_model, registry, shape_class
 from apex_tpu.tuning.cache import (
     TuneDB,
     active_db,
@@ -50,7 +50,7 @@ __all__ = [
     "paged_key", "quant_key", "softmax_key", "flash_config",
     "ln_block_rows", "moe_grouped_config", "optim_block_rows",
     "paged_decode_config", "quant_matmul_config", "softmax_row_chunk",
-    "cost_model", "registry", "shape_class",
+    "comm_model", "cost_model", "registry", "shape_class",
 ]
 
 

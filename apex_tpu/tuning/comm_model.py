@@ -1,0 +1,161 @@
+"""Collective cost model: analytic bytes-on-wire + a link-time layer.
+
+The whole-run planner (tuning/planner.py) scores candidate
+(dp x tp x pp x ep x ZeRO x gate) configurations by composing compute
+time (cost_model.py's FLOP/byte machinery) with COMMUNICATION time.
+This module is the comm half, built from two layers that must never
+disagree with the rest of the repo:
+
+1. **Bytes on wire.** One analytic byte count per collective. For the
+   DDP / ZeRO gradient paths these are *delegations to the PR-5
+   formulas* — ``parallel/quantized_collectives.py``'s
+   ``quantized_wire_bytes`` / ``quantized_scatter_wire_bytes`` for the
+   int8 paths and the same ``n * itemsize`` payload count the
+   ``comms/bytes_on_wire`` counters record for the exact paths
+   (parallel/ddp.py, contrib/optimizers/_sharding.py) — so the planner
+   and the observability counters share ONE definition of wire bytes
+   (pinned by tests/L0/test_planner.py). The remaining collectives
+   (all_gather, reduce_scatter, all_to_all, the ppermute ring step)
+   follow the same convention: count the logical payload once.
+
+2. **Link time.** A per-device-kind interconnect model
+   (``cost_model.link_spec``: ICI bytes/s per direction + per-hop
+   latency) with the standard ring algorithmics layered on top:
+   a psum moves ``2*(w-1)/w`` of its payload per device over ``2*(w-1)``
+   hops, reduce_scatter / all_gather half that, an all_to_all moves the
+   ``(w-1)/w`` remote fraction, a ppermute step is one neighbor hop.
+   Quantized collectives time their own (already pass- and
+   scale-inclusive) wire formula over the same ring.
+
+Like every cost model here the numbers are deliberately coarse — they
+only have to order configurations, and they re-measure the day a TPU
+shows up (BENCH_r01-r05 are all "tpu backend unavailable").
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "all_gather_wire_bytes",
+    "all_to_all_wire_bytes",
+    "collective_seconds",
+    "ddp_psum_wire_bytes",
+    "ppermute_step_wire_bytes",
+    "reduce_scatter_wire_bytes",
+    "zero_allgather_wire_bytes",
+    "zero_scatter_wire_bytes",
+]
+
+# ring passes over the payload per device / hop counts per collective
+# kind (w = axis size): the classic bidirectional-ring algorithmics the
+# XLA collectives lower to on ICI
+_RING = {
+    # kind: (payload_fraction(w), hops(w))
+    "psum": (lambda w: 2.0 * (w - 1) / w, lambda w: 2 * (w - 1)),
+    "all_gather": (lambda w: (w - 1) / w, lambda w: w - 1),
+    "reduce_scatter": (lambda w: (w - 1) / w, lambda w: w - 1),
+    "all_to_all": (lambda w: (w - 1) / w, lambda w: w - 1),
+    "ppermute": (lambda w: 1.0, lambda w: 1),
+}
+
+
+# ---------------------------------------------------------------------------
+# bytes on wire — the counted payload, ONE definition per path
+# ---------------------------------------------------------------------------
+
+def ddp_psum_wire_bytes(n_elems: int, itemsize: int, *,
+                        quantized: bool = False,
+                        chunk: int | None = None) -> int:
+    """Counted wire bytes of one DDP gradient all-reduce over an
+    ``n_elems`` flat bucket — EXACTLY what parallel/ddp.py records on
+    ``comms/bytes_on_wire``: ``n * itemsize`` for the exact psum,
+    ``quantized_wire_bytes(n)`` for the int8 path."""
+    n = int(n_elems)
+    if not quantized:
+        return n * int(itemsize)
+    from apex_tpu.parallel.quantized_collectives import (
+        DEFAULT_CHUNK,
+        quantized_wire_bytes,
+    )
+
+    return quantized_wire_bytes(n, chunk or DEFAULT_CHUNK)
+
+
+def zero_scatter_wire_bytes(n_elems: int, itemsize: int, world: int, *,
+                            quantized: bool = False,
+                            chunk: int | None = None) -> int:
+    """Counted wire bytes of the ZeRO-2 gradient reduce-scatter —
+    EXACTLY what contrib/optimizers/_sharding.py records:
+    ``n * itemsize`` exact, ``quantized_scatter_wire_bytes(n, world)``
+    int8."""
+    n = int(n_elems)
+    if not quantized:
+        return n * int(itemsize)
+    from apex_tpu.parallel.quantized_collectives import (
+        DEFAULT_CHUNK,
+        quantized_scatter_wire_bytes,
+    )
+
+    return quantized_scatter_wire_bytes(n, int(world),
+                                        chunk or DEFAULT_CHUNK)
+
+
+def zero_allgather_wire_bytes(shard_elems: int, itemsize: int,
+                              world: int) -> int:
+    """Counted wire bytes of the ZeRO updated-param gather — EXACTLY
+    the ``world * shard * itemsize`` allreduce-sized payload
+    _sharding.all_gather_flat records (place-in-zeros + psum)."""
+    return int(world) * int(shard_elems) * int(itemsize)
+
+
+def all_gather_wire_bytes(gathered_elems: int, itemsize: int) -> int:
+    """Payload count of an all_gather whose OUTPUT is
+    ``gathered_elems`` (each device contributes 1/w of it)."""
+    return int(gathered_elems) * int(itemsize)
+
+
+def reduce_scatter_wire_bytes(full_elems: int, itemsize: int) -> int:
+    """Payload count of a reduce_scatter whose INPUT is
+    ``full_elems`` per device."""
+    return int(full_elems) * int(itemsize)
+
+
+def all_to_all_wire_bytes(local_elems: int, itemsize: int) -> int:
+    """Payload count of an all_to_all over a ``local_elems`` per-device
+    buffer (the EP dispatch/return unit)."""
+    return int(local_elems) * int(itemsize)
+
+
+def ppermute_step_wire_bytes(local_elems: int, itemsize: int) -> int:
+    """Payload of one ring hop (the pipeline p2p / decomposed-matmul
+    chunk unit)."""
+    return int(local_elems) * int(itemsize)
+
+
+# ---------------------------------------------------------------------------
+# link time
+# ---------------------------------------------------------------------------
+
+def collective_seconds(kind: str, payload_bytes: float, world: int,
+                       device: str = "cpu") -> float:
+    """Projected seconds of one collective: the counted payload run
+    through the ring algorithmics over the device kind's link model.
+
+    ``kind``: psum | all_gather | reduce_scatter | all_to_all |
+    ppermute. ``payload_bytes`` is the COUNTED payload (the wire-bytes
+    functions above); the ring fraction/hops are applied here, so a
+    quantized payload (whose formula already folds in its passes and
+    scale sidecars) rides the same ring as the exact one. world <= 1 is
+    free."""
+    if kind not in _RING:
+        # validated BEFORE the degenerate-world early return: a typo'd
+        # kind must fail loudly even on a size-1 axis
+        raise ValueError(
+            f"unknown collective kind {kind!r} (known: {sorted(_RING)})")
+    w = int(world)
+    if w <= 1 or payload_bytes <= 0:
+        return 0.0
+    from apex_tpu.tuning.cost_model import link_spec
+
+    frac, hops = _RING[kind]
+    bw, lat = link_spec(device)
+    return hops(w) * lat + frac(w) * float(payload_bytes) / bw

@@ -37,16 +37,26 @@ from __future__ import annotations
 
 from typing import Iterable
 
-# Per device-kind substring: (peak bf16 matmul FLOP/s, HBM GB/s, VMEM MiB).
+# Per device-kind substring: (peak bf16 matmul FLOP/s, HBM GB/s, VMEM MiB,
+# HBM GiB, ICI link bytes/s per direction, ICI per-hop latency s).
 # Same normalization as bench.peak_flops; VMEM is the scoped budget Mosaic
-# enforces, not the raw SRAM size.
+# enforces, not the raw SRAM size. The last three columns feed the
+# whole-run planner: HBM capacity is the default feasibility budget
+# (APEX_TPU_ANALYSIS_HBM_GB overrides), and the link columns are the
+# per-device-kind interconnect model tuning/comm_model.py layers its
+# collective times on (aggregate ICI bandwidth per chip divided across
+# links; microsecond-class per-hop latency — coarse, like everything
+# here: the model only has to ORDER configurations, not predict
+# microseconds, and every number re-measures the day a TPU shows up).
 DEVICE_SPECS = (
-    ("v5lite", 197e12, 819e9, 16.0),
-    ("v5e", 197e12, 819e9, 16.0),
-    ("v5p", 459e12, 2765e9, 16.0),
-    ("v6", 918e12, 1640e9, 32.0),
-    ("v4", 275e12, 1228e9, 16.0),
-    ("cpu", 1e12, 50e9, 16.0),  # nominal: interpret-mode ranking only
+    ("v5lite", 197e12, 819e9, 16.0, 16.0, 186e9, 1e-6),
+    ("v5e", 197e12, 819e9, 16.0, 16.0, 186e9, 1e-6),
+    ("v5p", 459e12, 2765e9, 16.0, 95.0, 600e9, 1e-6),
+    ("v6", 918e12, 1640e9, 32.0, 32.0, 448e9, 1e-6),
+    ("v4", 275e12, 1228e9, 16.0, 32.0, 300e9, 1e-6),
+    # nominal: interpret-mode ranking only; the link row keeps CPU-mesh
+    # planner demos ordered the same way a pod would be
+    ("cpu", 1e12, 50e9, 16.0, 16.0, 10e9, 5e-6),
 )
 
 # Per-grid-step launch/DMA-setup overhead (seconds). Coarse, but it is
@@ -67,10 +77,30 @@ STREAM_SEQ = 4096
 
 def device_spec(kind: str):
     kind = (kind or "cpu").lower().replace(" ", "")
-    for sub, flops, bw, vmem in DEVICE_SPECS:
+    for sub, flops, bw, vmem, _hbm, _link, _lat in DEVICE_SPECS:
         if sub in kind:
             return flops, bw, vmem * 2**20
     return 197e12, 819e9, 16.0 * 2**20  # unknown TPU: assume v5e
+
+
+def link_spec(kind: str):
+    """(ICI bytes/s per direction, per-hop latency s) for a device kind —
+    the planner's interconnect model (see the DEVICE_SPECS doc)."""
+    kind = (kind or "cpu").lower().replace(" ", "")
+    for sub, _fl, _bw, _vm, _hbm, link, lat in DEVICE_SPECS:
+        if sub in kind:
+            return link, lat
+    return 186e9, 1e-6  # unknown TPU: assume v5e
+
+
+def device_hbm_bytes(kind: str) -> float:
+    """Per-device HBM capacity in bytes — the planner's default
+    feasibility budget (APEX_TPU_ANALYSIS_HBM_GB beats it)."""
+    kind = (kind or "cpu").lower().replace(" ", "")
+    for sub, _fl, _bw, _vm, hbm, _link, _lat in DEVICE_SPECS:
+        if sub in kind:
+            return hbm * 2**30
+    return 16.0 * 2**30  # unknown TPU: assume v5e
 
 
 def _ceil128(s: int) -> int:

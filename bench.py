@@ -56,18 +56,28 @@ _METRIC = "bert_large_amp_o2_fused_lamb_samples_per_sec_per_chip"
 # identity plus the doubled block capacity at equal pool bytes; the
 # quantized matmul fwd+bwd and the int8-KV unified step also dry-compile
 # under --compile-only as a "quant" rung.
+# --plan: the whole-run auto-parallelism planner rung — rank
+# (dp x tp x pp x ep x ZeRO x gate) configs for the fixed bert/gpt
+# bench shapes (tuning/planner.py cost model; every reported plan
+# memory-feasible per estimate_peak_hbm), then EXECUTE the toy winner
+# on a host-device mesh with loss/grad parity vs the unplanned
+# reference and report projected-vs-measured (metric
+# apex_tpu_plan_projected_vs_measured); the planned step also
+# dry-compiles under --compile-only as its own "plan" rung.
 _COMPILE_ONLY = "--compile-only" in sys.argv[1:]
 _AUTOTUNE = "--autotune" in sys.argv[1:]
 _SERVING = "--serving" in sys.argv[1:]
 _MOE = "--moe" in sys.argv[1:]
 _FLEET = "--fleet" in sys.argv[1:]
 _QUANT = "--quant" in sys.argv[1:]
+_PLAN = "--plan" in sys.argv[1:]
 _COMPILE_METRIC = "bert_large_compile_gate_rungs_ok"
 _AUTOTUNE_METRIC = "apex_tpu_autotune_entries_written"
 _SERVING_METRIC = "apex_tpu_serving_decode_steps_per_sec"
 _MOE_METRIC = "apex_tpu_moe_tokens_per_sec"
 _FLEET_METRIC = "apex_tpu_fleet_tokens_per_sec"
 _QUANT_METRIC = "apex_tpu_quant_tokens_per_sec"
+_PLAN_METRIC = "apex_tpu_plan_projected_vs_measured"
 
 
 # -- observability: rung timings ride the telemetry registry ----------
@@ -336,17 +346,17 @@ def _success_payload(best, sweep, kernels, note=None):
     return payload
 
 
-def _compile_with_timeout(step, args, timeout_s):
-    """AOT-lower + compile in a worker thread with a deadline; never runs
-    the executable. Returns (compile_s | None, err | None) with the same
-    "hung" convention as _measure_with_timeout."""
+def _run_with_timeout(fn, timeout_s):
+    """Run ``fn()`` in a daemon worker thread with a deadline — the ONE
+    definition of the "hung" convention. Returns
+    (result | None, err | None); err is the literal string "hung" on
+    deadline (the worker may still hold the device client — the caller
+    decides whether the sweep can continue)."""
     box = {}
 
     def work():
         try:
-            t0 = time.perf_counter()
-            step.lower(*args).compile()
-            box["result"] = time.perf_counter() - t0
+            box["result"] = fn()
         except BaseException as e:  # noqa: BLE001 — a failing rung is data
             box["error"] = e
 
@@ -358,6 +368,17 @@ def _compile_with_timeout(step, args, timeout_s):
     if "error" in box:
         return None, box["error"]
     return box["result"], None
+
+
+def _compile_with_timeout(step, args, timeout_s):
+    """AOT-lower + compile under the deadline; never runs the
+    executable. Returns (compile_s | None, err | None)."""
+    def work():
+        t0 = time.perf_counter()
+        step.lower(*args).compile()
+        return time.perf_counter() - t0
+
+    return _run_with_timeout(work, timeout_s)
 
 
 def _compile_only_payload(rungs, kernels):
@@ -380,28 +401,12 @@ def _compile_only_payload(rungs, kernels):
 
 
 def _measure_with_timeout(step, args, iters, timeout_s):
-    """Run _measure in a worker thread with a deadline. A hung remote
-    compile cannot be interrupted from Python, so on timeout the caller
-    must stop the sweep (the worker still holds the device client) and
-    emit what it has; the daemon thread dies with the process."""
-    box = {}
-
-    def work():
-        try:
-            box["result"] = _measure(step, args, iters)
-        except BaseException as e:  # noqa: BLE001 — must never lose the round
-            box["error"] = e
-
-    t = threading.Thread(target=work, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if t.is_alive():
-        return None, "hung"
-    if "error" in box:
-        return None, box["error"]
-    if "result" not in box:
-        return None, RuntimeError("measure worker died without result")
-    return box["result"], None
+    """Run _measure under the deadline. A hung remote compile cannot be
+    interrupted from Python, so on timeout the caller must stop the
+    sweep (the worker still holds the device client) and emit what it
+    has; the daemon thread dies with the process."""
+    return _run_with_timeout(lambda: _measure(step, args, iters),
+                             timeout_s)
 
 
 def _serving_setup(on_cpu: bool, spec: bool = False):
@@ -1224,6 +1229,103 @@ def _analysis_compile_rung() -> dict:
     return rung
 
 
+def _plan_shapes(dev) -> list:
+    """The fixed bench shapes the planner ranks: the north-star
+    BERT-large geometry and the GPT-medium class, for the acquired
+    device's cost tables."""
+    from apex_tpu.tuning import planner
+
+    kind = "cpu" if dev.platform == "cpu" else str(
+        getattr(dev, "device_kind", "tpu"))
+    return [(planner.shape_by_name("bert-large"), kind),
+            (planner.shape_by_name("gpt-medium"), kind)]
+
+
+def _plan_payload(on_cpu: bool) -> dict:
+    """The --plan rung: rank configs for the fixed bert/gpt bench
+    shapes (8-device pod-slice unit), then EXECUTE the toy winner on
+    the host-device mesh — parity-gated, projected-vs-measured as the
+    metric value."""
+    from apex_tpu.tuning import planner
+
+    dev = jax.devices()[0]
+    ranked = {}
+    # planner.plan() only ever RETURNS memory-feasible plans (it raises
+    # when none exist), so the rung's ok verdict is the parity gate
+    for shape, kind in _plan_shapes(dev):
+        plans = planner.plan(shape, 8, device=kind, top_k=3)
+        ranked[shape.name] = [p.to_json() for p in plans]
+        for p in plans:
+            _obs_gauge("bench/plan_projected_ms", p.projected_ms,
+                       model=shape.name, config=p.config.tag)
+    host = jax.devices("cpu")
+    toy_plans = planner.plan(planner.shape_by_name("toy"), len(host),
+                             device="cpu", top_k=5)
+    executed = planner.execute_plan(toy_plans[0], devices=host, steps=2)
+    ratio = executed.get("projected_vs_measured") or 0.0
+    _obs_gauge("bench/plan_measured_ms", executed["measured_ms"],
+               config=executed["tag"])
+    return {
+        "metric": _PLAN_METRIC,
+        "value": round(float(ratio), 6),
+        "unit": "projected/measured",
+        "vs_baseline": 0.0,
+        "ok": bool(executed.get("parity_ok")),
+        "plan": True,
+        "detail": {
+            "ranked": ranked,
+            "executed": {k: v for k, v in executed.items()
+                         if isinstance(v, (int, float, str, bool,
+                                           type(None)))},
+            "toy_plans": [p.config.tag for p in toy_plans],
+        },
+    }
+
+
+def _plan_compile_rung(timeout_s: float) -> dict:
+    """The planner as a gate rung: the search must produce feasible
+    plans for the bench shapes, and the toy winner's planned step must
+    execute (compile + 1 step, parity-gated) on the host mesh —
+    seconds in the gate instead of a broken measurement window. The
+    whole body runs under the same worker-thread deadline as the other
+    rungs (the remote-tunnel hazard: a hung trace/compile must mark the
+    rung skipped, never stall the gate)."""
+    import time as _time
+
+    rung = {"rung": "plan", "batch": None, "remat": "plan"}
+
+    def work():
+        from apex_tpu.tuning import planner
+
+        t0 = _time.perf_counter()
+        dev = jax.devices()[0]
+        for shape, kind in _plan_shapes(dev):
+            plans = planner.plan(shape, 8, device=kind, top_k=1)
+            assert plans, shape.name
+        host = jax.devices("cpu")
+        toy = planner.plan(planner.shape_by_name("toy"), len(host),
+                           device="cpu", top_k=1)
+        executed = planner.execute_plan(toy[0], devices=host, steps=1)
+        assert executed["parity_ok"]
+        return _time.perf_counter() - t0, executed["tag"]
+
+    result, err = _run_with_timeout(work, timeout_s)
+    if err is not None:
+        msg = ("hung" if err == "hung"
+               else f"{type(err).__name__}: "
+                    f"{str(err).splitlines()[0][:200]}")
+        print(f"bench: compile-only rung plan: FAILED — marked "
+              f"skipped ({msg})", file=sys.stderr, flush=True)
+        rung.update(ok=False, skipped=True, error=msg)
+    else:
+        dt, tag = result
+        print(f"bench: compile-only rung plan: OK ({dt:.1f}s — "
+              f"executed {tag}, parity clean)",
+              file=sys.stderr, flush=True)
+        rung.update(ok=True, compile_s=round(dt, 1), executed=tag)
+    return rung
+
+
 def _moe_compile_rungs(on_cpu: bool, timeout_s: float) -> list:
     """Dry-compile the MoE dispatch steps as one gate rung PER PATH
     (einsum / grouped / dropless — a per-rung verdict line for each, so
@@ -1336,6 +1438,15 @@ def main():
         # name, same discipline. `--fleet --compile-only` falls through
         # to the dry-compile gate below (which carries the fleet rung)
         emit(_fleet_payload(on_cpu))
+        return
+
+    if _PLAN and not _COMPILE_ONLY:
+        # auto-parallelism planner rung: rank configs for the fixed
+        # bert/gpt bench shapes, execute the toy winner on the host
+        # mesh (parity-gated), report projected-vs-measured; its own
+        # metric name, same discipline. `--plan --compile-only` falls
+        # through to the dry-compile gate below (the "plan" rung)
+        emit(_plan_payload(on_cpu))
         return
 
     if on_cpu:
@@ -1659,6 +1770,7 @@ def main():
         compile_rungs.append(_quant_compile_rung(on_cpu, gate_timeout))
         compile_rungs.extend(_moe_compile_rungs(on_cpu, gate_timeout))
         compile_rungs.append(_obs_compile_rung(on_cpu, gate_timeout))
+        compile_rungs.append(_plan_compile_rung(gate_timeout))
         compile_rungs.append(_analysis_compile_rung())
         emit(_compile_only_payload(compile_rungs, kernel_report))
         return

@@ -121,6 +121,23 @@ run fleet_leg     1800 'fleet leg: OK' \
 run quant_bench   3600 '"ok": true' python bench.py --quant
 run quant_leg     1800 'quant leg: OK' \
                        python -c 'import __graft_entry__ as g; g.dryrun_quant()'
+# 4c''''' — auto-parallelism planner rung (whole-run planner PR): rank
+#      (dp x tp x pp x ep x ZeRO x gate) configs for the fixed
+#      bert/gpt bench shapes (every reported plan memory-feasible per
+#      estimate_peak_hbm), execute the toy winner on the 8-host-device
+#      mesh with loss/grad parity vs the unplanned reference, report
+#      projected-vs-measured (metric
+#      apex_tpu_plan_projected_vs_measured); then the graft plan leg
+#      (ranked feasible list + executed top plan + the pp=2 numeric
+#      1F1B/interleaved run against fwd_bwd_no_pipelining). The
+#      planned step also dry-compiles in the overlap_gate compile-only
+#      item above as its own "plan" rung.
+run plan_bench    3600 '"ok": true' env \
+                       XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+                       python bench.py --plan
+run plan_leg      1800 'plan leg: OK' env \
+                       XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+                       python -c 'import __graft_entry__ as g; g.dryrun_plan()'
 # 4d — MoE dispatch A/B rung (dropless-MoE PR): tokens/s of the einsum
 #      [t,E,C] dispatch vs the sort-based grouped-matmul path (capacity
 #      parity mode AND dropless) at the fixed GPT-medium-class sweep

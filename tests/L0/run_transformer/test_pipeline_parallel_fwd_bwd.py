@@ -154,6 +154,38 @@ def test_forward_only_outputs():
     np.testing.assert_allclose(outs, ref.outputs, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("ckpt", [False, True])
+@pytest.mark.parametrize("schedule,vp", [
+    (forward_backward_pipelining_without_interleaving, 1),
+    (forward_backward_pipelining_with_interleaving, 2),
+])
+def test_pp2_parity_checkpoint_on_off(schedule, vp, ckpt):
+    """The pp=2 numeric-parity pin behind ROADMAP item 4's planner
+    dryrun: BOTH schedules, checkpoint_activations on AND off, must
+    reproduce the no-pipelining losses, stage grads and loss-param
+    grads on the 2-stage ring — the exact mesh the planner's executed
+    pp leg and the graft plan leg drive."""
+    pp, m = 2, 4
+    chunks, lp = make_params(jax.random.PRNGKey(8), pp * vp)
+    xs, ys = make_batch(jax.random.PRNGKey(9), m)
+    ref = reference_run(chunks, lp, xs, ys)
+    losses, grads, lgrads, _ = run_pipelined(
+        schedule, chunks, lp, xs, ys, pp, vp,
+        checkpoint_activations=ckpt,
+    )
+    np.testing.assert_allclose(losses, ref.losses, rtol=1e-5, atol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                atol=1e-5),
+        grads, ref.stage_grads,
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                atol=1e-5),
+        lgrads, ref.loss_grads,
+    )
+
+
 def test_checkpoint_activations_parity():
     pp, m = 4, 4
     chunks, lp = make_params(jax.random.PRNGKey(6), pp)

@@ -1,0 +1,296 @@
+"""Whole-run auto-parallelism planner: comm-model pins + search +
+executed-plan parity.
+
+The pins that matter most here are the ONE-definition-of-wire-bytes
+pins: the planner's DP/ZeRO byte projections must equal the PR-5
+analytic formulas (``parallel/quantized_collectives.py`` + the
+``comms/bytes_on_wire`` counter arguments in parallel/ddp.py and
+contrib/optimizers/_sharding.py) EXACTLY, so the planner and the
+observability counters can never disagree. Then monotonicity sanity
+(more tp => less per-device compute; fewer microbatches => bigger
+bubble), memory-feasibility ordering, and the executed leg: the
+planner's top configs run REAL steps with loss/grad parity vs the
+unplanned reference, including the pp=2 schedules against
+fwd_bwd_no_pipelining.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from apex_tpu.parallel.quantized_collectives import (
+    quantized_scatter_wire_bytes,
+    quantized_wire_bytes,
+)
+from apex_tpu.tuning import comm_model, cost_model, planner
+
+TOY = planner.shape_by_name("toy")
+
+
+# ---------------------------------------------------------------------------
+# comm-model pins: one definition of wire bytes
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [1, 255, 256, 4096, 100003])
+def test_ddp_wire_bytes_pin_exact_and_quantized(n):
+    # exact path: the payload count parallel/ddp.py records
+    assert comm_model.ddp_psum_wire_bytes(n, 4) == n * 4
+    # int8 path: the PR-5 analytic formula verbatim
+    assert (comm_model.ddp_psum_wire_bytes(n, 4, quantized=True)
+            == quantized_wire_bytes(n))
+    assert (comm_model.ddp_psum_wire_bytes(n, 4, quantized=True,
+                                           chunk=64)
+            == quantized_wire_bytes(n, 64))
+
+
+@pytest.mark.parametrize("n,world", [(4096, 2), (4096, 8), (99840, 4)])
+def test_zero_wire_bytes_pin_exact_and_quantized(n, world):
+    assert comm_model.zero_scatter_wire_bytes(n, 4, world) == n * 4
+    assert (comm_model.zero_scatter_wire_bytes(n, 4, world,
+                                               quantized=True)
+            == quantized_scatter_wire_bytes(n, world))
+    # the param gather: world * shard * itemsize (the place-in-zeros +
+    # psum payload all_gather_flat counts)
+    shard = n // world
+    assert (comm_model.zero_allgather_wire_bytes(shard, 4, world)
+            == world * shard * 4)
+
+
+def test_planner_projection_uses_the_pinned_formulas():
+    """The byte numbers inside a projected breakdown must BE the
+    formulas — computed from the same per-device param count."""
+    cfg = planner.PlanConfig(dp=4, tp=1, pp=1, microbatches=1)
+    n_local = planner.local_param_elems(TOY, cfg)
+    b = planner.project(TOY, cfg, device="v5e")
+    assert b["wire_bytes"]["dp_grad"] == n_local * 4
+
+    cfg_q = planner.PlanConfig(dp=4, microbatches=1,
+                               quantized_comms=True)
+    bq = planner.project(TOY, cfg_q, device="v5e")
+    assert bq["wire_bytes"]["dp_grad"] == quantized_wire_bytes(n_local)
+
+    cfg_z = planner.PlanConfig(dp=4, zero=2, microbatches=1)
+    bz = planner.project(TOY, cfg_z, device="v5e")
+    assert bz["wire_bytes"]["dp_grad"] == n_local * 4
+    shard = -(-n_local // 4)
+    assert bz["wire_bytes"]["zero_gather"] == 4 * shard * 4
+
+    cfg_zq = planner.PlanConfig(dp=4, zero=2, microbatches=1,
+                                quantized_comms=True)
+    bzq = planner.project(TOY, cfg_zq, device="v5e")
+    assert (bzq["wire_bytes"]["dp_grad"]
+            == quantized_scatter_wire_bytes(n_local, 4))
+
+
+def test_collective_seconds_ring_model():
+    bw, lat = cost_model.link_spec("v5e")
+    B, w = 1 << 20, 4
+    # psum moves 2(w-1)/w of the payload over 2(w-1) hops
+    assert comm_model.collective_seconds("psum", B, w, "v5e") == (
+        pytest.approx(2 * (w - 1) * lat + 2 * (w - 1) / w * B / bw))
+    # world 1 is free; unknown kinds raise
+    assert comm_model.collective_seconds("psum", B, 1, "v5e") == 0.0
+    with pytest.raises(ValueError):
+        comm_model.collective_seconds("gather_scatter", B, w, "v5e")
+
+
+def test_quantized_halves_exposed_grad_bytes_uncompensated():
+    """The planner inherits the PR-2 semantics: error-compensated
+    quantization (the default) is byte-PARITY with fp32, and the
+    2x wire win appears exactly when compensation is off."""
+    n = 1 << 16
+    exact = comm_model.ddp_psum_wire_bytes(n, 4)
+    comp = quantized_wire_bytes(n)
+    uncomp = quantized_wire_bytes(n, error_compensation=False)
+    assert comp == pytest.approx(exact, rel=0.05)
+    assert uncomp <= 0.55 * exact
+
+
+# ---------------------------------------------------------------------------
+# projection monotonicity pins
+# ---------------------------------------------------------------------------
+
+def test_more_tp_less_per_device_compute():
+    ms = [planner.project(
+        planner.shape_by_name("bert-large"),
+        planner.PlanConfig(dp=1, tp=tp, pp=1, microbatches=1),
+        device="v5e")["compute_ms"] for tp in (1, 2, 4)]
+    assert ms[0] > ms[1] > ms[2]
+
+
+def test_fewer_microbatches_bigger_bubble():
+    fracs = [planner.project(
+        TOY, planner.PlanConfig(dp=1, pp=2, microbatches=m),
+        device="v5e")["bubble_fraction"] for m in (8, 4, 2)]
+    assert fracs[0] < fracs[1] < fracs[2]
+    assert fracs[2] == pytest.approx((2 - 1) / 2)
+
+
+def test_overlap_gate_shrinks_projected_tp_time():
+    base = planner.PlanConfig(dp=1, tp=4, pp=1, microbatches=1)
+    on = planner.PlanConfig(dp=1, tp=4, pp=1, microbatches=1,
+                            overlap_tp=True)
+    shape = planner.shape_by_name("bert-large")
+    assert (planner.project(shape, on, "v5e")["tp_ms"]
+            < planner.project(shape, base, "v5e")["tp_ms"])
+
+
+# ---------------------------------------------------------------------------
+# search space + memory feasibility
+# ---------------------------------------------------------------------------
+
+def test_enumerate_configs_validity():
+    cfgs = planner.enumerate_configs(TOY, 8)
+    assert cfgs
+    for c in cfgs:
+        assert c.devices == 8
+        assert TOY.layers % c.pp == 0
+        assert TOY.heads % c.tp == 0 and TOY.seq % c.tp == 0
+        assert TOY.global_batch % c.dp == 0
+        assert c.ep == 1                       # dense model pins ep
+        if c.zero:
+            assert c.dp > 1
+        if c.quantized_comms:
+            assert c.dp > 1
+        if c.overlap_tp:
+            assert c.tp > 1
+
+
+def test_enumerate_configs_moe_opens_ep():
+    moe = planner.ModelShape("moe", vocab=128, seq=32, hidden=32,
+                             layers=4, heads=4, global_batch=8,
+                             experts=8)
+    assert any(c.ep > 1 for c in planner.enumerate_configs(moe, 8))
+
+
+def test_memory_model_orderings():
+    """The static estimator must order the levers the right way:
+    ZeRO shrinks the optimizer residency, tp shrinks params."""
+    base = planner.estimate_config_peak(
+        TOY, planner.PlanConfig(dp=4, microbatches=1))
+    zero = planner.estimate_config_peak(
+        TOY, planner.PlanConfig(dp=4, zero=2, microbatches=1))
+    assert zero.peak_bytes < base.peak_bytes
+
+    tp1 = planner.estimate_config_peak(
+        planner.shape_by_name("bert-large"),
+        planner.PlanConfig(dp=1, tp=1, microbatches=1))
+    tp4 = planner.estimate_config_peak(
+        planner.shape_by_name("bert-large"),
+        planner.PlanConfig(dp=1, tp=4, microbatches=1))
+    assert tp4.peak_bytes < tp1.peak_bytes
+
+
+def test_plan_reports_only_feasible_ranked():
+    plans = planner.plan(TOY, 8, device="cpu", top_k=4)
+    assert plans
+    for i, p in enumerate(plans):
+        assert p.rank == i
+        assert p.feasible and p.peak_bytes <= p.budget_bytes
+        assert p.config.devices == 8
+    ms = [p.projected_ms for p in plans]
+    assert ms == sorted(ms)
+    # the plan record carries everything a run needs
+    j = plans[0].to_json()
+    assert set(j["env_gates"]) == {"APEX_TPU_QUANTIZED_COMMS",
+                                   "APEX_TPU_OVERLAP_TP",
+                                   "APEX_TPU_ZERO_PREFETCH"}
+    assert j["mesh_axes"]["data"] * j["mesh_axes"]["model"] * \
+        j["mesh_axes"]["stage"] * j["mesh_axes"]["expert"] == 8
+    assert "partition_specs" in j and "projected_peak_gib" in j
+
+
+def test_plan_budget_rejects_infeasible():
+    with pytest.raises(ValueError):
+        planner.plan(planner.shape_by_name("bert-large"), 1,
+                     device="v5e", hbm_budget_gb=0.001,
+                     max_memory_traces=4)
+
+
+def test_plan_respects_env_budget(monkeypatch):
+    monkeypatch.setenv("APEX_TPU_ANALYSIS_HBM_GB", "2.5")
+    plans = planner.plan(TOY, 2, device="cpu", top_k=1)
+    assert plans[0].budget_bytes == pytest.approx(2.5 * 2 ** 30)
+
+
+# ---------------------------------------------------------------------------
+# the executed leg (host mesh; real steps)
+# ---------------------------------------------------------------------------
+
+def test_execute_top_dp_tp_plan_parity(eight_cpu_devices):
+    plans = planner.plan(TOY, 4, device="cpu", top_k=12,
+                         max_memory_traces=32)
+    dp_tp = [p for p in plans if p.config.pp == 1]
+    assert dp_tp, [p.config.tag for p in plans]
+    res = planner.execute_plan(dp_tp[0], devices=eight_cpu_devices,
+                               steps=1)
+    assert res["parity_ok"] and res["mode"] == "dp_tp"
+    assert res["measured_ms"] > 0
+    assert np.isfinite(res["loss"])
+
+
+def test_execute_pp2_plan_numeric_parity(eight_cpu_devices):
+    """The pp EXECUTION leg: a pp=2 plan drives the real 1F1B +
+    interleaved schedules against fwd_bwd_no_pipelining."""
+    plans = planner.plan(TOY, 8, device="cpu", top_k=12,
+                         max_memory_traces=32)
+    pp2 = [p for p in plans if p.config.pp == 2]
+    assert pp2, [p.config.tag for p in plans]
+    res = planner.execute_plan(pp2[0], devices=eight_cpu_devices)
+    assert res["parity_ok"] and res["mode"] == "pipeline"
+    assert res["interleaved_ok"]
+    assert res["audited_eqns"] > 0
+
+
+def test_plan_gauges_recorded(monkeypatch):
+    from apex_tpu.observability import default_registry
+
+    monkeypatch.setenv("APEX_TPU_METRICS_SINK", "memory")
+    reg = default_registry()
+    reg.reset()
+    try:
+        plans = planner.plan(TOY, 2, device="cpu", top_k=1)
+        series = reg.gauge("tuning/plan_projected_ms").series()
+        assert series and series[0]["labels"]["config"] == \
+            plans[0].config.tag
+    finally:
+        reg.reset()
+
+
+def test_cli_json_report(capsys):
+    rc = planner.main(["--model", "toy", "--devices", "8", "--top",
+                       "2", "--device-kind", "v5e"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["model"] == "toy" and len(report["plans"]) == 2
+    assert all(p["feasible"] for p in report["plans"])
+
+
+def test_executed_gate_env_restored(eight_cpu_devices, monkeypatch):
+    """execute_plan scopes the plan's env gates: whatever the ambient
+    values were, they come back."""
+    import os
+
+    monkeypatch.setenv("APEX_TPU_QUANTIZED_COMMS", "0")
+    plans = planner.plan(TOY, 2, device="cpu", top_k=8)
+    qc = [p for p in plans
+          if p.config.quantized_comms and p.config.pp == 1]
+    if not qc:
+        pytest.skip("no quantized-comms config in the top plans")
+    planner.execute_plan(qc[0], devices=eight_cpu_devices, steps=1)
+    assert os.environ["APEX_TPU_QUANTIZED_COMMS"] == "0"
+
+
+def test_memory_step_counts_match_wire_formulas():
+    """local_param_elems IS the byte base of every DP wire formula and
+    the memory step's parameter tree — one source of truth."""
+    cfg = planner.PlanConfig(dp=2, tp=2, pp=2, microbatches=2)
+    fn, args, donate = planner._memory_step(TOY, cfg)
+    params = args[0]
+    total = sum(int(np.prod(s.shape)) for s in
+                jax.tree.leaves(params))
+    assert total == planner.local_param_elems(TOY, cfg)
+    assert donate == (0, 1)
