@@ -86,6 +86,16 @@ RULES: Dict[str, Rule] = {
              "at trace time, or silently freezes a data-dependent branch "
              "if the value is concrete during tracing. Use lax.cond / "
              "jnp.where / pl.when."),
+        Rule("APX107", "wallclock-duration", ERROR,
+             "time.time() used for duration math (a subtraction with a "
+             "time.time() result — direct or via an assigned alias — on "
+             "either side): the wall clock steps under NTP slew, so a "
+             "span or latency measured with it can come out negative or "
+             "wildly wrong — exactly the samples SLO verdicts, goodput "
+             "EMAs and tracer spans are built on. Use "
+             "time.perf_counter() (monotonic) for every duration; "
+             "time.time() stays legitimate for timestamps that never "
+             "enter arithmetic (log records, file names)."),
         # ---- APX2xx: jaxpr auditors ----------------------------------
         Rule("APX201", "use-after-donation", ERROR,
              "a value passed into a donated argument slot of a jitted "

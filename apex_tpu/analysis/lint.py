@@ -1,4 +1,4 @@
-"""AST trace-hygiene linter (rules APX101-APX105).
+"""AST trace-hygiene linter (rules APX101-APX107).
 
 Pure-stdlib static analysis over the package source — no jax import, no
 tracing, so the whole-package self-run costs well under a second and can
@@ -19,6 +19,11 @@ hand-fixed:
   python closures late-bind, so every index map the loop builds reads
   the LAST iteration's value when Pallas finally calls it. Bind it as
   a default (``lambda i, k=k: ...``) or build the map in a factory.
+* APX107 — ``time.time()`` used for duration math: any subtraction
+  with a wall-clock read (direct call or a name assigned from one) on
+  either side. Wall clocks step under NTP; spans/latencies must use
+  ``time.perf_counter()``. Pure timestamps (no arithmetic) stay legal
+  — the registry's record timestamps, postmortem file names.
 
 "Jitted" is decided statically: a function is **hot** when it is
 decorated with ``jax.jit``/``pjit`` (bare or via ``functools.partial``),
@@ -155,6 +160,25 @@ def _first_arg_names(call: ast.Call) -> List[str]:
     return []
 
 
+def _collect_time_names(tree: ast.Module) -> tuple:
+    """(module aliases of ``time``, function aliases of ``time.time``)
+    — what an APX107 wall-clock read can look like in this module:
+    ``time.time()`` / ``t.time()`` after ``import time as t`` /
+    ``time()`` after ``from time import time`` (incl. ``as`` names)."""
+    mods: Set[str] = set()
+    funcs: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    mods.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "time":
+                    funcs.add(a.asname or "time")
+    return mods, funcs
+
+
 def _collect_hot_names(tree: ast.Module) -> Set[str]:
     """Function names that are jitted or pallas-called anywhere in the
     module (assignment-style ``step = jax.jit(body, ...)`` and call-style
@@ -202,6 +226,11 @@ class _Linter(ast.NodeVisitor):
         # per-function-frame names assigned directly from an env read
         # ("env = os.environ.get(...)") — the aliases APX102 follows
         self._env_aliases: List[Set[str]] = []
+        # names assigned from a wall-clock read ("t0 = time.time()") —
+        # the aliases APX107 follows through a later subtraction; frame
+        # 0 is module scope, functions push/pop their own
+        self._time_mods, self._time_funcs = _collect_time_names(self.tree)
+        self._time_aliases: List[Set[str]] = [set()]
 
     # -- helpers ----------------------------------------------------
     def _add(self, rule: str, node: ast.AST, msg: str) -> None:
@@ -230,10 +259,12 @@ class _Linter(ast.NodeVisitor):
         hot = _is_hot_def(node, self.hot_names, self.rel)
         self._fn_stack.append(node)
         self._env_aliases.append(set())
+        self._time_aliases.append(set())
         self._hot_depth += 1 if hot else 0
         self._check_missing_wraps(node)
         self.generic_visit(node)
         self._hot_depth -= 1 if hot else 0
+        self._time_aliases.pop()
         self._env_aliases.pop()
         self._fn_stack.pop()
 
@@ -292,11 +323,46 @@ class _Linter(ast.NodeVisitor):
             return any(node.id in frame for frame in self._env_aliases)
         return False
 
+    # -- wall-clock tracking (APX107) ---------------------------------
+    def _is_wallclock_call(self, node: ast.AST) -> bool:
+        """A ``time.time()``-shaped expression under this module's
+        imports (``time.time()``, ``t.time()`` after ``import time as
+        t``, bare ``time()`` after ``from time import time``)."""
+        if not isinstance(node, ast.Call):
+            return False
+        name = _dotted(node.func)
+        if name in self._time_funcs:
+            return True
+        mod, _, attr = name.rpartition(".")
+        return attr == "time" and mod in self._time_mods
+
+    def _is_wallclock_operand(self, node: ast.AST) -> bool:
+        if self._is_wallclock_call(node):
+            return True
+        if isinstance(node, ast.Name):
+            return any(node.id in frame for frame in self._time_aliases)
+        return False
+
+    def _note_time_assign(self, value: ast.AST, target: ast.AST) -> None:
+        """Track (or clear) a name's wall-clock provenance in the
+        current frame: assigning ``time.time()`` marks it, reassigning
+        anything else un-marks it (precision: a reused ``t0`` must not
+        keep firing)."""
+        if not isinstance(target, ast.Name):
+            return
+        frame = self._time_aliases[-1]
+        if self._is_wallclock_call(value):
+            frame.add(target.id)
+        else:
+            frame.discard(target.id)
+
     def visit_Assign(self, node: ast.Assign) -> None:
         if self._env_aliases and _contains_env_read(node.value) is not None:
             for tgt in node.targets:
                 if isinstance(tgt, ast.Name):
                     self._env_aliases[-1].add(tgt.id)
+        for tgt in node.targets:
+            self._note_time_assign(node.value, tgt)
         self.generic_visit(node)
 
     def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
@@ -304,6 +370,8 @@ class _Linter(ast.NodeVisitor):
                 and _contains_env_read(node.value) is not None \
                 and isinstance(node.target, ast.Name):
             self._env_aliases[-1].add(node.target.id)
+        if node.value is not None:
+            self._note_time_assign(node.value, node.target)
         self.generic_visit(node)
 
     def visit_NamedExpr(self, node: ast.NamedExpr) -> None:
@@ -311,6 +379,21 @@ class _Linter(ast.NodeVisitor):
         if self._env_aliases and _contains_env_read(node.value) is not None \
                 and isinstance(node.target, ast.Name):
             self._env_aliases[-1].add(node.target.id)
+        self._note_time_assign(node.value, node.target)
+        self.generic_visit(node)
+
+    # APX107: wall-clock subtraction = duration math on time.time()
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        if isinstance(node.op, ast.Sub) and (
+                self._is_wallclock_operand(node.left)
+                or self._is_wallclock_operand(node.right)):
+            self._add(
+                "APX107", node,
+                "duration computed from time.time() — the wall clock "
+                "steps under NTP slew, so this span/latency can come "
+                "out negative or wildly wrong; use "
+                "time.perf_counter() (monotonic) for duration math "
+                "(time.time() is fine for pure timestamps)")
         self.generic_visit(node)
 
     # -- loop tracking (APX106) ---------------------------------------

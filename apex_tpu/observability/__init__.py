@@ -14,6 +14,18 @@ docs/observability.md):
 - ``goodput``   — steps/s & tokens/s EMAs, compile-event detection via
                   trace counters, overflow-skip fraction, compile-vs-run
                   wall split.
+- ``tracing``   — host-side spans + instant events on monotonic clocks,
+                  bounded ring buffer (``APEX_TPU_TRACE`` /
+                  ``APEX_TPU_TRACE_RING``), jitted HLO bitwise-unchanged.
+- ``events``    — the request-lifecycle event vocabulary, chain
+                  replay/validation, and the fault flight recorder
+                  (postmortem JSONL dump + reader, ``APEX_TPU_TRACE_DIR``).
+- ``exposition``— Prometheus text-format rendering (HELP/TYPE metadata,
+                  ``_bucket``/``_sum``/``_count`` histograms), atomic
+                  textfile-collector writes, opt-in stdlib HTTP endpoint.
+- ``trace_export`` — Perfetto/Chrome trace-event export of the tracer
+                  ring (per-replica process rows, per-slot threads,
+                  counter tracks) with a schema validator.
 
 Built-in instrumentation records here: the serving engine (TTFT/TPOT
 histograms, queue depth, KV occupancy, admission/eviction counters), the
@@ -46,14 +58,43 @@ from apex_tpu.observability.sinks import (  # noqa: F401
     flush_metrics,
     sink_from_env,
 )
+from apex_tpu.observability.tracing import (  # noqa: F401
+    Tracer,
+    add_span,
+    default_tracer,
+    trace_event,
+    trace_span,
+    tracing_enabled,
+)
+from apex_tpu.observability.events import (  # noqa: F401
+    Postmortem,
+    chain_problems,
+    dump_postmortem,
+    load_postmortem,
+    request_event,
+)
+from apex_tpu.observability.exposition import (  # noqa: F401
+    render_prometheus,
+    start_http_server,
+    write_textfile,
+)
+from apex_tpu.observability.trace_export import (  # noqa: F401
+    chrome_trace,
+    validate_chrome_trace,
+    write_chrome_trace,
+)
 
 __all__ = [
     "CSVSink", "Counter", "DEFAULT_BUCKETS", "Gauge", "GoodputTracker",
     "Histogram", "JSONLSink", "MEMORY", "MemorySink", "MetricsBuffer",
-    "MetricsDrainer", "MetricsRegistry", "Sink", "TIME_BUCKETS",
-    "accumulate", "default_registry", "flush_metrics", "inc_counter",
-    "init_buffer", "metrics_enabled", "observe", "set_gauge",
-    "sink_from_env",
+    "MetricsDrainer", "MetricsRegistry", "Postmortem", "Sink",
+    "TIME_BUCKETS", "Tracer", "accumulate", "add_span", "chain_problems",
+    "chrome_trace", "default_registry", "default_tracer",
+    "dump_postmortem", "flush_metrics", "inc_counter", "init_buffer",
+    "load_postmortem", "metrics_enabled", "observe", "render_prometheus",
+    "request_event", "set_gauge", "sink_from_env", "start_http_server",
+    "trace_event", "trace_span", "tracing_enabled",
+    "validate_chrome_trace", "write_chrome_trace", "write_textfile",
 ]
 
 _LAZY = {

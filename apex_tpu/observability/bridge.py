@@ -38,6 +38,7 @@ from apex_tpu.observability.registry import (
     MetricsRegistry,
     default_registry,
 )
+from apex_tpu.observability.tracing import trace_span
 
 __all__ = ["MetricsBuffer", "MetricsDrainer", "accumulate", "init_buffer"]
 
@@ -145,14 +146,20 @@ class MetricsDrainer:
 
     def drain(self, buf: MetricsBuffer, *,
               force: bool = False) -> MetricsBuffer:
-        """Maybe-drain ``buf``; returns the buffer for the next step."""
+        """Maybe-drain ``buf``; returns the buffer for the next step.
+        A drain window is a tracer span (``<prefix>.metrics_drain``)
+        when APEX_TPU_TRACE=1, so the timeline shows where the host
+        spent its harvest time between steps — non-drain calls stay
+        untouched (no span, no flag check beyond the rate limit)."""
         self._calls += 1
         if not (force or self._calls % self.interval == 0):
             return buf
-        self._harvest()                       # the interval-old transfer
-        if self.registry.enabled:
-            self._pending = _start_transfer(buf)
-        return jax.tree.map(jnp.zeros_like, buf)
+        with trace_span(f"{self.prefix}.metrics_drain",
+                        call=self._calls):
+            self._harvest()                   # the interval-old transfer
+            if self.registry.enabled:
+                self._pending = _start_transfer(buf)
+            return jax.tree.map(jnp.zeros_like, buf)
 
     def flush(self) -> None:
         """End of run: harvest whatever transfer is still pending. (The

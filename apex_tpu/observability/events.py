@@ -1,0 +1,271 @@
+"""Event vocabulary + fault flight recorder (postmortem dump/replay).
+
+**Vocabulary.** Every serving request leaves a chain of instant events
+in the tracer ring, keyed by ``rid`` and ``replica`` labels — the
+lifecycle the docs table (docs/serving.md) promises::
+
+    submit -> queue -> admit -> prefill_chunk* -> first_token
+           -> (decode | spec_verify)* -> finish
+    ... interrupted by:  preempt -> requeue   (SLO preemption)
+                         drain -> resume      (replica fault requeue)
+
+``chain_problems`` is the machine-checkable form of that grammar: a
+COMPLETE chain starts with exactly one ``submit``, ends with exactly
+one ``finish``, was admitted at least once, and every interruption
+(``preempt``/``drain``) is answered by its recovery event
+(``requeue``/``resume``) later in the chain — across placements, since
+the chain is keyed by rid, not replica. The fleet tests and the graft
+trace leg replay postmortem dumps through it.
+
+**Flight recorder.** The tracer ring is always cheap to feed (bounded,
+host-side); when a replica's step raises, the fleet Router calls
+:func:`dump_postmortem`: ring events + a metrics-registry snapshot + a
+host-mirror state summary (slots, seq_lens, queue depths, pool
+occupancy — NEVER a device sync) land in a timestamped JSONL file under
+``APEX_TPU_TRACE_DIR`` (default ``/tmp/apex_tpu_trace``). The drive
+then continues — drained work resumes on survivors — and at drive end
+the Router appends an EPILOGUE (the events recorded after the crash,
+plus the recovered state) to the same file, so the one artifact holds
+both the crash instant and the proof that recovery completed.
+:func:`load_postmortem` reads it back for replay.
+
+File format: JSON Lines, one record per line, discriminated by
+``kind``: ``postmortem`` (header: reason, wall time, last ring seq),
+``event`` (a tracer record), ``metrics`` (registry snapshot),
+``state`` (crash-time summary), ``epilogue`` (post-recovery state),
+with epilogue ``event`` records following their ``epilogue`` marker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from apex_tpu.observability.registry import MetricsRegistry, default_registry
+from apex_tpu.observability.tracing import Tracer, default_tracer
+from apex_tpu.utils.envvars import env_str
+
+__all__ = [
+    "ADMIT", "DECODE", "DRAIN", "FINISH", "FIRST_TOKEN", "LIFECYCLE",
+    "PREEMPT", "PREFILL_CHUNK", "QUEUE", "REQUEUE", "RESUME",
+    "SPEC_VERIFY", "SUBMIT",
+    "Postmortem",
+    "chain_for", "chain_problems", "dump_postmortem", "append_epilogue",
+    "load_postmortem", "request_event", "trace_dir",
+]
+
+# -- the request-lifecycle vocabulary (docs/serving.md table) -----------
+SUBMIT = "request.submit"
+QUEUE = "request.queue"
+ADMIT = "request.admit"
+PREFILL_CHUNK = "request.prefill_chunk"
+FIRST_TOKEN = "request.first_token"
+DECODE = "request.decode"
+SPEC_VERIFY = "request.spec_verify"
+PREEMPT = "request.preempt"
+REQUEUE = "request.requeue"
+DRAIN = "request.drain"
+RESUME = "request.resume"
+FINISH = "request.finish"
+
+LIFECYCLE = (SUBMIT, QUEUE, ADMIT, PREFILL_CHUNK, FIRST_TOKEN, DECODE,
+             SPEC_VERIFY, PREEMPT, REQUEUE, DRAIN, RESUME, FINISH)
+
+
+def request_event(name: str, rid, replica, **labels) -> None:
+    """Record one lifecycle event on the default tracer (disabled: one
+    flag check inside ``Tracer.event``). ``rid``/``replica`` become the
+    labels every chain/exporter keys on."""
+    default_tracer().event(name, rid=str(rid), replica=str(replica),
+                           **labels)
+
+
+# -- chain extraction / validation --------------------------------------
+
+def chain_for(events: List[dict], rid) -> List[dict]:
+    """The rid's events in timeline order (ts, then seq — spans record
+    at exit, so raw ring order is completion order, not start order)."""
+    rid = str(rid)
+    mine = [e for e in events
+            if e.get("labels", {}).get("rid") == rid]
+    return sorted(mine, key=lambda e: (e.get("ts", 0.0),
+                                       e.get("seq", 0)))
+
+
+def chain_problems(chain: List[dict]) -> List[str]:
+    """Why a request's event chain is NOT a complete lifecycle; empty
+    list = complete. The grammar: one ``submit`` first, one ``finish``
+    last, >= 1 ``admit``, every ``preempt`` later answered by a
+    ``requeue``, every ``drain`` by a ``resume``. A chain may span
+    placements (the replica label changes mid-chain) — that is the
+    fault-recovery story, not a problem."""
+    problems: List[str] = []
+    names = [e["name"] for e in chain]
+    if not names:
+        return ["no events"]
+    if names[0] != SUBMIT:
+        problems.append(f"first event is {names[0]!r}, not submit")
+    if names.count(SUBMIT) != 1:
+        problems.append(f"{names.count(SUBMIT)} submit events (want 1)")
+    if names[-1] != FINISH:
+        problems.append(f"last event is {names[-1]!r}, not finish")
+    if names.count(FINISH) != 1:
+        problems.append(f"{names.count(FINISH)} finish events (want 1)")
+    if ADMIT not in names:
+        problems.append("never admitted")
+    for interrupt, recovery in ((PREEMPT, REQUEUE), (DRAIN, RESUME)):
+        for i, n in enumerate(names):
+            if n == interrupt and recovery not in names[i + 1:]:
+                problems.append(
+                    f"{interrupt} at position {i} never followed by "
+                    f"{recovery}")
+    return problems
+
+
+# -- the postmortem file -------------------------------------------------
+
+_DEFAULT_DIR = "/tmp/apex_tpu_trace"
+_DUMP_SEQ = itertools.count()
+
+
+def trace_dir() -> Path:
+    """Where postmortems land: ``APEX_TPU_TRACE_DIR`` (re-read at call
+    time, utils/envvars), default ``/tmp/apex_tpu_trace``."""
+    return Path(env_str("APEX_TPU_TRACE_DIR", default=_DEFAULT_DIR))
+
+
+def dump_postmortem(*, reason: str, state: Optional[dict] = None,
+                    tracer: Optional[Tracer] = None,
+                    registry: Optional[MetricsRegistry] = None,
+                    directory: Optional[os.PathLike] = None) -> Path:
+    """Write the flight-recorder dump: header + every ring event + the
+    metrics snapshot + the host-mirror ``state`` summary, one JSON
+    object per line. Returns the timestamped file path (wall-clock
+    named — the one legitimate ``time.time`` use here; every duration
+    inside the records is monotonic)."""
+    tracer = tracer or default_tracer()
+    registry = registry or default_registry()
+    d = Path(directory) if directory is not None else trace_dir()
+    d.mkdir(parents=True, exist_ok=True)
+    wall = time.time()
+    stamp = time.strftime("%Y%m%d-%H%M%S", time.localtime(wall))
+    path = d / (f"postmortem-{stamp}-p{os.getpid()}"
+                f"-{next(_DUMP_SEQ)}.jsonl")
+    events = tracer.events()
+    perf0, wall0 = tracer.wall_anchor()
+    with path.open("w") as f:
+        f.write(json.dumps({
+            "kind": "postmortem", "reason": reason, "time": round(wall, 3),
+            "ring_events": len(events),
+            "last_seq": events[-1]["seq"] if events else -1,
+            "wall_anchor": {"perf_counter": perf0, "wall": wall0},
+        }, sort_keys=True) + "\n")
+        for e in events:
+            f.write(json.dumps({"kind": "event", **e}, sort_keys=True)
+                    + "\n")
+        f.write(json.dumps({"kind": "metrics",
+                            "snapshot": registry.snapshot()},
+                           sort_keys=True) + "\n")
+        f.write(json.dumps({"kind": "state", "state": state or {}},
+                           sort_keys=True) + "\n")
+    return path
+
+
+def append_epilogue(path: os.PathLike, *, state: Optional[dict] = None,
+                    tracer: Optional[Tracer] = None) -> int:
+    """Append the events recorded AFTER the dump (seq greater than the
+    file's newest) plus a recovered-state record — called by the fleet
+    Router when a fault-interrupted drive completes, so the postmortem's
+    chains run through to ``finish``. Returns the number of events
+    appended."""
+    tracer = tracer or default_tracer()
+    path = Path(path)
+    last = -1
+    with path.open() as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("kind") == "event":
+                last = max(last, rec.get("seq", -1))
+            elif rec.get("kind") == "postmortem":
+                last = max(last, rec.get("last_seq", -1))
+    fresh = [e for e in tracer.events() if e["seq"] > last]
+    with path.open("a") as f:
+        f.write(json.dumps({"kind": "epilogue", "time": round(time.time(), 3),
+                            "events": len(fresh), "state": state or {}},
+                           sort_keys=True) + "\n")
+        for e in fresh:
+            f.write(json.dumps({"kind": "event", **e}, sort_keys=True)
+                    + "\n")
+    return len(fresh)
+
+
+@dataclasses.dataclass
+class Postmortem:
+    """A loaded dump: crash header, merged event timeline (dump +
+    epilogue, deduped by seq), registry snapshot, crash-time state and
+    (when the drive completed) the epilogue state."""
+
+    path: Path
+    header: dict
+    events: List[dict]
+    metrics: dict
+    state: dict
+    epilogue: Optional[dict] = None
+
+    def rids(self) -> List[str]:
+        out = []
+        for e in self.events:
+            rid = e.get("labels", {}).get("rid")
+            if rid is not None and rid not in out:
+                out.append(rid)
+        return out
+
+    def drained_rids(self) -> List[str]:
+        """Requests the crash drained off the dead replica (recorded in
+        the state summary at dump time)."""
+        return [str(r) for r in self.state.get("drained", [])]
+
+    def chain(self, rid) -> List[dict]:
+        return chain_for(self.events, rid)
+
+    def chain_problems(self, rid) -> List[str]:
+        return chain_problems(self.chain(rid))
+
+
+def load_postmortem(path: os.PathLike) -> Postmortem:
+    """Read a dump back for replay (stdlib-only: works in a jax-free
+    triage process). Event records are deduped by ``seq`` and the
+    epilogue's events merged into one timeline."""
+    path = Path(path)
+    header: dict = {}
+    metrics: dict = {}
+    state: dict = {}
+    epilogue: Optional[dict] = None
+    by_seq: Dict[int, dict] = {}
+    with path.open() as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            kind = rec.pop("kind", None)
+            if kind == "postmortem":
+                header = rec
+            elif kind == "event":
+                by_seq[rec.get("seq", len(by_seq))] = rec
+            elif kind == "metrics":
+                metrics = rec.get("snapshot", {})
+            elif kind == "state":
+                state = rec.get("state", {})
+            elif kind == "epilogue":
+                epilogue = rec
+    if not header:
+        raise ValueError(f"{path}: not a postmortem dump (no header)")
+    events = [by_seq[k] for k in sorted(by_seq)]
+    return Postmortem(path=path, header=header, events=events,
+                      metrics=metrics, state=state, epilogue=epilogue)

@@ -1,0 +1,359 @@
+"""Prometheus text-format exposition of the metrics registry.
+
+The registry (registry.py) stores Prometheus-SHAPED data already (one
+series per label set, fixed-bucket histograms with sum/count); this
+module renders it in the text exposition format (version 0.0.4) any
+Prometheus scraper / node-exporter textfile collector ingests:
+
+* counters  -> ``<name>_total{labels} value`` (the ``_total``
+  convention)
+* gauges    -> ``<name>{labels} value``
+* histograms-> CUMULATIVE ``<name>_bucket{labels,le="..."}`` rows
+  (registry storage is per-bucket; the scan happens here) closing with
+  ``le="+Inf"``, plus ``<name>_sum`` / ``<name>_count``
+
+Metric names sanitize as ``apex_tpu_`` + the registry name with every
+non-``[a-zA-Z0-9_:]`` rune replaced by ``_`` (``serving/ttft_s`` ->
+``apex_tpu_serving_ttft_s``). Label values escape ``\\``, ``"`` and
+newlines per the spec.
+
+``# HELP`` / ``# TYPE`` metadata: every built-in series family ships a
+HELP string in :data:`FAMILY_HELP`; :func:`describe` registers strings
+for new families (first write wins — HELP is documentation, not state).
+Families without metadata render with a generated placeholder so the
+output always parses.
+
+Two delivery paths, both opt-in and host-side:
+
+* :func:`write_textfile` — atomic write (tmp + ``os.replace``) for the
+  node-exporter textfile collector; a scraper never reads a torn file.
+* :func:`start_http_server` — a stdlib ``http.server`` endpoint
+  (daemon thread) serving ``GET /metrics``; ``port=0`` binds an
+  ephemeral port (tests). Nothing in the library starts it implicitly.
+
+:func:`parse_prometheus` is the matching reader — the round-trip pin
+in tests/L0/test_tracing.py renders the registry, parses the text back
+and checks every sample against the registry accessors.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from apex_tpu.observability.registry import (
+    MetricsRegistry,
+    default_registry,
+)
+
+__all__ = [
+    "FAMILY_HELP",
+    "PrometheusEndpoint",
+    "describe",
+    "help_for",
+    "parse_prometheus",
+    "prom_name",
+    "render_prometheus",
+    "start_http_server",
+    "write_textfile",
+]
+
+_PREFIX = "apex_tpu_"
+
+# HELP strings for the series families the library itself emits
+# (docs/observability.md's metric tables, one line each). describe()
+# extends this for user families.
+FAMILY_HELP: Dict[str, str] = {
+    "serving/ttft_s": "Time to first token per request (seconds)",
+    "serving/tpot_s": "Per-token decode latency (seconds)",
+    "serving/prefill_s": "Prefill wall time (seconds)",
+    "serving/chunk_utilization":
+        "Fraction of the step token budget carrying query tokens",
+    "serving/spec_accept_rate":
+        "Accepted/drafted fraction per speculative verify window",
+    "serving/queue_depth": "Requests waiting for admission",
+    "serving/active_slots": "Running sequences",
+    "serving/kv_blocks_total": "KV pool size in blocks",
+    "serving/kv_blocks_free": "Free KV blocks",
+    "serving/kv_blocks_free_min": "Low-watermark of free KV blocks",
+    "serving/kv_occupancy": "Fraction of the KV pool in use",
+    "serving/kv_watermark": "Admission free-block reserve",
+    "serving/admissions": "Requests admitted into a slot",
+    "serving/evictions": "Finished sequences released",
+    "serving/preemptions": "Slots evicted for a higher SLO class",
+    "serving/admission_blocked":
+        "Admissions deferred at the free-block watermark",
+    "serving/prefix_hit_tokens": "Prompt tokens served from the prefix cache",
+    "serving/prefix_miss_tokens": "Prompt tokens prefilled fresh",
+    "serving/spec_drafted_tokens": "Speculative tokens drafted",
+    "serving/spec_accepted_tokens": "Speculative tokens accepted",
+    "serving/decode_steps_per_sec": "Decode step throughput",
+    "serving/decode_tokens_per_sec": "Decode token throughput",
+    "fleet/queue_wait_s": "Submit-to-admission wait (seconds)",
+    "fleet/requeues": "Requests requeued (preemption or replica fault)",
+    "fleet/slo_violations": "Finished requests missing an SLO target",
+    "fleet/replica_faults": "Replica step failures",
+    "goodput/steps_per_sec": "Training step rate EMA",
+    "goodput/tokens_per_sec": "Training token rate EMA",
+    "goodput/overflow_fraction": "Steps skipped on non-finite grads",
+    "goodput/compile_s": "Wall seconds attributed to (re)compiles",
+    "goodput/run_s": "Wall seconds spent in run steps",
+    "goodput/compiles": "Step (re)trace events",
+    "comms/bytes_on_wire": "Analytic collective payload bytes",
+    "moe/grouped_dispatch": "Grouped-MoE dispatch traces",
+    "tuning/lookups": "Tune-cache lookups",
+    "tuning/plan_projected_ms": "Planner projected step time (ms)",
+    "tuning/plan_measured_ms": "Planner executed step time (ms)",
+    "tuning/plan_projected_vs_measured": "Planner projection accuracy",
+    "tuning/plan_peak_gib": "Planner projected peak HBM (GiB)",
+    "quant/matmul_bytes_saved": "Operand bytes saved by quantized matmul",
+    "quant/kv_pool_bytes": "Quantized KV pool bytes (payload + scales)",
+    "quant/kv_pool_blocks": "Quantized KV pool blocks",
+}
+
+_EXTRA_HELP: Dict[str, str] = {}
+_HELP_LOCK = threading.Lock()
+
+
+def describe(name: str, help_text: str) -> None:
+    """Register a HELP string for a series family (registry name, e.g.
+    ``"serving/ttft_s"``). First write wins — re-describing an already
+    documented family is a no-op, never an error (HELP is metadata)."""
+    with _HELP_LOCK:
+        if name not in FAMILY_HELP and name not in _EXTRA_HELP:
+            _EXTRA_HELP[name] = str(help_text)
+
+
+def help_for(name: str) -> str:
+    h = FAMILY_HELP.get(name) or _EXTRA_HELP.get(name)
+    return h if h is not None else f"apex_tpu metric {name}"
+
+
+def prom_name(name: str) -> str:
+    """Registry name -> Prometheus metric name (prefixed + sanitized)."""
+    return _PREFIX + re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def _escape(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _labels_text(labels: dict, extra: Optional[List[Tuple[str, str]]] = None
+                 ) -> str:
+    items = [(str(k), str(v)) for k, v in sorted(labels.items())]
+    items += extra or []
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape(v)}"' for k, v in items) + "}"
+
+
+def _num(v: float) -> str:
+    f = float(v)
+    if f == float("inf"):
+        return "+Inf"
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
+    """Render the registry in Prometheus text format 0.0.4. Series with
+    no samples (never-materialized instruments) are skipped, matching
+    ``snapshot()``."""
+    registry = registry or default_registry()
+    snap = registry.snapshot()
+    lines: List[str] = []
+    for name in sorted(snap):
+        info = snap[name]
+        kind = info["type"]
+        base = prom_name(name)
+        family = base + "_total" if kind == "counter" else base
+        lines.append(f"# HELP {family} {help_for(name)}")
+        lines.append(f"# TYPE {family} "
+                     f"{'untyped' if kind not in ('counter', 'gauge', 'histogram') else kind}")
+        for s in info["series"]:
+            labels = s.get("labels", {})
+            if kind == "histogram":
+                cum = 0
+                for bound, count in s["buckets"]:
+                    cum += count
+                    lines.append(
+                        f"{base}_bucket"
+                        f"{_labels_text(labels, [('le', _num(bound))])} "
+                        f"{cum}")
+                lines.append(f"{base}_sum{_labels_text(labels)} "
+                             f"{_num(s['sum'])}")
+                lines.append(f"{base}_count{_labels_text(labels)} "
+                             f"{s['count']}")
+            else:
+                lines.append(f"{family}{_labels_text(labels)} "
+                             f"{_num(s['value'])}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# -- the matching reader (round-trip tests, triage tools) ---------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+
+
+def _parse_labels(text: str) -> Dict[str, str]:
+    labels: Dict[str, str] = {}
+    i = 0
+    n = len(text)
+    while i < n:
+        j = text.index("=", i)
+        key = text[i:j].strip()
+        assert text[j + 1] == '"', f"unquoted label value at {j}"
+        i = j + 2
+        out = []
+        while text[i] != '"':
+            if text[i] == "\\":
+                nxt = text[i + 1]
+                out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+                i += 2
+            else:
+                out.append(text[i])
+                i += 1
+        labels[key] = "".join(out)
+        i += 1
+        if i < n and text[i] == ",":
+            i += 1
+    return labels
+
+
+def parse_prometheus(text: str) -> Dict[str, dict]:
+    """Parse text-format exposition back into
+    ``{metric_family: {"type": ..., "help": ..., "samples":
+    [(sample_name, labels, value)]}}`` — samples attach to the family
+    whose name prefixes theirs (``_bucket``/``_sum``/``_count``
+    included). The round-trip pin for :func:`render_prometheus`."""
+    out: Dict[str, dict] = {}
+    order: List[str] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            fam, _, help_text = rest.partition(" ")
+            out.setdefault(fam, {"type": "untyped", "help": "",
+                                 "samples": []})["help"] = help_text
+            if fam not in order:
+                order.append(fam)
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            fam, _, kind = rest.partition(" ")
+            out.setdefault(fam, {"type": "untyped", "help": "",
+                                 "samples": []})["type"] = kind
+            if fam not in order:
+                order.append(fam)
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"unparseable sample line: {line!r}")
+        sname, ltext, value = m.groups()
+        labels = _parse_labels(ltext) if ltext else {}
+        fam = next((f for f in order
+                    if sname == f
+                    or (sname.startswith(f)
+                        and sname[len(f):] in ("_bucket", "_sum",
+                                               "_count"))), None)
+        if fam is None:
+            fam = sname
+            out.setdefault(fam, {"type": "untyped", "help": "",
+                                 "samples": []})
+            order.append(fam)
+        v = float("inf") if value == "+Inf" else float(value)
+        out[fam]["samples"].append((sname, labels, v))
+    return out
+
+
+# -- delivery: textfile collector + HTTP endpoint -----------------------
+
+def write_textfile(path: os.PathLike,
+                   registry: Optional[MetricsRegistry] = None) -> Path:
+    """Atomically write the rendered registry to ``path`` (tmp file in
+    the same directory + ``os.replace``) — the node-exporter textfile
+    collector contract: a concurrent scrape reads the old complete file
+    or the new complete file, never a torn one."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    text = render_prometheus(registry)
+    fd, tmp = tempfile.mkstemp(dir=str(path.parent),
+                               prefix=path.name + ".", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+class PrometheusEndpoint:
+    """Opt-in stdlib HTTP scrape endpoint: ``GET /metrics`` (or ``/``)
+    renders the registry per request. Runs ``http.server`` on a daemon
+    thread; ``close()`` shuts it down. Nothing starts this implicitly —
+    a library must never open ports on its own."""
+
+    CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+    def __init__(self, port: int = 0, *, addr: str = "127.0.0.1",
+                 registry: Optional[MetricsRegistry] = None):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        endpoint = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path.split("?")[0].rstrip("/") not in ("",
+                                                               "/metrics"):
+                    self.send_error(404)
+                    return
+                body = render_prometheus(endpoint.registry).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", endpoint.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-scrape stderr spam
+                pass
+
+        self.registry = registry
+        self._server = ThreadingHTTPServer((addr, port), Handler)
+        self.addr, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="apex-tpu-metrics-http", daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.addr}:{self.port}/metrics"
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+def start_http_server(port: int = 0, *,
+                      registry: Optional[MetricsRegistry] = None
+                      ) -> PrometheusEndpoint:
+    """Start the opt-in scrape endpoint; ``port=0`` binds an ephemeral
+    port (read it back from ``.port``). Caller owns ``close()``."""
+    return PrometheusEndpoint(port, registry=registry)

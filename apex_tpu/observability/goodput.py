@@ -40,6 +40,7 @@ from apex_tpu.observability.registry import (
     MetricsRegistry,
     default_registry,
 )
+from apex_tpu.observability.tracing import default_tracer
 
 __all__ = ["GoodputTracker"]
 
@@ -88,11 +89,19 @@ class GoodputTracker:
         self.tokens += tokens
         if self._trace_events > before:
             # a (re)trace happened inside this window: compile time, not
-            # throughput — EMAs skip it entirely
+            # throughput — EMAs skip it entirely. The span rides the
+            # SAME trace-counter verdict: the timeline shows this step
+            # as a compile window, not a run step
             self.compiles += self._trace_events - before
             self.compile_s += dt
+            default_tracer().add_span(
+                f"{self.prefix}.step", t0, dt, phase="compile",
+                step=self.steps, tokens=tokens)
             return
         self.run_s += dt
+        default_tracer().add_span(
+            f"{self.prefix}.step", t0, dt, phase="run",
+            step=self.steps, tokens=tokens)
         if dt > 0:
             sps = 1.0 / dt
             self.steps_per_sec = sps if self.steps_per_sec is None else (

@@ -293,6 +293,29 @@ class MetricsRegistry:
                 out.append(rec)
         return out
 
+    def drain_records(self) -> List[dict]:
+        """Snapshot + clear as ONE atomic step under the registry lock —
+        the delta-flush primitive behind ``flush_metrics(reset=True)``.
+
+        Two guarantees the naive records-then-reset sequence lacks, both
+        pinned by tests/L0/test_observability.py's concurrency test:
+
+        * atomicity — an increment racing the flush lands either in the
+          returned batch or in the next one, never in neither (instrument
+          writes take the same registry lock);
+        * identity — instruments are cleared IN PLACE, not dropped, so a
+          recorder that already fetched its Counter/Histogram keeps
+          incrementing the registered object instead of an orphan whose
+          counts would vanish. Consequence: histogram bucket
+          declarations and instrument types survive a delta flush
+          (they describe the series, not its data) — only ``reset()``
+          forgets them."""
+        with self._lock:
+            records = self.records()
+            for inst in self._instruments.values():
+                inst._series.clear()
+        return records
+
     def reset(self) -> None:
         with self._lock:
             self._instruments.clear()

@@ -134,15 +134,19 @@ def flush_metrics(registry: Optional[MetricsRegistry] = None,
                   reset: bool = False) -> List[dict]:
     """Snapshot ``registry`` (default: the process registry) into ``sink``
     (default: resolved from env; no-op when disabled). Returns the
-    records written. ``reset=True`` clears the registry afterwards —
-    delta-style flushing for long-running loops."""
+    records written. ``reset=True`` drains instead of snapshotting —
+    delta-style flushing for long-running loops, with the snapshot and
+    the clear ATOMIC under the registry lock (``drain_records``): an
+    increment racing the flush lands in this delta or the next, never
+    in neither, and instruments are cleared in place (histogram bucket
+    declarations survive the delta; only ``registry.reset()`` forgets
+    them). An empty registry flushes nothing (no file touched, no
+    empty batch written — the sinks' ``write([])`` contract)."""
     registry = registry or default_registry()
     if sink is None:
         sink = sink_from_env()
         if sink is None:
             return []
-    records = registry.records()
+    records = registry.drain_records() if reset else registry.records()
     sink.write(records)
-    if reset:
-        registry.reset()
     return records

@@ -1,0 +1,263 @@
+"""Host-side tracer — labeled spans + instant events on monotonic clocks.
+
+The metrics registry (registry.py) answers "how much"; this module
+answers "what happened when": a process-global :class:`Tracer` records
+labeled SPANS (a name + start + duration) and INSTANT events into a
+bounded ring buffer, so every serving request, training step, drain and
+planner search leaves a timeline the exporters (trace_export.py ->
+Perfetto, events.py -> postmortem JSONL) can replay.
+
+Design constraints (the registry's discipline, verbatim):
+
+* **Host-side only.** Nothing here is ever traced by jax; call sites
+  live in host loops (the serving session, goodput's step timer, the
+  fleet router) or at trace time. The jitted programs' HLO is
+  bitwise-identical with tracing on or off — pinned by
+  tests/L0/test_tracing.py.
+* **Monotonic clocks.** Timestamps and durations come from
+  ``time.perf_counter`` — never ``time.time`` (wall clocks step under
+  NTP; analysis rule APX107 machine-checks the whole package for
+  wall-clock duration math). A single wall-clock anchor taken at
+  tracer creation maps the monotonic timeline to absolute time for
+  file naming and cross-process correlation.
+* **Disabled ⇒ one flag check per event.** ``APEX_TPU_TRACE`` (via
+  utils/envvars, re-read at call time like APEX_TPU_METRICS_SINK)
+  gates every recorder; unset/0 means each helper is a dict lookup and
+  a return.
+* **Bounded.** Events land in a ring of ``APEX_TPU_TRACE_RING``
+  (default 4096) entries — the flight-recorder property: always cheap
+  to feed, never grows, and at a crash the last N events ARE the story
+  (events.dump_postmortem). The ring size is latched when the first
+  event is recorded (or at ``clear()``).
+
+Spans nest per thread: :meth:`Tracer.span` keeps a thread-local stack,
+so each recorded span carries its parent and depth (Perfetto nests
+same-track "X" events by time, but the explicit parent makes postmortem
+text dumps readable without a renderer). ``span`` is ALSO the
+profiler seam: it enters ``utils/profiling.host_trace_range`` (lazily
+imported — this module stays stdlib-only when jax is absent), so every
+tracer span shows up as a jax profiler ``TraceAnnotation`` whenever a
+profiler capture is running — one instrumentation point, two backends.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterator, List, Optional
+
+from apex_tpu.utils.envvars import env_flag, env_int
+
+__all__ = [
+    "DEFAULT_RING",
+    "Tracer",
+    "add_span",
+    "default_tracer",
+    "trace_event",
+    "trace_span",
+    "tracing_enabled",
+]
+
+DEFAULT_RING = 4096
+
+
+def tracing_enabled() -> bool:
+    """The gate every recorder consults, resolved at CALL time:
+    ``APEX_TPU_TRACE=1`` enables (unset/0 = off, the default)."""
+    return bool(env_flag("APEX_TPU_TRACE", default=False))
+
+
+# the jax-profiler seam, imported lazily so this module (and the
+# postmortem reader) work in jax-free processes. host_trace_range
+# checks profiling_enabled() itself — a tracer span therefore emits a
+# TraceAnnotation exactly when a profiler capture would see it.
+_SEAM = None
+
+
+def _profiler_seam(name: str):
+    global _SEAM
+    if _SEAM is None:
+        try:
+            from apex_tpu.utils.profiling import host_trace_range
+            _SEAM = host_trace_range
+        except Exception:  # pragma: no cover — jax-free host
+            _SEAM = _null_seam
+    return _SEAM(name)
+
+
+@contextlib.contextmanager
+def _null_seam(name: str) -> Iterator[None]:
+    yield
+
+
+class Tracer:
+    """Span/event recorder over a bounded ring.
+
+    ``enabled=None`` (the default tracer) follows the ``APEX_TPU_TRACE``
+    env gate at every call; True/False force it (tests, the bench
+    harness). ``ring`` overrides ``APEX_TPU_TRACE_RING``.
+
+    Event records are plain dicts (json-safe):
+
+    ``{"ph": "X"|"i", "name": str, "ts": float, "dur": float ("X"),
+    "seq": int, "thread": int, "depth": int, "parent": str|None,
+    "labels": {str: str|int|float}}``
+
+    ``ts``/``dur`` are ``perf_counter`` seconds; ``wall_anchor()``
+    returns the (perf_counter, wall) pair taken at construction so
+    consumers can place the timeline in absolute time.
+    """
+
+    def __init__(self, *, enabled: Optional[bool] = None,
+                 ring: Optional[int] = None):
+        self._enabled = enabled
+        self._ring_size = ring
+        self._ring: Optional[deque] = None
+        self._lock = threading.Lock()
+        self._seq = itertools.count()
+        self._tls = threading.local()
+        self._anchor = (time.perf_counter(), time.time())
+
+    # -- state -------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        if self._enabled is not None:
+            return self._enabled
+        return tracing_enabled()
+
+    def wall_anchor(self) -> tuple:
+        """(perf_counter, wall-clock) pair from tracer creation: maps a
+        monotonic ``ts`` to wall time as ``wall + (ts - perf)``."""
+        return self._anchor
+
+    def _buf(self) -> deque:
+        if self._ring is None:
+            n = self._ring_size if self._ring_size is not None else \
+                env_int("APEX_TPU_TRACE_RING", default=DEFAULT_RING)
+            self._ring = deque(maxlen=n)
+        return self._ring
+
+    def _stack(self) -> List[str]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _record(self, rec: dict) -> None:
+        with self._lock:
+            rec["seq"] = next(self._seq)
+            self._buf().append(rec)
+
+    # -- recorders ---------------------------------------------------
+    def event(self, name: str, **labels) -> None:
+        """Record an instant event (disabled: one flag check)."""
+        if not self.enabled:
+            return
+        st = self._stack()
+        self._record({
+            "ph": "i", "name": name, "ts": time.perf_counter(),
+            "thread": threading.get_ident(), "depth": len(st),
+            "parent": st[-1] if st else None, "labels": labels,
+        })
+
+    def add_span(self, name: str, t0: float, dur: float, **labels) -> None:
+        """Record an ALREADY-TIMED span (``t0``/``dur`` in perf_counter
+        seconds) — for callers that measure anyway (goodput's step
+        timer), so the disabled path stays one flag check with no
+        context-manager machinery."""
+        if not self.enabled:
+            return
+        st = self._stack()
+        self._record({
+            "ph": "X", "name": name, "ts": t0, "dur": dur,
+            "thread": threading.get_ident(), "depth": len(st),
+            "parent": st[-1] if st else None, "labels": labels,
+        })
+
+    @contextlib.contextmanager
+    def span(self, name: str, **labels) -> Iterator[None]:
+        """Labeled span around a block. Always enters the jax-profiler
+        seam (``host_trace_range`` — a TraceAnnotation when profiling
+        is on, a no-op otherwise); records into the ring only when
+        tracing is enabled. A span whose body raises is still recorded,
+        labeled ``error=<type>`` — exactly what the flight recorder
+        wants to see last."""
+        if not self.enabled:
+            with _profiler_seam(name):
+                yield
+            return
+        st = self._stack()
+        parent = st[-1] if st else None
+        depth = len(st)
+        st.append(name)
+        t0 = time.perf_counter()
+        err: Optional[str] = None
+        try:
+            with _profiler_seam(name):
+                yield
+        except BaseException as e:
+            err = type(e).__name__
+            raise
+        finally:
+            st.pop()
+            dur = time.perf_counter() - t0
+            if err is not None:
+                labels = dict(labels, error=err)
+            self._record({
+                "ph": "X", "name": name, "ts": t0, "dur": dur,
+                "thread": threading.get_ident(), "depth": depth,
+                "parent": parent, "labels": labels,
+            })
+
+    # -- readers -----------------------------------------------------
+    def events(self) -> List[dict]:
+        """Snapshot of the ring in record order (oldest first). Plain
+        dicts, json-safe."""
+        with self._lock:
+            if self._ring is None:
+                return []
+            return [dict(r) for r in self._ring]
+
+    def last_seq(self) -> int:
+        """Sequence number of the newest recorded event (-1 when
+        empty) — what postmortem epilogues split the timeline on."""
+        with self._lock:
+            if not self._ring:
+                return -1
+            return self._ring[-1]["seq"]
+
+    def clear(self) -> None:
+        """Drop every recorded event AND the ring itself, so the next
+        event re-reads ``APEX_TPU_TRACE_RING`` (tests resize this
+        way)."""
+        with self._lock:
+            self._ring = None
+
+
+_DEFAULT = Tracer()
+
+
+def default_tracer() -> Tracer:
+    """The process-wide tracer every built-in span/event records into
+    (serving session, fleet router, goodput, planner). Follows the
+    ``APEX_TPU_TRACE`` env gate."""
+    return _DEFAULT
+
+
+# -- the hot-path helpers (single flag check, then dispatch) ------------
+
+def trace_event(name: str, **labels) -> None:
+    _DEFAULT.event(name, **labels)
+
+
+def trace_span(name: str, **labels):
+    """Context manager: span on the default tracer (and the profiler
+    seam — see Tracer.span)."""
+    return _DEFAULT.span(name, **labels)
+
+
+def add_span(name: str, t0: float, dur: float, **labels) -> None:
+    _DEFAULT.add_span(name, t0, dur, **labels)

@@ -114,15 +114,16 @@ from apex_tpu.transformer.tensor_parallel.mappings import (
     copy_to_tensor_model_parallel_region,
 )
 from apex_tpu.observability import (
-    TIME_BUCKETS,
     default_registry,
     inc_counter,
     metrics_enabled,
     observe,
     set_gauge,
 )
+from apex_tpu.observability import events as obs_events
+from apex_tpu.observability.tracing import trace_span
 from apex_tpu.utils.envvars import env_flag, env_int
-from apex_tpu.utils.profiling import host_trace_range, trace_range
+from apex_tpu.utils.profiling import trace_range
 
 # serving/chunk_utilization histogram: fraction of the step budget
 # actually carrying query tokens
@@ -626,6 +627,13 @@ class ServingSession:
         # session records happens OUTSIDE the jitted step, so the step
         # HLO and the one-compile contract are untouched with metrics on
         self.kv_free_min = self.sched.free_blocks
+        # SLO-aligned histogram boundaries, frozen at the series' first
+        # observation (registry contract): the latency-class targets are
+        # bucket EDGES, so violation rates read straight off the
+        # cumulative _bucket rows (docs/observability.md)
+        targets = slo_mod.targets_for(slo_mod.LATENCY)
+        self._ttft_buckets = slo_mod.slo_buckets(targets.ttft_s)
+        self._tpot_buckets = slo_mod.slo_buckets(targets.tpot_s)
         if metrics_enabled():
             # materialize the event counters at 0 — with the SAME label
             # shape the real increments carry — so a quiet run still
@@ -660,8 +668,8 @@ class ServingSession:
                           replica=eng.replica)
 
     # -- intake ------------------------------------------------------
-    def add(self, req: Request) -> None:
-        """Queue a fresh request into this session (validated here so a
+    def _intake(self, req: Request) -> None:
+        """Validate + queue (shared by fresh and resumed intake, so a
         bad request raises before anything prefills)."""
         s = self.eng.scfg
         if len(req.prompt) + req.max_new_tokens > s.max_seq_len:
@@ -671,16 +679,28 @@ class ServingSession:
                 f"max_seq_len {s.max_seq_len}")
         self.sched.add(req)
 
+    def add(self, req: Request) -> None:
+        """Queue a fresh request into this session — the lifecycle's
+        ``request.submit`` event."""
+        self._intake(req)
+        obs_events.request_event(obs_events.SUBMIT, req.rid,
+                                 self.eng.replica,
+                                 slo=slo_mod.resolve_class(req.slo))
+
     def add_resumed(self, req: Request, prior: List[int]) -> None:
         """Queue a RESUME-shaped request (its prompt already ends with
         the ``prior`` tokens an earlier placement emitted; its
         max_new_tokens counts only the remainder) — the fault-requeue
         entry the Router uses. The session stitches ``prior`` back onto
         the front of the tokens at finish, so the request's final output
-        is the uninterrupted run's."""
+        is the uninterrupted run's. Emits ``request.resume`` (NOT a
+        second submit — the chain validator wants exactly one submit
+        per rid across placements)."""
         if prior:
             self._prior[req.rid] = list(prior)
-        self.add(req)
+        self._intake(req)
+        obs_events.request_event(obs_events.RESUME, req.rid,
+                                 self.eng.replica, prior=len(prior))
 
     def has_work(self) -> bool:
         return self.sched.has_work()
@@ -704,7 +724,8 @@ class ServingSession:
         """Extract every UNFINISHED request as a ``(resume_request,
         prior_tokens)`` pair (host state only — the device cache is left
         alone; the caller resets the engine). The Router feeds these to
-        surviving replicas via ``add_resumed`` after a replica fault."""
+        surviving replicas via ``add_resumed`` after a replica fault.
+        Each pair is the lifecycle's ``request.drain`` event."""
         items: List[tuple] = []
         for req in list(self.sched._future) + list(self.sched._waiting):
             items.append((req, self._prior.get(req.rid, [])))
@@ -717,7 +738,36 @@ class ServingSession:
                 prompt=list(st.req.prompt) + list(emitted),
                 max_new_tokens=st.req.max_new_tokens - len(emitted),
                 arrival=0, slo=st.req.slo), prior))
+        for req, prior in items:
+            obs_events.request_event(obs_events.DRAIN, req.rid,
+                                     self.eng.replica, emitted=len(prior))
         return items
+
+    def state_summary(self) -> dict:
+        """Host-mirror state snapshot for the flight recorder: slots
+        with their seq_lens/prefill progress, queue depth, pool
+        occupancy — every number read off the scheduler's python
+        mirror, NEVER a device sync (the postmortem dump must be safe
+        to take while the device is wedged)."""
+        sched = self.sched
+        sig = self.signals()
+        return {
+            "replica": self.eng.replica,
+            "step": self.step,
+            "queue_depth": int(sig["queue_depth"]),
+            "free_blocks": int(sig["free_blocks"]),
+            "kv_occupancy": round(float(sig["kv_occupancy"]), 6),
+            "slots": {
+                str(slot): {
+                    "rid": str(st.req.rid),
+                    "seq_len": st.tokens_in_cache,
+                    "prefilled": st.prefilled,
+                    "n_blocks": st.n_blocks,
+                    "slo_rank": st.slo_rank,
+                }
+                for slot, st in sorted(sched.running.items())
+            },
+        }
 
     # -- preemption / finish ----------------------------------------
     def _preempt(self, slot: int) -> None:
@@ -744,6 +794,11 @@ class ServingSession:
         self.stats["requeues"] += 1
         inc_counter("fleet/requeues", 1, reason="preemption",
                     replica=eng.replica)
+        obs_events.request_event(obs_events.PREEMPT, req.rid,
+                                 eng.replica, slot=slot,
+                                 emitted=len(emitted))
+        obs_events.request_event(obs_events.REQUEUE, req.rid,
+                                 eng.replica, reason="preemption")
 
     def _finish(self, slot: int) -> None:
         eng = self.eng
@@ -788,6 +843,8 @@ class ServingSession:
             self.stats["slo_violations"] += 1
             inc_counter("fleet/slo_violations", 1, slo=cls, kind=kind,
                         replica=eng.replica)
+        obs_events.request_event(obs_events.FINISH, rid, eng.replica,
+                                 slot=slot, tokens=len(tokens))
 
     # -- one tick of the loop ---------------------------------------
     def step_once(self) -> None:
@@ -823,8 +880,12 @@ class ServingSession:
         for adm in admissions:
             observe("fleet/queue_wait_s",
                     now_adm - self.waiting_since.get(adm.req.rid, now_adm),
-                    buckets=TIME_BUCKETS, replica=rep,
+                    buckets=self._ttft_buckets, replica=rep,
                     slo=slo_mod.resolve_class(adm.req.slo))
+            obs_events.request_event(
+                obs_events.ADMIT, adm.req.rid, rep, slot=adm.slot,
+                prefix="hit" if adm.shared_ids else "miss",
+                shared_blocks=len(adm.shared_ids))
         for b in eng._batched(sched.drain_releases()):
             self.cache = eng._release(self.cache, eng._ids_row(b),
                                       jnp.int32(len(b)))
@@ -883,9 +944,17 @@ class ServingSession:
                             drafts[w.slot][:w.n - 1]
                 off += w.n
             t0 = time.perf_counter()
-            # host-side profiler seam: marks the dispatch+wait span
-            # in host traces without touching the compiled program
-            with host_trace_range("serving.unified_step"):
+            # tracer span over the dispatch+wait window — recorded in
+            # the ring when APEX_TPU_TRACE=1 AND (through the
+            # host_trace_range seam inside trace_span) marked in host
+            # profiler traces when profiling is on; the compiled
+            # program is untouched either way (HLO pinned)
+            with trace_span("serving.unified_step", replica=rep, step=step,
+                            tokens=off,
+                            decodes=sum(1 for w in work
+                                        if w.kind == "decode"),
+                            chunks=sum(1 for w in work
+                                       if w.kind == "chunk")):
                 self.cache, nxt = eng._step(
                     eng.params, self.cache, jnp.asarray(tokens),
                     jnp.asarray(qs), jnp.asarray(ql))
@@ -909,6 +978,10 @@ class ServingSession:
             for w in work:
                 st = sched.running[w.slot]
                 rid = st.req.rid
+                if w.kind == "chunk":
+                    obs_events.request_event(
+                        obs_events.PREFILL_CHUNK, rid, rep, slot=w.slot,
+                        n=w.n, completes=int(w.completes_prompt))
                 if w.kind == "decode" and w.n > 1:
                     # speculative verify: greedy longest-prefix
                     # acceptance — row j's output is the model's
@@ -941,6 +1014,10 @@ class ServingSession:
                                 replica=rep)
                     observe("serving/spec_accept_rate", acc / nd,
                             buckets=SPEC_BUCKETS, replica=rep)
+                    obs_events.request_event(
+                        obs_events.SPEC_VERIFY, rid, rep, slot=w.slot,
+                        drafted=nd, accepted=acc,
+                        emitted=len(emitted))
                     fin = (len(gen[w.slot])
                            >= st.req.max_new_tokens
                            or emitted[-1] == s.eos_id)
@@ -961,6 +1038,8 @@ class ServingSession:
                     out[rid]["steps"] = step
                     stats["decode_tokens"] += 1
                     dec_emitted += 1
+                    obs_events.request_event(obs_events.DECODE, rid,
+                                             rep, slot=w.slot)
                     if (len(gen[w.slot]) >= st.req.max_new_tokens
                             or tok == s.eos_id):
                         self._finish(w.slot)
@@ -977,9 +1056,12 @@ class ServingSession:
                     else:
                         ttft = now - self.waiting_since.get(rid, t0)
                         observe("serving/ttft_s", ttft,
-                                buckets=TIME_BUCKETS, replica=rep)
+                                buckets=self._ttft_buckets, replica=rep)
                         out[rid] = {"ttft_step": step, "steps": step,
                                     "ttft_s": ttft}
+                        obs_events.request_event(
+                            obs_events.FIRST_TOKEN, rid, rep,
+                            slot=w.slot)
                     self._first_tok.setdefault(rid, now)
                     if st.req.max_new_tokens == 1 or tok == s.eos_id:
                         self._finish(w.slot)
@@ -994,7 +1076,7 @@ class ServingSession:
                 # cost across them, keeping TPOT honest spec-on
                 observe("serving/tpot_s",
                         dt * n_dec / max(dec_emitted, 1),
-                        buckets=TIME_BUCKETS, replica=rep)
+                        buckets=self._tpot_buckets, replica=rep)
         self.kv_free_min = min(self.kv_free_min, sched.free_blocks)
         set_gauge("serving/kv_blocks_free", sched.free_blocks, replica=rep)
         set_gauge("serving/kv_occupancy",

@@ -52,6 +52,8 @@ from apex_tpu.observability import (
     inc_counter,
     metrics_enabled,
 )
+from apex_tpu.observability import events as obs_events
+from apex_tpu.observability import tracing as obs_tracing
 from apex_tpu.serving.engine import ServingConfig, ServingEngine
 from apex_tpu.serving.fleet import slo
 from apex_tpu.serving.fleet.replica import FaultPlan, Replica
@@ -94,6 +96,7 @@ class Router:
         self._harvested: Dict[object, dict] = {}
         self._requeues = 0
         self._faults: List[dict] = []
+        self._postmortems: List[str] = []
 
     # -- lifecycle ---------------------------------------------------
     def set_fault_plan(self, plan: Optional[FaultPlan]) -> None:
@@ -116,6 +119,7 @@ class Router:
         self._harvested = {}
         self._requeues = 0
         self._faults = []
+        self._postmortems = []
         if metrics_enabled():
             # materialize the fleet series at 0 — one series per label
             # combination a drive can emit — so a quiet drive still
@@ -167,16 +171,60 @@ class Router:
         return rep.rid
 
     # -- fault handling ----------------------------------------------
+    def _state_summary(self, failing: Optional[Replica] = None) -> dict:
+        """Fleet-wide host-mirror snapshot for the flight recorder
+        (slots, seq_lens, queue depths, pool occupancy — zero device
+        syncs; ServingSession.state_summary). ``failing`` marks the
+        replica whose step just raised."""
+        out: Dict[str, object] = {"replicas": {}}
+        for rep in self.replicas:
+            if rep.session is None:
+                out["replicas"][str(rep.rid)] = {"alive": rep.alive,
+                                                 "session": None}
+            else:
+                s = rep.session.state_summary()
+                s["alive"] = rep.alive
+                out["replicas"][str(rep.rid)] = s
+        if failing is not None:
+            out["failed_replica"] = failing.rid
+            out["failed_local_step"] = failing.local_step
+        return out
+
     def _on_fault(self, rep: Replica, err: Exception) -> None:
-        self._faults.append({
+        fault = {
             "replica": rep.rid, "local_step": rep.local_step,
-            "error": f"{type(err).__name__}: {err}"})
+            "error": f"{type(err).__name__}: {err}"}
+        self._faults.append(fault)
         inc_counter("fleet/replica_faults", 1, replica=str(rep.rid))
+        obs_tracing.trace_event("fleet.replica_fault",
+                                replica=str(rep.rid),
+                                step=rep.local_step,
+                                error=type(err).__name__)
+        # flight-recorder state is captured BEFORE the drain tears the
+        # dying session down — this is the crash instant the postmortem
+        # preserves
+        state = (self._state_summary(failing=rep)
+                 if obs_tracing.tracing_enabled() else None)
         # finished results survive the replica: harvest before drain
         for rid, v in rep.session.out.items():
             if rid is not None and "tokens" in v:
                 self._harvested[rid] = v
         items = rep.fail()
+        if state is not None:
+            # dump ring + registry + state summary NOW (the drain/resume
+            # events that follow land in the drive-end epilogue) — the
+            # drained rids ride the state record so a replay knows which
+            # chains must complete on the survivors
+            state["drained"] = [str(req.rid) for req, _ in items]
+            try:
+                path = obs_events.dump_postmortem(
+                    reason=f"replica {rep.rid} fault at local step "
+                           f"{rep.local_step}: {fault['error']}",
+                    state=state)
+                fault["postmortem"] = str(path)
+                self._postmortems.append(str(path))
+            except OSError as e:  # a full disk must not kill recovery
+                fault["postmortem_error"] = f"{type(e).__name__}: {e}"
         if not any(r.alive for r in self.replicas):
             raise RuntimeError(
                 "fleet: every replica has faulted") from err
@@ -242,6 +290,17 @@ class Router:
             raise RuntimeError(
                 f"fleet conservation violated: missing={sorted(map(str, missing))} "
                 f"unexpected={sorted(map(str, extra))}")
+        # close the flight-recorder loop: the drive completed, so every
+        # crash dump gains an epilogue — the events recorded since the
+        # dump (drain -> resume -> ... -> finish on the survivors) plus
+        # the recovered state, making the postmortem's per-request
+        # chains replayable end to end (tests + the graft trace leg)
+        for path in self._postmortems:
+            try:
+                obs_events.append_epilogue(
+                    path, state=self._state_summary())
+            except OSError:
+                pass
         results[None] = {
             "replicas": stats_by_replica,
             "fleet_steps": steps,
@@ -252,6 +311,7 @@ class Router:
             "slo_violations": sum(s["slo_violations"]
                                   for s in stats_by_replica.values()),
             "faults": list(self._faults),
+            "postmortems": list(self._postmortems),
             "dead_replicas": [r.rid for r in self.replicas
                               if not r.alive],
             "placements": dict(self._placements),

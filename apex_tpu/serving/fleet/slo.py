@@ -49,6 +49,7 @@ __all__ = [
     "SLOTargets",
     "rank_of",
     "resolve_class",
+    "slo_buckets",
     "targets_for",
     "violations",
 ]
@@ -103,6 +104,30 @@ def targets_for(name: str) -> SLOTargets:
             tpot_s=env_float("APEX_TPU_SLO_LATENCY_TPOT_S", default=0.1))
     rank_of(name)  # validate
     return SLOTargets()
+
+
+# the fractions of a target the SLO-aligned histogram boundaries sit at:
+# four buckets under the target (how much headroom), the target itself
+# (the violation edge is a bucket EDGE, so violation counts read exactly
+# off the cumulative histogram), and five over (how bad the misses are)
+_BUCKET_FRACTIONS = (0.125, 0.25, 0.5, 0.75, 1.0, 1.5, 2.0, 4.0, 8.0,
+                     16.0)
+
+
+def slo_buckets(target_s: float) -> tuple:
+    """Histogram upper bounds aligned to an SLO target: the target is
+    one of the boundaries, with sub-target buckets below and escalating
+    miss buckets above — ``serving/ttft_s``, ``serving/tpot_s`` and
+    ``fleet/queue_wait_s`` declare these at first use
+    (docs/observability.md), so a dashboard reads the violation rate
+    straight off ``_bucket{le="<target>"}`` vs ``_count``. Registry
+    bucket boundaries freeze at a series' first observation; changing
+    the SLO env targets mid-process therefore raises on the next
+    observation unless the registry was reset — the documented
+    conflicting-redeclare contract."""
+    if not target_s or target_s <= 0:
+        raise ValueError(f"slo_buckets: target {target_s!r} must be > 0")
+    return tuple(round(target_s * f, 9) for f in _BUCKET_FRACTIONS)
 
 
 def violations(name: str, ttft_s: Optional[float],

@@ -81,6 +81,7 @@ from collections import deque
 from typing import Deque, Dict, Iterable, List, Optional
 
 from apex_tpu.observability import inc_counter
+from apex_tpu.observability import events as obs_events
 from apex_tpu.serving.fleet import slo as slo_mod
 from apex_tpu.serving.kv_cache import PrefixIndex, blocks_needed
 
@@ -221,9 +222,14 @@ class Scheduler:
         self._future.sort(key=lambda r: r.arrival)
 
     def tick(self, step: int) -> None:
-        """Move requests whose arrival step has come into the wait queue."""
+        """Move requests whose arrival step has come into the wait
+        queue (each move is the ``request.queue`` lifecycle event —
+        docs/serving.md's table; one flag check when tracing is off)."""
         while self._future and self._future[0].arrival <= step:
-            self._waiting.append(self._future.pop(0))
+            req = self._future.pop(0)
+            self._waiting.append(req)
+            obs_events.request_event(obs_events.QUEUE, req.rid,
+                                     self.replica, step=step)
 
     def has_work(self) -> bool:
         return bool(self._future or self._waiting or self.running)

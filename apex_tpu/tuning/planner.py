@@ -620,10 +620,21 @@ def plan(shape: ModelShape, n_devices: int, *, device: str = "cpu",
     ``top_k`` feasible plans are found or ``max_memory_traces`` traces
     are spent. Budget: ``hbm_budget_gb`` arg >
     ``APEX_TPU_ANALYSIS_HBM_GB`` > the device kind's HBM capacity."""
+    from apex_tpu.observability.tracing import trace_span
+
     if hbm_budget_gb is None:
         hbm_budget_gb = env_float("APEX_TPU_ANALYSIS_HBM_GB")
     budget = (float(hbm_budget_gb) * GiB if hbm_budget_gb is not None
               else cost_model.device_hbm_bytes(device))
+    with trace_span("tuning.plan_search", shape=shape.name,
+                    devices=n_devices, device=device):
+        return _plan_ranked(shape, n_devices, device, budget,
+                            microbatches, top_k, max_memory_traces, log)
+
+
+def _plan_ranked(shape: ModelShape, n_devices: int, device: str,
+                 budget: float, microbatches: Optional[int], top_k: int,
+                 max_memory_traces: int, log) -> List[Plan]:
     cands = enumerate_configs(shape, n_devices,
                               microbatches=microbatches)
     if not cands:
@@ -770,7 +781,10 @@ def execute_plan(p: Plan, *, devices=None, steps: int = 2,
             "the executed leg drives dense dp x tp x pp plans; EP "
             "execution rides the MoE dryrun leg")
 
-    with _scoped_env(cfg.env_gates):
+    from apex_tpu.observability.tracing import trace_span
+
+    with trace_span("tuning.plan_execute", config=cfg.tag,
+                    model=p.shape.name), _scoped_env(cfg.env_gates):
         if cfg.pp > 1:
             result = _execute_pipeline(p, devices, steps=steps,
                                        rtol=rtol, atol=atol)

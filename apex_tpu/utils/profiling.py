@@ -65,7 +65,13 @@ def host_trace_range(name: str) -> Iterator[None]:
     would leak into any tracing the block happens to trigger (the FIRST
     call of a jitted program traces inside the caller's context),
     renaming ops in the compiled HLO — so this marks the host timeline
-    only, leaving every traced program bitwise-identical."""
+    only, leaving every traced program bitwise-identical.
+
+    This is also THE seam ``observability.tracing.Tracer.span`` enters
+    around every tracer span: one instrumentation point feeds both the
+    tracer ring (``APEX_TPU_TRACE``) and the jax profiler timeline
+    (``APEX_TPU_PROF`` / an active capture) — instrument once, see it
+    in the flight recorder, the Perfetto export AND TensorBoard."""
     if profiling_enabled():
         with jax.profiler.TraceAnnotation(name):
             yield

@@ -86,7 +86,21 @@ _PLAN_METRIC = "apex_tpu_plan_projected_vs_measured"
 # wants numbers) and emit() flushes them through a JSONL sink next to
 # the BENCH_*.json artifacts (APEX_TPU_METRICS_PATH overrides). All
 # best-effort: telemetry must never cost the bench its one JSON line.
+#
+# Tracing rides along the same way: the bench arms APEX_TPU_TRACE for
+# its own process (explicit operator setting wins — setdefault, so
+# APEX_TPU_TRACE=0 turns it off), so every serving/fleet/goodput span
+# the rungs exercise lands in the tracer ring, and emit() writes the
+# Perfetto export (BENCH_TRACE.json, gitignored) next to
+# BENCH_METRICS.jsonl — any future hardware run ships a timeline
+# alongside its numbers. Cost inside timed windows: ~1 µs host work
+# per event against ms-scale steps, and BOTH sides of every A/B rung
+# run equally traced, so the comparisons the bench gates on stay fair;
+# an absolute-throughput ladder chasing the last fraction of a percent
+# can re-measure with APEX_TPU_TRACE=0.
+os.environ.setdefault("APEX_TPU_TRACE", "1")
 _OBS_REG = None
+_TRACE_ARTIFACT = "BENCH_TRACE.json"
 
 
 def _obs():
@@ -125,6 +139,14 @@ def _obs_flush() -> None:
         flush_metrics(_OBS_REG, JSONLSink(path))
     except Exception as e:  # noqa: BLE001
         print(f"bench: metrics flush failed: {e}", file=sys.stderr)
+    try:
+        from apex_tpu.observability import default_tracer
+        from apex_tpu.observability.trace_export import write_chrome_trace
+
+        if default_tracer().events():
+            write_chrome_trace(_TRACE_ARTIFACT, registry=_OBS_REG)
+    except Exception as e:  # noqa: BLE001 — the timeline is a bonus
+        print(f"bench: trace export failed: {e}", file=sys.stderr)
 
 
 def emit(payload: dict) -> None:
@@ -1150,8 +1172,45 @@ def _obs_compile_rung(on_cpu: bool, timeout_s: float) -> dict:
                   f"marked skipped ({msg})", file=sys.stderr, flush=True)
             rung.update(ok=False, skipped=True, error=msg)
         else:
+            # the tracing-off-path pin, surfaced in the gate: the SAME
+            # step must lower byte-identical with APEX_TPU_TRACE=1 vs
+            # unset, and a goodput-wrapped jit must still compile
+            # exactly ONCE with tracing armed (spans are host-side —
+            # zero extra compiles; tests/L0/test_tracing.py holds the
+            # engine-step version of this pin)
+            from apex_tpu.observability import GoodputTracker
+
+            saved_trace = os.environ.pop("APEX_TPU_TRACE", None)
+            try:
+                hlo_off = jax.jit(step).lower(w, buf).as_text()
+                os.environ["APEX_TPU_TRACE"] = "1"
+                hlo_on = jax.jit(step).lower(w, buf).as_text()
+                tracker = GoodputTracker()
+                traced = jax.jit(tracker.wrap_step(step))
+                for _ in range(2):
+                    with tracker.step():
+                        jax.block_until_ready(traced(w, buf)[0])
+                trace_compiles = tracker.compiles
+            finally:
+                if saved_trace is None:
+                    os.environ.pop("APEX_TPU_TRACE", None)
+                else:
+                    os.environ["APEX_TPU_TRACE"] = saved_trace
+            trace_ok = (hlo_off == hlo_on) and trace_compiles == 1
+            rung.update(trace_hlo_identical=(hlo_off == hlo_on),
+                        trace_compiles=trace_compiles)
+            if not trace_ok:
+                print(f"bench: compile-only rung observability: FAILED "
+                      f"— APEX_TPU_TRACE=1 changed the program "
+                      f"(hlo_identical={hlo_off == hlo_on}, "
+                      f"compiles={trace_compiles})",
+                      file=sys.stderr, flush=True)
+                rung.update(ok=False)
+                return rung
             print(f"bench: compile-only rung observability: OK "
-                  f"({compile_s:.1f}s)", file=sys.stderr, flush=True)
+                  f"({compile_s:.1f}s, trace-on HLO identical, "
+                  f"{trace_compiles} compile with tracing armed)",
+                  file=sys.stderr, flush=True)
             rung.update(ok=True, compile_s=round(compile_s, 1))
     except Exception as e:  # noqa: BLE001 — a failing rung is data
         print(f"bench: compile-only rung observability: FAILED — marked "

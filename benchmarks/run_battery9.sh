@@ -155,6 +155,19 @@ run moe_bench     3600 '"ok": true' python bench.py --moe
 run obs_smoke     1800 'telemetry leg: OK' env \
                        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
                        python -c 'import __graft_entry__ as g; g.dryrun_telemetry(8)'
+# 4e' — request-tracing / flight-recorder leg (tracing PR): a
+#      fault-injected N=2 fleet drive with APEX_TPU_TRACE=1 must dump a
+#      postmortem (tracer ring + registry snapshot + host-mirror state
+#      summary) whose per-request event chains replay COMPLETE through
+#      load_postmortem after the drive-end epilogue (submit on the dead
+#      replica, drain -> resume -> finish on the survivor), the
+#      Perfetto export must validate against the trace-event schema,
+#      and the Prometheus rendering must parse back. The tracing-off
+#      HLO identity pin also rides the overlap_gate compile-only item
+#      above (the observability rung asserts trace-on lowering is
+#      byte-identical and compiles once).
+run trace_leg     1800 'trace leg: OK' \
+                       python -c 'import __graft_entry__ as g; g.dryrun_trace()'
 # 4f — static-analysis self-check (analysis PR): the full self-run
 #      (trace-hygiene lint + jaxpr auditors + peak-HBM estimator +
 #      SPMD deadlock checker) plus the SEEDED kernel-sanitizer sweep

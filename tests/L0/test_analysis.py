@@ -414,6 +414,76 @@ def test_apx106_pragma_suppresses():
 
 
 # ---------------------------------------------------------------------------
+# APX107 — wall-clock duration math
+# ---------------------------------------------------------------------------
+
+def test_apx107_fires_on_time_time_subtraction():
+    """The span-measurement bug class: t0 = time.time(); dt = time.time()
+    - t0 — the wall clock steps under NTP, so the latency sample can go
+    negative. One finding per subtraction, at the subtraction."""
+    findings = _lint("""
+        import time
+        def f():
+            t0 = time.time()
+            work()
+            dt = time.time() - t0
+            return dt
+    """)
+    [f] = [x for x in findings if x.rule == "APX107"]
+    assert f.line == 6
+    assert "perf_counter" in f.message
+
+
+def test_apx107_follows_aliases_and_import_forms():
+    # alias assigned in an OUTER scope (module level), subtracted later
+    findings = _lint("""
+        from time import time as wall
+        start = wall()
+        def g(end):
+            return end - start
+    """)
+    assert "APX107" in _rules(findings)
+    # import time as t
+    findings = _lint("""
+        import time as t
+        def f(a):
+            return a - t.time()
+    """)
+    assert "APX107" in _rules(findings)
+
+
+def test_apx107_silent_on_timestamps_and_perf_counter():
+    """time.time() as a pure timestamp (the registry's record stamps,
+    postmortem file names) and perf_counter duration math both stay
+    legal; reassigning an alias to a non-clock value clears it."""
+    findings = _lint("""
+        import time
+        def f():
+            t0 = time.perf_counter()
+            dt = time.perf_counter() - t0
+            ts = round(time.time(), 3)
+            return dt, ts
+        def g(a):
+            t0 = time.time()
+            t0 = 5
+            return a - t0
+        def h(x):
+            return x - time_budget(x)    # unrelated name, not the clock
+    """)
+    assert "APX107" not in _rules(findings)
+
+
+def test_apx107_pragma_suppresses():
+    findings = _lint("""
+        import time
+        def f(t0):
+            return time.time() - t0  # apexlint: disable=APX107
+    """)
+    assert "APX107" not in _rules(findings)
+    assert "APX107" in _rules(findings, include_suppressed=True)
+
+
+# ---------------------------------------------------------------------------
 # findings / pragma plumbing
 # ---------------------------------------------------------------------------
 
@@ -448,6 +518,7 @@ def test_layer_bits_and_exit_code():
 def test_rule_catalog_is_stable():
     assert set(RULES) == {
         "APX101", "APX102", "APX103", "APX104", "APX105", "APX106",
+        "APX107",
         "APX201", "APX202", "APX203",
         "APX301", "APX302", "APX303", "APX304", "APX305",
         "APX401", "APX402",

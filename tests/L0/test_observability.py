@@ -212,6 +212,69 @@ def test_flush_reset_gives_deltas(enabled_registry):
     assert [r["value"] for r in sink.records if r["name"] == "a"] == [1, 1]
 
 
+def test_flush_reset_racing_increments_conserves_counts(enabled_registry):
+    """The delta-flush concurrency pin: snapshot+reset is ATOMIC under
+    the registry lock (drain_records), so an increment racing a
+    flush(reset=True) lands in that delta or the next — summing every
+    flushed delta plus the live registry always equals everything
+    recorded. The old records-then-reset sequence dropped the window's
+    increments."""
+    import threading
+
+    sink = MemorySink()
+    n_threads, per_thread = 4, 500
+    stop = threading.Event()
+
+    def writer():
+        for _ in range(per_thread):
+            inc_counter("raced", 1)
+
+    def flusher():
+        while not stop.is_set():
+            flush_metrics(sink=sink, reset=True)
+
+    threads = [threading.Thread(target=writer) for _ in range(n_threads)]
+    fl = threading.Thread(target=flusher)
+    fl.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    fl.join()
+    flushed = sum(r["value"] for r in sink.records
+                  if r["name"] == "raced")
+    remaining = enabled_registry.counter("raced").value()
+    assert flushed + remaining == n_threads * per_thread
+
+
+def test_flush_empty_registry_writes_nothing(tmp_path, enabled_registry):
+    """An empty registry flushes no records and touches no file — a
+    quiet interval must not append empty batches or create artifacts."""
+    path = tmp_path / "never.jsonl"
+    assert flush_metrics(sink=JSONLSink(path)) == []
+    assert flush_metrics(sink=JSONLSink(path), reset=True) == []
+    assert not path.exists()
+    assert MemorySink().records == []
+
+
+def test_jsonl_sink_append_mode_reopen(tmp_path, enabled_registry):
+    """A NEW sink object over an existing path appends (the
+    restart-resume economy: a relaunched loop extends the artifact, it
+    never truncates history) — and the delta pump's records stay
+    parseable across the reopen."""
+    path = tmp_path / "m.jsonl"
+    inc_counter("a", 2)
+    flush_metrics(sink=JSONLSink(path), reset=True)
+    inc_counter("a", 5)
+    flush_metrics(sink=JSONLSink(path), reset=True)   # fresh sink object
+    rows = [json.loads(x) for x in path.read_text().splitlines()]
+    assert [r["value"] for r in rows if r["name"] == "a"] == [2, 5]
+    # deltas sum to the true total; timestamps are non-decreasing
+    assert sum(r["value"] for r in rows if r["name"] == "a") == 7
+    assert rows == sorted(rows, key=lambda r: r["time"])
+
+
 # ---------------------------------------------------------------------------
 # bridge: MetricsBuffer accumulate + drain
 # ---------------------------------------------------------------------------

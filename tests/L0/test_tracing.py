@@ -1,0 +1,502 @@
+"""End-to-end request tracing, fault flight recorder, and exposition:
+tracer ring semantics, the request-lifecycle chain grammar, the
+postmortem dump/replay loop over a fault-injected fleet drive, Perfetto
+trace-event schema validation, Prometheus text round-trips, SLO-aligned
+histogram boundaries — and the acceptance pin that the tracing-off path
+is byte-identical (engine step HLO equal with APEX_TPU_TRACE on vs off,
+trace_counts unchanged, zero extra compiles).
+
+Runs on the hermetic CPU mesh (tests/conftest.py)."""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.observability import default_registry
+from apex_tpu.observability import events as ev
+from apex_tpu.observability.exposition import (
+    parse_prometheus,
+    prom_name,
+    render_prometheus,
+    start_http_server,
+    write_textfile,
+)
+from apex_tpu.observability.registry import MetricsRegistry
+from apex_tpu.observability.trace_export import (
+    chrome_trace,
+    validate_chrome_trace,
+    write_chrome_trace,
+)
+from apex_tpu.observability.tracing import (
+    Tracer,
+    default_tracer,
+    tracing_enabled,
+)
+from apex_tpu.serving.fleet import slo
+
+
+@pytest.fixture
+def traced(monkeypatch):
+    """Tracing on + a clean default tracer (and registry)."""
+    monkeypatch.setenv("APEX_TPU_TRACE", "1")
+    monkeypatch.setenv("APEX_TPU_METRICS_SINK", "memory")
+    tr = default_tracer()
+    tr.clear()
+    reg = default_registry()
+    reg.reset()
+    yield tr
+    tr.clear()
+    reg.reset()
+
+
+# ---------------------------------------------------------------------------
+# tracer ring semantics
+# ---------------------------------------------------------------------------
+
+def test_disabled_tracer_records_nothing(monkeypatch):
+    monkeypatch.delenv("APEX_TPU_TRACE", raising=False)
+    assert not tracing_enabled()
+    tr = default_tracer()
+    tr.clear()
+    tr.event("e")
+    with tr.span("s"):
+        pass
+    tr.add_span("t", 0.0, 1.0)
+    assert tr.events() == []
+    monkeypatch.setenv("APEX_TPU_TRACE", "2")
+    with pytest.raises(ValueError, match="APEX_TPU_TRACE"):
+        tracing_enabled()
+
+
+def test_span_and_event_records(traced):
+    tr = traced
+    with tr.span("outer", replica="0"):
+        tr.event("mark", rid="r1")
+        with tr.span("inner"):
+            pass
+    evs = tr.events()
+    by_name = {e["name"]: e for e in evs}
+    # spans record at exit: inner closes before outer
+    assert [e["name"] for e in evs] == ["mark", "inner", "outer"]
+    assert by_name["mark"]["ph"] == "i"
+    assert by_name["mark"]["parent"] == "outer"
+    assert by_name["mark"]["depth"] == 1
+    assert by_name["inner"]["parent"] == "outer"
+    assert by_name["outer"]["ph"] == "X"
+    assert by_name["outer"]["parent"] is None
+    assert by_name["outer"]["dur"] >= by_name["inner"]["dur"] >= 0
+    # monotonic clock: nested span starts at or after its parent
+    assert by_name["inner"]["ts"] >= by_name["outer"]["ts"]
+    assert by_name["outer"]["labels"] == {"replica": "0"}
+    # seq strictly increases in record order
+    assert [e["seq"] for e in evs] == sorted(e["seq"] for e in evs)
+
+
+def test_span_records_on_exception(traced):
+    with pytest.raises(RuntimeError):
+        with traced.span("doomed", replica="1"):
+            raise RuntimeError("boom")
+    [e] = traced.events()
+    assert e["name"] == "doomed" and e["labels"]["error"] == "RuntimeError"
+
+
+def test_ring_is_bounded_and_env_sized(monkeypatch):
+    tr = Tracer(enabled=True, ring=4)
+    for i in range(10):
+        tr.event(f"e{i}")
+    evs = tr.events()
+    assert [e["name"] for e in evs] == ["e6", "e7", "e8", "e9"]
+    assert tr.last_seq() == 9                   # seqs keep counting
+    monkeypatch.setenv("APEX_TPU_TRACE_RING", "2")
+    monkeypatch.setenv("APEX_TPU_TRACE", "1")
+    tr2 = Tracer()
+    for i in range(5):
+        tr2.event(f"e{i}")
+    assert len(tr2.events()) == 2
+    monkeypatch.setenv("APEX_TPU_TRACE_RING", "nope")
+    tr3 = Tracer()
+    with pytest.raises(ValueError, match="APEX_TPU_TRACE_RING"):
+        tr3.event("x")
+
+
+# ---------------------------------------------------------------------------
+# lifecycle chain grammar
+# ---------------------------------------------------------------------------
+
+def _chain(tr, names, rid="a"):
+    for n in names:
+        tr.event(n, rid=rid, replica="0")
+    return ev.chain_for(tr.events(), rid)
+
+
+def test_chain_complete_and_incomplete():
+    tr = Tracer(enabled=True)
+    full = (ev.SUBMIT, ev.QUEUE, ev.ADMIT, ev.PREFILL_CHUNK,
+            ev.FIRST_TOKEN, ev.DECODE, ev.FINISH)
+    assert ev.chain_problems(_chain(tr, full)) == []
+    assert ev.chain_problems([]) == ["no events"]
+    # missing finish / missing admit / double submit each name themselves
+    tr2 = Tracer(enabled=True)
+    probs = ev.chain_problems(_chain(tr2, full[:-1]))
+    assert any("not finish" in p for p in probs)
+    tr3 = Tracer(enabled=True)
+    probs = ev.chain_problems(_chain(tr3, (ev.SUBMIT, ev.FINISH)))
+    assert "never admitted" in probs
+    tr4 = Tracer(enabled=True)
+    probs = ev.chain_problems(_chain(tr4, (ev.SUBMIT,) + full))
+    assert any("2 submit" in p for p in probs)
+
+
+def test_chain_interruptions_need_recovery():
+    tr = Tracer(enabled=True)
+    good = (ev.SUBMIT, ev.QUEUE, ev.ADMIT, ev.FIRST_TOKEN, ev.PREEMPT,
+            ev.REQUEUE, ev.ADMIT, ev.DECODE, ev.FINISH)
+    assert ev.chain_problems(_chain(tr, good)) == []
+    # a fault drain answered by resume on the OTHER placement is complete
+    tr2 = Tracer(enabled=True)
+    for n, rep in ((ev.SUBMIT, "1"), (ev.QUEUE, "1"), (ev.ADMIT, "1"),
+                   (ev.DRAIN, "1"), (ev.RESUME, "0"), (ev.QUEUE, "0"),
+                   (ev.ADMIT, "0"), (ev.FINISH, "0")):
+        tr2.event(n, rid="a", replica=rep)
+    assert ev.chain_problems(ev.chain_for(tr2.events(), "a")) == []
+    # an unanswered drain is a problem
+    tr3 = Tracer(enabled=True)
+    probs = ev.chain_problems(_chain(
+        tr3, (ev.SUBMIT, ev.ADMIT, ev.DRAIN, ev.FINISH)))
+    assert any("drain" in p and "resume" in p for p in probs)
+
+
+# ---------------------------------------------------------------------------
+# Perfetto / Chrome trace export
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_schema_and_rows(traced, tmp_path):
+    tr = traced
+    with tr.span("serving.unified_step", replica="0", step=0):
+        tr.event(ev.DECODE, rid="r", replica="0", slot=1)
+    tr.event(ev.SUBMIT, rid="q", replica="1")
+    tr.add_span("train.step", 0.0, 0.001, phase="run")
+    reg = default_registry()
+    reg.counter("serving/admissions").inc(2, replica="0")
+    reg.gauge("serving/kv_occupancy").set(0.5, replica="0")
+
+    doc = chrome_trace(tr, reg)
+    assert validate_chrome_trace(doc) == []
+    evs = doc["traceEvents"]
+    # per-replica process rows + the host row, named by metadata
+    proc_names = {e["args"]["name"] for e in evs
+                  if e["ph"] == "M" and e["name"] == "process_name"}
+    assert {"host", "replica 0", "replica 1"} <= proc_names
+    # per-slot thread: the slot-1 decode rides tid 3 of replica 0's pid
+    decode = next(e for e in evs if e["name"] == ev.DECODE)
+    step = next(e for e in evs if e["name"] == "serving.unified_step")
+    assert decode["pid"] == step["pid"] and decode["tid"] == 3
+    assert step["tid"] == 1 and step["ph"] == "X" and step["dur"] >= 0
+    # the replica-less train span lands on the host row
+    train = next(e for e in evs if e["name"] == "train.step")
+    assert train["pid"] == 1
+    # counter tracks carry the registry's last-known values
+    ctrs = {e["name"]: e["args"]["value"] for e in evs if e["ph"] == "C"}
+    assert ctrs["serving/admissions|replica=0"] == 2.0
+    assert ctrs["serving/kv_occupancy|replica=0"] == 0.5
+    # every ts is rebased non-negative and the doc is pure JSON
+    assert min(e["ts"] for e in evs) >= 0.0
+    path = write_chrome_trace(tmp_path / "trace.json", tr, reg)
+    assert validate_chrome_trace(json.loads(path.read_text())) == []
+
+
+def test_chrome_trace_validator_catches_corruption(traced, tmp_path):
+    traced.event("x", replica="0")
+    doc = chrome_trace(traced)
+    doc["traceEvents"].append({"ph": "Z", "name": "bad"})
+    doc["traceEvents"].append({"ph": "X", "name": "negdur", "ts": 1.0,
+                               "dur": -5.0, "pid": 1, "tid": 1})
+    probs = validate_chrome_trace(doc)
+    assert any("ph 'Z'" in p for p in probs)
+    assert any("negdur" in p or "dur" in p for p in probs)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+def _exposition_registry():
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("serving/admissions")
+    c.inc(3, replica="0")
+    c.inc(4, replica="1", slo="latency")
+    reg.gauge("serving/kv_occupancy").set(0.25, replica="0")
+    h = reg.histogram("serving/ttft_s", buckets=(0.1, 0.5, 1.0))
+    h.observe(0.05, replica="0")
+    h.observe(0.7, replica="0")
+    h.observe(2.0, replica="1")
+    return reg
+
+
+def test_prometheus_round_trip_counter_gauge_histogram():
+    """The acceptance pin: render -> parse -> every sample (incl.
+    labeled subsets) matches the registry accessors; histograms expose
+    CUMULATIVE _bucket rows closing at +Inf plus _sum/_count."""
+    reg = _exposition_registry()
+    text = render_prometheus(reg)
+    parsed = parse_prometheus(text)
+
+    fam = parsed[prom_name("serving/admissions") + "_total"]
+    assert fam["type"] == "counter" and fam["help"]
+    by_labels = {tuple(sorted(s[1].items())): s[2] for s in fam["samples"]}
+    assert by_labels[(("replica", "0"),)] == 3
+    assert by_labels[(("replica", "1"), ("slo", "latency"))] == 4
+
+    g = parsed[prom_name("serving/kv_occupancy")]
+    assert g["type"] == "gauge"
+    assert g["samples"][0][2] == 0.25
+
+    h = parsed[prom_name("serving/ttft_s")]
+    assert h["type"] == "histogram"
+    rows = {(s[0].rsplit("_", 1)[-1] if not s[0].endswith("_bucket")
+             else s[1]["le"], s[1].get("replica")): s[2]
+            for s in h["samples"]}
+    # cumulative buckets for replica 0: 1 under 0.1, still 1 at 0.5,
+    # 2 at 1.0 and +Inf
+    assert rows[("0.1", "0")] == 1
+    assert rows[("0.5", "0")] == 1
+    assert rows[("1", "0")] == 2
+    assert rows[("+Inf", "0")] == 2
+    assert rows[("sum", "0")] == pytest.approx(0.75)
+    assert rows[("count", "0")] == 2
+    assert rows[("+Inf", "1")] == 1
+    # HELP/TYPE metadata precedes every family exactly once
+    assert text.count("# TYPE " + prom_name("serving/ttft_s")
+                      + " histogram") == 1
+
+
+def test_prometheus_escaping_and_name_sanitization():
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("odd/name-with.runes").inc(
+        1, path='a"b\\c', note="line\nbreak")
+    text = render_prometheus(reg)
+    assert "apex_tpu_odd_name_with_runes_total" in text
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    parsed = parse_prometheus(text)
+    [(_, labels, v)] = parsed["apex_tpu_odd_name_with_runes_total"]["samples"]
+    assert labels == {"path": 'a"b\\c', "note": "line\nbreak"} and v == 1
+
+
+def test_textfile_collector_write_is_atomic(tmp_path):
+    reg = _exposition_registry()
+    path = tmp_path / "collector" / "apex.prom"
+    out = write_textfile(path, reg)
+    assert out == path
+    assert parse_prometheus(path.read_text())
+    # rewrite replaces in place; no stale tmp files remain
+    write_textfile(path, reg)
+    assert [p.name for p in path.parent.iterdir()] == ["apex.prom"]
+
+
+def test_http_endpoint_serves_metrics():
+    reg = _exposition_registry()
+    srv = start_http_server(registry=reg)
+    try:
+        body = urllib.request.urlopen(srv.url, timeout=10).read().decode()
+        assert body == render_prometheus(reg)
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://{srv.addr}:{srv.port}/nope", timeout=10)
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# SLO-aligned histogram boundaries
+# ---------------------------------------------------------------------------
+
+def test_slo_buckets_put_target_on_a_boundary(monkeypatch):
+    b = slo.slo_buckets(0.5)
+    assert 0.5 in b and b == tuple(sorted(b))
+    assert b[0] < 0.5 < b[-1]
+    monkeypatch.setenv("APEX_TPU_SLO_LATENCY_TPOT_S", "0.2")
+    t = slo.targets_for(slo.LATENCY)
+    assert 0.2 in slo.slo_buckets(t.tpot_s)
+    with pytest.raises(ValueError):
+        slo.slo_buckets(0)
+
+
+# ---------------------------------------------------------------------------
+# the serving engine under tracing: events, HLO pin, zero extra compiles
+# ---------------------------------------------------------------------------
+
+def _tiny_engine(**scfg_kw):
+    from apex_tpu.serving import ServingConfig, ServingEngine
+    from apex_tpu.testing import TransformerConfig, transformer_init
+
+    cfg = TransformerConfig(vocab_size=64, seq_len=32, hidden=16, layers=1,
+                            heads=2, causal=True)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    kw = dict(num_blocks=32, block_size=4, max_slots=2, max_prefill_len=8,
+              max_seq_len=16)
+    kw.update(scfg_kw)
+    return ServingEngine(ServingConfig(model=cfg, **kw), params), cfg
+
+
+def test_engine_step_hlo_identical_trace_on_off(monkeypatch):
+    """The acceptance pin: the unified step lowers byte-identical with
+    the tracer enabled vs disabled — tracing is host-side only."""
+    monkeypatch.setenv("APEX_TPU_USE_PALLAS", "0")
+
+    def step_text(trace):
+        if trace is None:
+            monkeypatch.delenv("APEX_TPU_TRACE", raising=False)
+        else:
+            monkeypatch.setenv("APEX_TPU_TRACE", trace)
+        eng, _ = _tiny_engine()
+        cache = eng.fresh_cache()
+        tq = eng.scfg.chunk_tokens
+        return eng._step.lower(
+            eng.params, cache, jnp.zeros((tq,), jnp.int32),
+            jnp.zeros((2,), jnp.int32), jnp.zeros((2,), jnp.int32)
+        ).as_text()
+
+    assert step_text(None) == step_text("1")
+
+
+def test_traced_run_lifecycle_chains_and_no_extra_compiles(
+        traced, monkeypatch):
+    """A traced 8-request staggered run still compiles the step exactly
+    once, every request's chain replays complete, the ttft histogram
+    carries the SLO-aligned boundaries (target on a bucket edge), and
+    the step spans ride the ring."""
+    monkeypatch.setenv("APEX_TPU_USE_PALLAS", "0")
+    from apex_tpu.serving import Request
+
+    eng, cfg = _tiny_engine(prefix_cache=False)
+    reqs = [Request(rid=i, prompt=[1 + i, 2, 3], max_new_tokens=3,
+                    arrival=i // 4)
+            for i in range(8)]
+    out = eng.run(reqs)
+    stats = out.pop(None)
+    assert stats["trace_counts"]["step"] == 1, stats["trace_counts"]
+
+    evs = traced.events()
+    for r in reqs:
+        probs = ev.chain_problems(ev.chain_for(evs, r.rid))
+        assert not probs, (r.rid, probs)
+    spans = [e for e in evs if e["name"] == "serving.unified_step"]
+    assert spans and all(e["ph"] == "X" and e["dur"] > 0 for e in spans)
+    # SLO-aligned boundaries: the env target is a bucket edge
+    targets = slo.targets_for(slo.LATENCY)
+    reg = default_registry()
+    assert targets.ttft_s in reg.histogram("serving/ttft_s").buckets
+    assert targets.tpot_s in reg.histogram("serving/tpot_s").buckets
+    # state summary is pure host-mirror data, json-safe
+    sess = eng.session()
+    summary = sess.state_summary()
+    json.dumps(summary)
+    assert summary["replica"] == "0" and summary["slots"] == {}
+
+
+def test_goodput_spans_split_compile_and_run(traced):
+    from apex_tpu.observability.goodput import GoodputTracker
+
+    t = GoodputTracker()
+    f = jax.jit(t.wrap_step(lambda x: x * 2))
+    x = jnp.ones((8,))
+    for _ in range(3):
+        with t.step(tokens=8):
+            jax.block_until_ready(f(x))
+    spans = [e for e in traced.events() if e["name"] == "goodput.step"]
+    assert [s["labels"]["phase"] for s in spans] == ["compile", "run",
+                                                     "run"]
+    assert all(s["ph"] == "X" and s["dur"] > 0 for s in spans)
+
+
+# ---------------------------------------------------------------------------
+# the flight recorder: fault-injected fleet drive -> postmortem replay
+# ---------------------------------------------------------------------------
+
+def test_fleet_fault_dumps_postmortem_with_complete_chains(
+        traced, monkeypatch, tmp_path):
+    """The acceptance pin: a FaultPlan-injected N=2 drive produces a
+    postmortem dump; replaying it shows (a) crash-time state — the dead
+    replica's slots/seq_lens/queue depth, the drained rids — and (b)
+    after the drive-end epilogue, a complete submit→…→finish chain for
+    every drained request ACROSS its two placements."""
+    monkeypatch.setenv("APEX_TPU_USE_PALLAS", "0")
+    monkeypatch.setenv("APEX_TPU_TRACE_DIR", str(tmp_path))
+    from apex_tpu.serving import FaultPlan, Request, Router
+
+    from apex_tpu.serving import ServingConfig
+    from apex_tpu.testing import TransformerConfig, transformer_init
+
+    cfg = TransformerConfig(vocab_size=64, seq_len=32, hidden=16,
+                            layers=1, heads=2, causal=True)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    scfg = ServingConfig(model=cfg, num_blocks=32, block_size=4,
+                         max_slots=2, max_prefill_len=8, max_seq_len=16)
+    fleet = Router(scfg, params, n_replicas=2,
+                   fault_plan=FaultPlan({1: 1}))
+    reqs = [Request(rid=i, prompt=[1 + i, 2, 3], max_new_tokens=3,
+                    arrival=i // 4)
+            for i in range(8)]
+    out = fleet.serve(reqs)
+    stats = out.pop(None)
+    assert stats["dead_replicas"] == [1]
+    assert len(stats["postmortems"]) == 1
+    assert stats["faults"][0]["postmortem"] == stats["postmortems"][0]
+
+    pm = ev.load_postmortem(stats["postmortems"][0])
+    assert pm.path.name.startswith("postmortem-")
+    assert "replica 1 fault" in pm.header["reason"]
+    # crash-time state: host mirrors of the dying replica
+    crash = pm.state["replicas"]["1"]
+    assert crash["slots"] and not crash["alive"] is None
+    for st in crash["slots"].values():
+        assert st["seq_len"] >= 0 and st["rid"]
+    assert pm.state["failed_replica"] == 1
+    # the registry snapshot rode along
+    assert "serving/admissions" in pm.metrics
+    # drained chains replay complete across BOTH placements
+    drained = pm.drained_rids()
+    assert drained
+    for rid in drained:
+        assert pm.chain_problems(rid) == [], (rid, pm.chain_problems(rid))
+        placements = {e["labels"]["replica"] for e in pm.chain(rid)}
+        assert placements == {"0", "1"}, (rid, placements)
+    # non-drained requests are complete too (epilogue merged them)
+    for r in reqs:
+        assert pm.chain_problems(r.rid) == []
+    assert pm.epilogue is not None and pm.epilogue["events"] > 0
+    # recovery never retraced
+    assert all(c["step"] == 1 for c in fleet.trace_counts().values())
+
+
+def test_postmortem_requires_header(tmp_path):
+    p = tmp_path / "not_a_dump.jsonl"
+    p.write_text('{"kind": "event", "name": "x", "seq": 0}\n')
+    with pytest.raises(ValueError, match="no header"):
+        ev.load_postmortem(p)
+
+
+def test_dump_and_epilogue_roundtrip(tmp_path):
+    tr = Tracer(enabled=True)
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("c").inc(2)
+    tr.event(ev.SUBMIT, rid="x", replica="0")
+    path = ev.dump_postmortem(reason="unit", state={"drained": ["x"]},
+                              tracer=tr, registry=reg,
+                              directory=tmp_path)
+    # post-dump events land in the epilogue, pre-dump ones are not
+    # duplicated
+    for name in (ev.QUEUE, ev.ADMIT, ev.FIRST_TOKEN, ev.FINISH):
+        tr.event(name, rid="x", replica="0")
+    appended = ev.append_epilogue(path, tracer=tr, state={"done": True})
+    assert appended == 4
+    pm = ev.load_postmortem(path)
+    assert [e["name"] for e in pm.chain("x")] == [
+        ev.SUBMIT, ev.QUEUE, ev.ADMIT, ev.FIRST_TOKEN, ev.FINISH]
+    assert pm.chain_problems("x") == []
+    assert pm.metrics["c"]["series"][0]["value"] == 2
+    assert pm.epilogue["state"] == {"done": True}
